@@ -1,0 +1,20 @@
+"""cerbos_tpu: a TPU-native authorization Policy Decision Point.
+
+A from-scratch rebuild of the capabilities of cerbos/cerbos (see SURVEY.md) with
+the rule/condition evaluation hot loop lowered to JAX/XLA for batched execution
+on TPU. The package layout mirrors the reference's layer map (SURVEY.md §1):
+
+- ``policy``    policy model + YAML parser        (ref: internal/policy, internal/parser)
+- ``cel``       CEL condition language runtime    (ref: internal/conditions)
+- ``compile``   policy compiler                   (ref: internal/compile)
+- ``ruletable`` flattened rule rows + index + CPU oracle evaluator
+                                                  (ref: internal/ruletable)
+- ``engine``    batch dispatch facade             (ref: internal/engine)
+- ``tpu``       device lowering + vectorized evaluator (new; no reference equivalent)
+- ``parallel``  jax.sharding mesh helpers for batch/table sharding (new)
+- ``storage``   policy stores                     (ref: internal/storage)
+- ``server``    gRPC + HTTP API                   (ref: internal/server, internal/svc)
+- ``audit``     decision/access logs              (ref: internal/audit)
+"""
+
+__version__ = "0.1.0"

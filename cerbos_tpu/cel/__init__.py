@@ -1,0 +1,27 @@
+"""CEL (Common Expression Language) runtime.
+
+Behavioral reference: internal/conditions (cel-go environment with the Cerbos
+declarations and custom library). This is an independent implementation: a
+recursive-descent parser to a small AST, a tree-walking interpreter with CEL
+error semantics (error-absorbing ``||``/``&&``/``?:``), the standard library
+plus the strings/lists/math/encoders/bindings extensions the reference enables
+(internal/conditions/cel.go:62-74), and the Cerbos custom functions
+(internal/conditions/cerbos_lib.go:25-46).
+"""
+
+from .ast import (  # noqa: F401
+    Call,
+    Comprehension,
+    Ident,
+    Index,
+    ListLit,
+    Lit,
+    MapLit,
+    Node,
+    Select,
+)
+from .errors import CelError, CelParseError  # noqa: F401
+from .parser import parse  # noqa: F401
+from .interp import Activation, evaluate  # noqa: F401
+from .values import Duration, Timestamp, UInt, celtype_name  # noqa: F401
+from .checker import check  # noqa: F401

@@ -1,0 +1,122 @@
+"""CEL AST nodes.
+
+Macros (has/all/exists/exists_one/map/filter, cel.bind, two-var
+comprehensions) are desugared by the parser into :class:`Comprehension` /
+:class:`Bind` / :class:`Present` nodes so the interpreter and the TPU lowering
+see a small, closed node set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class Node:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Lit(Node):
+    value: Any
+
+
+@dataclass(frozen=True)
+class Ident(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class Select(Node):
+    operand: Node
+    field: str
+
+
+@dataclass(frozen=True)
+class Present(Node):
+    """has(e.f) — field/key presence test."""
+
+    operand: Node
+    field: str
+
+
+@dataclass(frozen=True)
+class Index(Node):
+    operand: Node
+    index: Node
+
+
+@dataclass(frozen=True)
+class Call(Node):
+    """Function or operator call. ``target`` is the receiver for member calls
+    (``a.f(b)``); None for global calls and operators (named ``_&&_`` etc.)."""
+
+    fn: str
+    args: tuple[Node, ...]
+    target: Optional[Node] = None
+
+
+@dataclass(frozen=True)
+class ListLit(Node):
+    items: tuple[Node, ...]
+
+
+@dataclass(frozen=True)
+class MapLit(Node):
+    entries: tuple[tuple[Node, Node], ...]
+
+
+@dataclass(frozen=True)
+class Bind(Node):
+    """cel.bind(name, init, body)."""
+
+    name: str
+    init: Node
+    body: Node
+
+
+@dataclass(frozen=True)
+class Comprehension(Node):
+    """Desugared macro over ``iter_range``.
+
+    kind: one of all/exists/exists_one/map/filter/transform_list/transform_map
+    /transform_map_entry. ``iter_var2`` is set for two-var comprehensions.
+    ``step2`` holds the transform for map-with-filter / transform variants.
+    """
+
+    kind: str
+    iter_range: Node
+    iter_var: str
+    step: Node
+    iter_var2: Optional[str] = None
+    step2: Optional[Node] = None
+
+
+def walk(node: Node):
+    """Yield every node in the tree (pre-order)."""
+    yield node
+    if isinstance(node, (Select, Present)):
+        yield from walk(node.operand)
+    elif isinstance(node, Index):
+        yield from walk(node.operand)
+        yield from walk(node.index)
+    elif isinstance(node, Call):
+        if node.target is not None:
+            yield from walk(node.target)
+        for a in node.args:
+            yield from walk(a)
+    elif isinstance(node, ListLit):
+        for a in node.items:
+            yield from walk(a)
+    elif isinstance(node, MapLit):
+        for k, v in node.entries:
+            yield from walk(k)
+            yield from walk(v)
+    elif isinstance(node, Bind):
+        yield from walk(node.init)
+        yield from walk(node.body)
+    elif isinstance(node, Comprehension):
+        yield from walk(node.iter_range)
+        yield from walk(node.step)
+        if node.step2 is not None:
+            yield from walk(node.step2)

@@ -1,0 +1,352 @@
+"""Cerbos custom CEL function library.
+
+Behavioral reference: internal/conditions/cerbos_lib.go:25-46 (function list)
+and internal/conditions/types/{hierarchy,spiffe}.go. ``now()``/``timeSince()``
+read the request-stable now-function from the activation, matching the
+reference's CacheFriendlyTimeDecorator behavior (cerbos_lib.go:274-334).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import posixpath
+import re as _re
+from typing import Any
+
+from .errors import CelError, no_such_overload
+from .stdlib import FUNCTIONS, METHODS, _as_list, _as_str, func, method
+from .values import Duration, Timestamp, values_equal
+
+
+def _set_except(a: Any, b: Any) -> list:
+    xs, ys = _as_list(a, "except"), _as_list(b, "except")
+    return [x for x in xs if not any(values_equal(x, y) for y in ys)]
+
+
+def _set_intersect(a: Any, b: Any) -> list:
+    xs, ys = _as_list(a, "intersect"), _as_list(b, "intersect")
+    out = []
+    for x in xs:
+        if any(values_equal(x, y) for y in ys) and not any(values_equal(x, o) for o in out):
+            out.append(x)
+    return out
+
+
+def _set_has_intersection(a: Any, b: Any) -> bool:
+    xs, ys = _as_list(a, "hasIntersection"), _as_list(b, "hasIntersection")
+    return any(any(values_equal(x, y) for y in ys) for x in xs)
+
+
+def _set_is_subset(a: Any, b: Any) -> bool:
+    xs, ys = _as_list(a, "isSubset"), _as_list(b, "isSubset")
+    return all(any(values_equal(x, y) for y in ys) for x in xs)
+
+
+for _name, _fn in (
+    ("except", _set_except),
+    ("intersect", _set_intersect),
+    ("hasIntersection", _set_has_intersection),
+    ("has_intersection", _set_has_intersection),
+    ("isSubset", _set_is_subset),
+    ("is_subset", _set_is_subset),
+):
+    FUNCTIONS[_name] = (lambda f: lambda args, ctx: f(args[0], args[1]))(_fn)
+    METHODS[_name] = (lambda f: lambda t, args, ctx: f(t, args[0]))(_fn)
+
+
+@func("now")
+def _f_now(args, ctx):
+    return ctx.now()
+
+
+@func("timeSince")
+def _f_timesince(args, ctx):
+    return _time_since(args[0], ctx)
+
+
+@method("timeSince")
+def _m_timesince(t, args, ctx):
+    return _time_since(t, ctx)
+
+
+def _time_since(v: Any, ctx) -> Duration:
+    if not isinstance(v, Timestamp):
+        raise no_such_overload("timeSince", v)
+    return Duration.from_timedelta(ctx.now() - v)
+
+
+@method("inIPAddrRange")
+def _m_in_ip_range(t, args, ctx):
+    addr_s = _as_str(t, "inIPAddrRange")
+    cidr_s = _as_str(args[0], "inIPAddrRange")
+    try:
+        addr = ipaddress.ip_address(addr_s)
+        net = ipaddress.ip_network(cidr_s, strict=False)
+    except ValueError as e:
+        raise CelError(f"inIPAddrRange: {e}") from None
+    if addr.version != net.version:
+        return False
+    return addr in net
+
+
+@func("id")
+def _f_id(args, ctx):
+    return args[0]
+
+
+# --- path functions (ref: internal/conditions/crosspath; POSIX semantics) ---
+
+
+def _clean_path(p: str) -> str:
+    if p == "":
+        return "."
+    cleaned = posixpath.normpath(p)
+    if p.endswith("/") and cleaned != "/":
+        pass  # normpath drops trailing slash, matching Go's path.Clean
+    return cleaned
+
+
+@func("basePath")
+def _f_basepath(args, ctx):
+    p = _as_str(args[0], "basePath")
+    if p == "":
+        return "."
+    p = p.rstrip("/")
+    if p == "":
+        return "/"
+    base = posixpath.basename(p)
+    return base if base else "/"
+
+
+@func("dirPath")
+def _f_dirpath(args, ctx):
+    return posixpath.dirname(_as_str(args[0], "dirPath")) or "."
+
+
+@func("extPath")
+def _f_extpath(args, ctx):
+    p = _as_str(args[0], "extPath")
+    base = posixpath.basename(p)
+    i = base.rfind(".")
+    return base[i:] if i >= 0 else ""
+
+
+@func("joinPath")
+def _f_joinpath(args, ctx):
+    parts = _as_list(args[0], "joinPath")
+    strs = []
+    for p in parts:
+        if not isinstance(p, str):
+            raise no_such_overload("joinPath", p)
+        strs.append(p)
+    nonempty = [p for p in strs if p]
+    if not nonempty:
+        return ""
+    return _clean_path("/".join(nonempty))
+
+
+def _path_has_prefix(p: str, prefix: str) -> bool:
+    p, prefix = _clean_path(p), _clean_path(prefix)
+    if prefix in (".", "/"):
+        return prefix == "/" and p.startswith("/") or prefix == "."
+    return p == prefix or p.startswith(prefix + "/")
+
+
+@func("pathHasPrefix")
+def _f_pathhasprefix(args, ctx):
+    return _path_has_prefix(_as_str(args[0], "pathHasPrefix"), _as_str(args[1], "pathHasPrefix"))
+
+
+@method("pathHasPrefix")
+def _m_pathhasprefix(t, args, ctx):
+    return _path_has_prefix(_as_str(t, "pathHasPrefix"), _as_str(args[0], "pathHasPrefix"))
+
+
+def _path_match(pattern: str, name: str) -> bool:
+    """Go path.Match semantics: *, ?, [class]; no ** and * stops at '/'."""
+    rx = _path_match_rx(pattern)
+    return bool(rx.match(name))
+
+
+_PATH_RX_CACHE: dict[str, _re.Pattern] = {}
+
+
+def _path_match_rx(pattern: str) -> _re.Pattern:
+    rx = _PATH_RX_CACHE.get(pattern)
+    if rx is not None:
+        return rx
+    out, i, n = [], 0, len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c == "*":
+            out.append("[^/]*")
+        elif c == "?":
+            out.append("[^/]")
+        elif c == "[":
+            j = i + 1
+            neg = j < n and pattern[j] == "^"
+            if neg:
+                j += 1
+            k = j
+            while k < n and pattern[k] != "]":
+                k += 1
+            if k >= n:
+                raise CelError(f"pathMatch: bad pattern {pattern!r}")
+            body = pattern[j:k]
+            out.append(f"[{'^' if neg else ''}{body}]")
+            i = k
+        elif c == "\\":
+            if i + 1 >= n:
+                raise CelError(f"pathMatch: bad pattern {pattern!r}")
+            out.append(_re.escape(pattern[i + 1]))
+            i += 1
+        else:
+            out.append(_re.escape(c))
+        i += 1
+    rx = _re.compile("^" + "".join(out) + "$")
+    _PATH_RX_CACHE[pattern] = rx
+    return rx
+
+
+@func("pathMatch")
+def _f_pathmatch(args, ctx):
+    # arg order per crosspath.Match(path, pattern)
+    return _path_match(_as_str(args[1], "pathMatch"), _as_str(args[0], "pathMatch"))
+
+
+@method("pathMatch")
+def _m_pathmatch(t, args, ctx):
+    return _path_match(_as_str(args[0], "pathMatch"), _as_str(t, "pathMatch"))
+
+
+@func("pathMatchAnyOf")
+def _f_pathmatchanyof(args, ctx):
+    name = _as_str(args[0], "pathMatchAnyOf")
+    pats = _as_list(args[1], "pathMatchAnyOf")
+    return any(_path_match(_as_str(p, "pathMatchAnyOf"), name) for p in pats)
+
+
+@method("pathMatchAnyOf")
+def _m_pathmatchanyof(t, args, ctx):
+    name = _as_str(t, "pathMatchAnyOf")
+    pats = _as_list(args[0], "pathMatchAnyOf")
+    return any(_path_match(_as_str(p, "pathMatchAnyOf"), name) for p in pats)
+
+
+@func("relPath")
+def _f_relpath(args, ctx):
+    base = _as_str(args[0], "relPath")
+    target = _as_str(args[1], "relPath")
+    try:
+        return posixpath.relpath(target, base)
+    except ValueError as e:
+        raise CelError(f"relPath: {e}") from None
+
+
+@func("volumeName")
+def _f_volumename(args, ctx):
+    _as_str(args[0], "volumeName")
+    return ""  # POSIX paths have no volume component
+
+
+# --- hierarchy type (ref: internal/conditions/types/hierarchy.go) ---
+
+
+class Hierarchy:
+    """Dotted-path hierarchy value: hierarchy("a.b.c")."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, parts: list[str]):
+        if not parts or any(p == "" for p in parts):
+            raise CelError("invalid hierarchy")
+        self.parts = parts
+
+    def cel_type_name(self) -> str:
+        return "cerbos.lib.hierarchy"
+
+    def cel_equals(self, other: Any) -> bool:
+        return isinstance(other, Hierarchy) and other.parts == self.parts
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"hierarchy({'.'.join(self.parts)!r})"
+
+
+def _as_hierarchy(v: Any, fn: str) -> Hierarchy:
+    if isinstance(v, Hierarchy):
+        return v
+    raise no_such_overload(fn, v)
+
+
+@func("hierarchy")
+def _f_hierarchy(args, ctx):
+    v = args[0]
+    if isinstance(v, Hierarchy):
+        return v
+    if isinstance(v, str):
+        delim = "."
+        if len(args) > 1:
+            delim = _as_str(args[1], "hierarchy")
+        return Hierarchy(v.split(delim)) if v else Hierarchy([])
+    if isinstance(v, (list, tuple)):
+        return Hierarchy([_as_str(x, "hierarchy") for x in v])
+    raise no_such_overload("hierarchy", v)
+
+
+@method("ancestorOf")
+def _m_ancestorof(t, args, ctx):
+    h, o = _as_hierarchy(t, "ancestorOf"), _as_hierarchy(args[0], "ancestorOf")
+    return len(h.parts) < len(o.parts) and o.parts[: len(h.parts)] == h.parts
+
+
+@method("descendentOf")
+def _m_descendentof(t, args, ctx):
+    h, o = _as_hierarchy(t, "descendentOf"), _as_hierarchy(args[0], "descendentOf")
+    return len(o.parts) < len(h.parts) and h.parts[: len(o.parts)] == o.parts
+
+
+@method("commonAncestors")
+def _m_commonancestors(t, args, ctx):
+    h, o = _as_hierarchy(t, "commonAncestors"), _as_hierarchy(args[0], "commonAncestors")
+    common = []
+    for a, b in zip(h.parts, o.parts):
+        if a == b:
+            common.append(a)
+        else:
+            break
+    # the common ancestors exclude either hierarchy itself
+    if len(common) == len(h.parts) or len(common) == len(o.parts):
+        common = common[:-1]
+    if not common:
+        raise CelError("no common ancestors")
+    return Hierarchy(common)
+
+
+@method("immediateChildOf")
+def _m_immediatechildof(t, args, ctx):
+    h, o = _as_hierarchy(t, "immediateChildOf"), _as_hierarchy(args[0], "immediateChildOf")
+    return len(h.parts) == len(o.parts) + 1 and h.parts[: len(o.parts)] == o.parts
+
+
+@method("immediateParentOf")
+def _m_immediateparentof(t, args, ctx):
+    h, o = _as_hierarchy(t, "immediateParentOf"), _as_hierarchy(args[0], "immediateParentOf")
+    return len(o.parts) == len(h.parts) + 1 and o.parts[: len(h.parts)] == h.parts
+
+
+@method("siblingOf")
+def _m_siblingof(t, args, ctx):
+    h, o = _as_hierarchy(t, "siblingOf"), _as_hierarchy(args[0], "siblingOf")
+    return (
+        len(h.parts) == len(o.parts)
+        and len(h.parts) > 0
+        and h.parts[:-1] == o.parts[:-1]
+        and h.parts != o.parts
+    )
+
+
+@method("overlaps")
+def _m_overlaps(t, args, ctx):
+    h, o = _as_hierarchy(t, "overlaps"), _as_hierarchy(args[0], "overlaps")
+    m = min(len(h.parts), len(o.parts))
+    return h.parts[:m] == o.parts[:m]

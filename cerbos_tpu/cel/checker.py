@@ -1,0 +1,101 @@
+"""Light static checks at policy-compile time.
+
+The reference type-checks conditions against typed declarations
+(internal/conditions/cel.go:44-55); unknown root identifiers or misspelled
+request fields fail compilation. This checker reproduces the checks that
+matter for policy authoring without a full CEL type system: known root
+identifiers and the request message field shapes.
+"""
+
+from __future__ import annotations
+
+from .ast import Bind, Call, Comprehension, Ident, Index, ListLit, MapLit, Node, Present, Select
+from .errors import CelParseError
+
+ROOT_IDENTS = {
+    "request", "R", "P", "runtime",
+    "constants", "C", "variables", "V", "globals", "G",
+    # CEL type identifiers
+    "int", "uint", "double", "bool", "string", "bytes", "list", "map",
+    "null_type", "type",
+}
+
+_REQUEST_FIELDS = {"principal", "resource", "auxData"}
+_PRINCIPAL_FIELDS = {"id", "roles", "attr", "policyVersion", "scope"}
+_RESOURCE_FIELDS = {"kind", "id", "attr", "policyVersion", "scope"}
+_RUNTIME_FIELDS = {"effectiveDerivedRoles"}
+_AUXDATA_FIELDS = {"jwt"}
+
+
+class CheckError(CelParseError):
+    pass
+
+
+def check(node: Node) -> None:
+    """Raise CheckError for references that cel-go would reject at compile."""
+    _walk(node, set())
+
+
+def _walk(node: Node, bound: set[str]) -> None:
+    if isinstance(node, Ident):
+        if node.name not in ROOT_IDENTS and node.name not in bound:
+            raise CheckError(f"undeclared reference to '{node.name}'")
+        return
+    if isinstance(node, (Select, Present)):
+        _check_select(node, bound)
+        return
+    if isinstance(node, Index):
+        _walk(node.operand, bound)
+        _walk(node.index, bound)
+        return
+    if isinstance(node, Call):
+        if node.target is not None:
+            _walk(node.target, bound)
+        for a in node.args:
+            _walk(a, bound)
+        return
+    if isinstance(node, ListLit):
+        for a in node.items:
+            _walk(a, bound)
+        return
+    if isinstance(node, MapLit):
+        for k, v in node.entries:
+            _walk(k, bound)
+            _walk(v, bound)
+        return
+    if isinstance(node, Bind):
+        _walk(node.init, bound)
+        _walk(node.body, bound | {node.name})
+        return
+    if isinstance(node, Comprehension):
+        _walk(node.iter_range, bound)
+        inner = bound | {node.iter_var}
+        if node.iter_var2:
+            inner |= {node.iter_var2}
+        _walk(node.step, inner)
+        if node.step2 is not None:
+            _walk(node.step2, inner)
+        return
+
+
+def _check_select(node: Node, bound: set[str]) -> None:
+    field = node.field  # type: ignore[union-attr]
+    operand = node.operand  # type: ignore[union-attr]
+    # typed message field checks along known chains
+    if isinstance(operand, Ident) and operand.name not in bound:
+        if operand.name == "request" and field not in _REQUEST_FIELDS:
+            raise CheckError(f"undefined field '{field}' on request")
+        if operand.name == "P" and field not in _PRINCIPAL_FIELDS:
+            raise CheckError(f"undefined field '{field}' on principal")
+        if operand.name == "R" and field not in _RESOURCE_FIELDS:
+            raise CheckError(f"undefined field '{field}' on resource")
+        if operand.name == "runtime" and field not in _RUNTIME_FIELDS:
+            raise CheckError(f"undefined field '{field}' on runtime")
+    elif isinstance(operand, Select) and isinstance(operand.operand, Ident) and operand.operand.name == "request":
+        if operand.field == "principal" and field not in _PRINCIPAL_FIELDS:
+            raise CheckError(f"undefined field '{field}' on request.principal")
+        if operand.field == "resource" and field not in _RESOURCE_FIELDS:
+            raise CheckError(f"undefined field '{field}' on request.resource")
+        if operand.field == "auxData" and field not in _AUXDATA_FIELDS:
+            raise CheckError(f"undefined field '{field}' on request.auxData")
+    _walk(operand, bound)

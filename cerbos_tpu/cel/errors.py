@@ -1,0 +1,36 @@
+"""CEL evaluation and parse errors."""
+
+from __future__ import annotations
+
+
+class CelParseError(ValueError):
+    def __init__(self, msg: str, pos: int = -1, src: str = ""):
+        self.pos = pos
+        self.src = src
+        loc = f" at offset {pos}" if pos >= 0 else ""
+        super().__init__(f"{msg}{loc}")
+
+
+class CelError(Exception):
+    """A CEL runtime error value. Propagates like cel-go errors: strict
+    functions re-raise it; ``||``/``&&``/``?:`` and comprehension aggregates
+    absorb it where the spec requires."""
+
+    def __init__(self, msg: str):
+        self.msg = msg
+        super().__init__(msg)
+
+
+def no_such_overload(fn: str, *args: object) -> CelError:
+    from .values import celtype_name
+
+    sig = ", ".join(celtype_name(a) for a in args)
+    return CelError(f"found no matching overload for '{fn}' applied to ({sig})")
+
+
+def no_such_key(key: object) -> CelError:
+    return CelError(f"no such key: {key!r}")
+
+
+def no_such_attribute(name: str) -> CelError:
+    return CelError(f"no such attribute: {name}")

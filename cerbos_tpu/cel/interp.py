@@ -1,0 +1,543 @@
+"""Tree-walking CEL interpreter with cel-go-compatible semantics.
+
+Error values propagate as :class:`CelError` exceptions; ``||``/``&&``/``?:``
+and the all/exists comprehension aggregates absorb them per the CEL spec
+(commutative logical operators). This evaluator is the CPU oracle the TPU
+lowering is differentially tested against.
+"""
+
+from __future__ import annotations
+
+import math as _math
+from typing import Any, Callable, Optional
+
+from .ast import Bind, Call, Comprehension, Ident, Index, ListLit, Lit, MapLit, Node, Present, Select
+from .errors import CelError, no_such_key, no_such_overload
+from .stdlib import FUNCTIONS, METHODS
+from . import cerbos_lib  # noqa: F401  (registers cerbos functions on import)
+from .values import (
+    Duration,
+    Timestamp,
+    UInt,
+    celtype_name,
+    check_int,
+    check_uint,
+    compare,
+    is_number,
+    keys_equal,
+    values_equal,
+)
+
+
+from .values import CelType as _CelType
+
+TYPE_IDENTS = {
+    n: _CelType(n)
+    for n in ("int", "uint", "double", "bool", "string", "bytes", "list", "map", "null_type", "type")
+}
+
+
+class Message:
+    """A proto-message-like value: fixed fields with defaults.
+
+    Used for ``request``/``request.principal``/``request.resource``/``runtime``
+    so that unset fields yield defaults (proto semantics) while ``attr`` maps
+    yield errors for missing keys (map semantics), matching the reference's
+    typed CEL declarations (internal/conditions/cel.go:44-55).
+    """
+
+    __slots__ = ("fields",)
+
+    def __init__(self, fields: dict[str, Any]):
+        self.fields = fields
+
+    def cel_select(self, field: str) -> Any:
+        try:
+            return self.fields[field]
+        except KeyError:
+            raise CelError(f"no such field: {field}") from None
+
+    def cel_has(self, field: str) -> bool:
+        if field not in self.fields:
+            raise CelError(f"no such field: {field}")
+        v = self.fields[field]
+        if isinstance(v, (str, bytes, list, tuple, dict)):
+            return len(v) > 0
+        if isinstance(v, bool):
+            return v
+        if v is None:
+            return False
+        if is_number(v):
+            return v != 0
+        return True
+
+    def cel_type_name(self) -> str:
+        return "message"
+
+
+class LazyVal:
+    """Wraps a zero-arg callable resolved on first access (ref: lazyRuntime)."""
+
+    __slots__ = ("fn", "_val", "_done")
+
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+        self._val = None
+        self._done = False
+
+    def get(self) -> Any:
+        if not self._done:
+            self._val = self.fn()
+            self._done = True
+        return self._val
+
+
+class Activation:
+    """Variable bindings + the request-stable now() function."""
+
+    __slots__ = ("vars", "parent", "_now_fn", "_now_cache")
+
+    def __init__(self, vars: dict[str, Any], parent: Optional["Activation"] = None, now_fn: Optional[Callable[[], Timestamp]] = None):
+        self.vars = vars
+        self.parent = parent
+        self._now_fn = now_fn
+        self._now_cache: Optional[Timestamp] = None
+
+    def child(self, vars: dict[str, Any]) -> "Activation":
+        return Activation(vars, parent=self)
+
+    def resolve(self, name: str) -> Any:
+        a: Optional[Activation] = self
+        while a is not None:
+            if name in a.vars:
+                v = a.vars[name]
+                if isinstance(v, LazyVal):
+                    v = v.get()
+                    a.vars[name] = v
+                return v
+            a = a.parent
+        if name in TYPE_IDENTS:
+            return TYPE_IDENTS[name]
+        raise CelError(f"no such attribute: {name}")
+
+    def has(self, name: str) -> bool:
+        a: Optional[Activation] = self
+        while a is not None:
+            if name in a.vars:
+                return True
+            a = a.parent
+        return False
+
+    def now(self) -> Timestamp:
+        a: Optional[Activation] = self
+        while a is not None and a._now_fn is None:
+            a = a.parent
+        if a is None:
+            raise CelError("now() is not available")
+        if a._now_cache is None:
+            a._now_cache = a._now_fn()
+        return a._now_cache
+
+
+def evaluate(node: Node, act: Activation) -> Any:
+    """Evaluate; raises CelError for CEL runtime errors."""
+    return _eval(node, act)
+
+
+def _eval(node: Node, act: Activation) -> Any:
+    t = type(node)
+    if t is Lit:
+        return node.value
+    if t is Ident:
+        return act.resolve(node.name)
+    if t is Select:
+        return _select(_eval(node.operand, act), node.field)
+    if t is Present:
+        return _present(_eval(node.operand, act), node.field)
+    if t is Index:
+        return _index(_eval(node.operand, act), _eval(node.index, act))
+    if t is ListLit:
+        return [_eval(x, act) for x in node.items]
+    if t is MapLit:
+        out: dict = {}
+        for k_node, v_node in node.entries:
+            k = _eval(k_node, act)
+            if isinstance(k, (list, dict)):
+                raise no_such_overload("map_key", k)
+            dup = (k in out) if type(k) is str else any(keys_equal(k, existing) for existing in out)
+            if dup:
+                raise CelError(f"repeated key: {k!r}")
+            out[k] = _eval(v_node, act)
+        return out
+    if t is Bind:
+        return _eval(node.body, act.child({node.name: _eval(node.init, act)}))
+    if t is Comprehension:
+        return _comprehension(node, act)
+    if t is Call:
+        return _call(node, act)
+    raise CelError(f"unknown AST node {t.__name__}")
+
+
+def _select(operand: Any, field: str) -> Any:
+    if isinstance(operand, Message):
+        return operand.cel_select(field)
+    if isinstance(operand, dict):
+        if field in operand:
+            return operand[field]
+        raise no_such_key(field)
+    sel = getattr(operand, "cel_select", None)
+    if sel is not None:
+        return sel(field)
+    raise no_such_overload(f".{field}", operand)
+
+
+def _present(operand: Any, field: str) -> bool:
+    if isinstance(operand, Message):
+        return operand.cel_has(field)
+    if isinstance(operand, dict):
+        return field in operand
+    has = getattr(operand, "cel_has", None)
+    if has is not None:
+        return has(field)
+    raise no_such_overload(f"has(.{field})", operand)
+
+
+def _index(operand: Any, idx: Any) -> Any:
+    if isinstance(operand, (list, tuple)):
+        if type(idx) is bool:
+            raise no_such_overload("_[_]", operand, idx)
+        if isinstance(idx, float):
+            if idx != int(idx):
+                raise CelError(f"invalid index: {idx}")
+            idx = int(idx)
+        if not isinstance(idx, int):
+            raise no_such_overload("_[_]", operand, idx)
+        i = int(idx)
+        if not 0 <= i < len(operand):
+            raise CelError(f"index out of range: {i}")
+        return operand[i]
+    if isinstance(operand, dict):
+        # Fast path for string keys (the common case: attr maps). Python would
+        # conflate 1/True/1.0/UInt(1) as dict keys, which CEL key equality
+        # does not, so non-string lookups take the scan path.
+        if type(idx) is str:
+            try:
+                return operand[idx]
+            except KeyError:
+                raise no_such_key(idx) from None
+        for k, v in operand.items():
+            if keys_equal(idx, k):
+                return v
+        raise no_such_key(idx)
+    if isinstance(operand, Message):
+        if isinstance(idx, str):
+            return operand.cel_select(idx)
+        raise no_such_overload("_[_]", operand, idx)
+    raise no_such_overload("_[_]", operand, idx)
+
+
+# ---------------------------------------------------------------------------
+# operators
+
+
+def _arith_add(a: Any, b: Any) -> Any:
+    if type(a) is bool or type(b) is bool:
+        raise no_such_overload("_+_", a, b)
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        return check_uint(int(a) + int(b))
+    if isinstance(a, Timestamp) and isinstance(b, Duration):
+        return Timestamp.from_datetime(a + b)
+    if isinstance(a, Duration) and isinstance(b, Timestamp):
+        return Timestamp.from_datetime(b + a)
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return Duration.from_timedelta(a + b)
+    if isinstance(a, (Timestamp, Duration)) or isinstance(b, (Timestamp, Duration)):
+        raise no_such_overload("_+_", a, b)
+    if type(a) is int and type(b) is int:
+        return check_int(a + b)
+    if isinstance(a, float) and isinstance(b, float):
+        return a + b
+    if isinstance(a, str) and isinstance(b, str):
+        return a + b
+    if isinstance(a, bytes) and isinstance(b, bytes):
+        return a + b
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return list(a) + list(b)
+    raise no_such_overload("_+_", a, b)
+
+
+def _arith_sub(a: Any, b: Any) -> Any:
+    if type(a) is bool or type(b) is bool:
+        raise no_such_overload("_-_", a, b)
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        return check_uint(int(a) - int(b))
+    if isinstance(a, Timestamp) and isinstance(b, Timestamp):
+        return Duration.from_timedelta(a - b)
+    if isinstance(a, Timestamp) and isinstance(b, Duration):
+        return Timestamp.from_datetime(a - b)
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return Duration.from_timedelta(a - b)
+    if isinstance(a, (Timestamp, Duration)) or isinstance(b, (Timestamp, Duration)):
+        raise no_such_overload("_-_", a, b)
+    if type(a) is int and type(b) is int:
+        return check_int(a - b)
+    if isinstance(a, float) and isinstance(b, float):
+        return a - b
+    raise no_such_overload("_-_", a, b)
+
+
+def _arith_mul(a: Any, b: Any) -> Any:
+    if type(a) is bool or type(b) is bool:
+        raise no_such_overload("_*_", a, b)
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        return check_uint(int(a) * int(b))
+    if type(a) is int and type(b) is int:
+        return check_int(a * b)
+    if isinstance(a, float) and isinstance(b, float):
+        return a * b
+    raise no_such_overload("_*_", a, b)
+
+
+def _arith_div(a: Any, b: Any) -> Any:
+    if type(a) is bool or type(b) is bool:
+        raise no_such_overload("_/_", a, b)
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        if int(b) == 0:
+            raise CelError("division by zero")
+        return check_uint(int(a) // int(b))
+    if type(a) is int and type(b) is int:
+        if b == 0:
+            raise CelError("division by zero")
+        q = abs(a) // abs(b)
+        if (a < 0) != (b < 0):
+            q = -q
+        return check_int(q)
+    if isinstance(a, float) and isinstance(b, float):
+        if b == 0.0:
+            if a == 0.0 or _math.isnan(a):
+                return float("nan")
+            return _math.inf if (a > 0) == (not _math.copysign(1, b) < 0) else -_math.inf
+        return a / b
+    raise no_such_overload("_/_", a, b)
+
+
+def _arith_mod(a: Any, b: Any) -> Any:
+    if type(a) is bool or type(b) is bool:
+        raise no_such_overload("_%_", a, b)
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        if int(b) == 0:
+            raise CelError("modulus by zero")
+        return check_uint(int(a) % int(b))
+    if type(a) is int and type(b) is int:
+        if b == 0:
+            raise CelError("modulus by zero")
+        r = abs(a) % abs(b)
+        return check_int(-r if a < 0 else r)
+    raise no_such_overload("_%_", a, b)
+
+
+def _neg(a: Any) -> Any:
+    if type(a) is bool:
+        raise no_such_overload("-_", a)
+    if isinstance(a, UInt):
+        raise no_such_overload("-_", a)
+    if isinstance(a, int):
+        return check_int(-a)
+    if isinstance(a, float):
+        return -a
+    raise no_such_overload("-_", a)
+
+
+def _in_op(a: Any, b: Any) -> bool:
+    if isinstance(b, (list, tuple)):
+        return any(values_equal(a, x) for x in b)
+    if isinstance(b, dict):
+        if type(a) is str:
+            return a in b
+        return any(keys_equal(a, k) for k in b)
+    raise no_such_overload("_in_", a, b)
+
+
+def _logic(node: Call, act: Activation, is_and: bool) -> Any:
+    """Commutative error-absorbing && / ||."""
+    short = False if is_and else True
+    vals: list[Any] = []
+    err: Optional[CelError] = None
+    for arg in node.args:
+        try:
+            v = _eval(arg, act)
+        except CelError as e:
+            err = err or e
+            continue
+        if type(v) is bool:
+            if v is short:
+                return short
+            vals.append(v)
+        else:
+            err = err or no_such_overload("_&&_" if is_and else "_||_", v)
+    if err is not None:
+        raise err
+    return not short
+
+
+def _call(node: Call, act: Activation) -> Any:
+    fn = node.fn
+    if node.target is None:
+        if fn == "_&&_":
+            return _logic(node, act, is_and=True)
+        if fn == "_||_":
+            return _logic(node, act, is_and=False)
+        if fn == "_?_:_":
+            cond = _eval(node.args[0], act)
+            if type(cond) is not bool:
+                raise no_such_overload("_?_:_", cond)
+            return _eval(node.args[1 if cond else 2], act)
+        if fn == "_==_":
+            return values_equal(_eval(node.args[0], act), _eval(node.args[1], act))
+        if fn == "_!=_":
+            return not values_equal(_eval(node.args[0], act), _eval(node.args[1], act))
+        if fn in ("_<_", "_<=_", "_>_", "_>=_"):
+            c = compare(_eval(node.args[0], act), _eval(node.args[1], act))
+            return {"_<_": c < 0, "_<=_": c <= 0, "_>_": c > 0, "_>=_": c >= 0}[fn]
+        if fn == "_+_":
+            return _arith_add(_eval(node.args[0], act), _eval(node.args[1], act))
+        if fn == "_-_":
+            return _arith_sub(_eval(node.args[0], act), _eval(node.args[1], act))
+        if fn == "_*_":
+            return _arith_mul(_eval(node.args[0], act), _eval(node.args[1], act))
+        if fn == "_/_":
+            return _arith_div(_eval(node.args[0], act), _eval(node.args[1], act))
+        if fn == "_%_":
+            return _arith_mod(_eval(node.args[0], act), _eval(node.args[1], act))
+        if fn == "!_":
+            v = _eval(node.args[0], act)
+            if type(v) is not bool:
+                raise no_such_overload("!_", v)
+            return not v
+        if fn == "-_":
+            return _neg(_eval(node.args[0], act))
+        if fn == "_in_":
+            return _in_op(_eval(node.args[0], act), _eval(node.args[1], act))
+        handler = FUNCTIONS.get(fn)
+        if handler is None:
+            raise CelError(f"unknown function: {fn}")
+        args = tuple(_eval(a, act) for a in node.args)
+        return handler(args, act)
+
+    target = _eval(node.target, act)
+    handler = METHODS.get(fn)
+    if handler is None:
+        raise CelError(f"unknown function: {fn}")
+    args = tuple(_eval(a, act) for a in node.args)
+    return handler(target, args, act)
+
+
+# ---------------------------------------------------------------------------
+# comprehensions
+
+
+def _iter_items(range_val: Any, two_var: bool, kind: str):
+    if isinstance(range_val, (list, tuple)):
+        if two_var:
+            return list(enumerate(range_val))
+        return [(None, v) for v in range_val]
+    if isinstance(range_val, dict):
+        if two_var:
+            return list(range_val.items())
+        return [(None, k) for k in range_val.keys()]
+    raise no_such_overload(kind, range_val)
+
+
+def _comprehension(node: Comprehension, act: Activation) -> Any:
+    range_val = _eval(node.iter_range, act)
+    two_var = node.iter_var2 is not None
+    items = _iter_items(range_val, two_var, node.kind)
+
+    def bind(k: Any, v: Any) -> Activation:
+        if two_var:
+            return act.child({node.iter_var: k, node.iter_var2: v})
+        return act.child({node.iter_var: v})
+
+    kind = node.kind
+    if kind in ("all", "exists"):
+        # && / || aggregation with error absorption
+        short = kind == "exists"
+        err: Optional[CelError] = None
+        for k, v in items:
+            try:
+                p = _eval(node.step, bind(k, v))
+            except CelError as e:
+                err = err or e
+                continue
+            if type(p) is not bool:
+                err = err or no_such_overload(kind, p)
+                continue
+            if p is short:
+                return short
+        if err is not None:
+            raise err
+        return not short
+    if kind == "exists_one":
+        count = 0
+        for k, v in items:
+            p = _eval(node.step, bind(k, v))
+            if type(p) is not bool:
+                raise no_such_overload(kind, p)
+            if p:
+                count += 1
+        return count == 1
+    if kind == "map":
+        out = []
+        for k, v in items:
+            a = bind(k, v)
+            if node.step2 is not None:
+                keep = _eval(node.step2, a)
+                if type(keep) is not bool:
+                    raise no_such_overload("map", keep)
+                if not keep:
+                    continue
+            out.append(_eval(node.step, a))
+        return out
+    if kind == "filter":
+        out = []
+        for k, v in items:
+            p = _eval(node.step, bind(k, v))
+            if type(p) is not bool:
+                raise no_such_overload("filter", p)
+            if p:
+                out.append(v)
+        return out
+    if kind == "transform_list":
+        out = []
+        for k, v in items:
+            a = bind(k, v)
+            if node.step2 is not None:
+                keep = _eval(node.step2, a)
+                if type(keep) is not bool:
+                    raise no_such_overload(kind, keep)
+                if not keep:
+                    continue
+            out.append(_eval(node.step, a))
+        return out
+    if kind in ("transform_map", "transform_map_entry"):
+        out_map: dict = {}
+        for k, v in items:
+            a = bind(k, v)
+            if node.step2 is not None:
+                keep = _eval(node.step2, a)
+                if type(keep) is not bool:
+                    raise no_such_overload(kind, keep)
+                if not keep:
+                    continue
+            r = _eval(node.step, a)
+            if kind == "transform_map":
+                out_map[k] = r
+            else:
+                if not isinstance(r, dict):
+                    raise no_such_overload(kind, r)
+                for rk, rv in r.items():
+                    if any(keys_equal(rk, existing) for existing in out_map):
+                        raise CelError(f"insert failed, key {rk!r} already exists")
+                    out_map[rk] = rv
+        return out_map
+    raise CelError(f"unknown comprehension kind {kind}")

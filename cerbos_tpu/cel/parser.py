@@ -1,0 +1,525 @@
+"""Recursive-descent CEL parser (grammar per the CEL spec).
+
+Produces the AST in :mod:`cerbos_tpu.cel.ast`, desugaring macros at parse time
+the way cel-go's macro expander does: ``has()``, the comprehension macros
+(``all``/``exists``/``exists_one``/``map``/``filter`` and their two-var
+variants), and ``cel.bind``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .ast import Bind, Call, Comprehension, Ident, Index, ListLit, Lit, MapLit, Node, Present, Select
+from .errors import CelParseError
+from .values import UInt, check_int
+
+_RESERVED = {
+    "as", "break", "const", "continue", "else", "for", "function", "if",
+    "import", "let", "loop", "package", "namespace", "return", "var",
+    "void", "while",
+}
+
+_TWO_CHAR_OPS = {"==", "!=", "<=", ">=", "&&", "||"}
+_PUNCT = set("()[]{}.,?:;+-*/%<>!=&|")
+
+
+class _Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind: str, value: Any, pos: int):
+        self.kind = kind  # IDENT, INT, UINT, FLOAT, STRING, BYTES, OP, EOF
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{self.kind}({self.value!r})"
+
+
+def _is_ident_start(c: str) -> bool:
+    return c.isalpha() or c == "_"
+
+
+def _is_ident_char(c: str) -> bool:
+    return c.isalnum() or c == "_"
+
+
+_ESCAPES = {
+    "a": "\a", "b": "\b", "f": "\f", "n": "\n", "r": "\r", "t": "\t",
+    "v": "\v", "\\": "\\", "'": "'", '"': '"', "`": "`", "?": "?",
+}
+
+
+def _tokenize(src: str) -> list[_Token]:
+    toks: list[_Token] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if c == "/" and i + 1 < n and src[i + 1] == "/":
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        start = i
+        # string / bytes literals with optional r/b prefixes (any order/case)
+        if c in "rRbB" or c in "'\"":
+            j = i
+            raw = is_bytes = False
+            while j < n and src[j] in "rRbB":
+                if src[j] in "rR":
+                    raw = True
+                else:
+                    is_bytes = True
+                j += 1
+            if j < n and src[j] in "'\"" and j - i <= 2:
+                s, j2 = _scan_string(src, j, raw)
+                if is_bytes:
+                    toks.append(_Token("BYTES", s.encode("utf-8") if isinstance(s, str) else s, start))
+                else:
+                    if isinstance(s, bytes):
+                        s = s.decode("utf-8", errors="surrogateescape")
+                    toks.append(_Token("STRING", s, start))
+                i = j2
+                continue
+            # fall through: plain identifier starting with r/b
+        if _is_ident_start(c):
+            j = i
+            while j < n and _is_ident_char(src[j]):
+                j += 1
+            toks.append(_Token("IDENT", src[i:j], start))
+            i = j
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            tok, i = _scan_number(src, i)
+            toks.append(tok)
+            continue
+        two = src[i : i + 2]
+        if two in _TWO_CHAR_OPS:
+            toks.append(_Token("OP", two, start))
+            i += 2
+            continue
+        if c in _PUNCT:
+            toks.append(_Token("OP", c, start))
+            i += 1
+            continue
+        raise CelParseError(f"unexpected character {c!r}", start, src)
+    toks.append(_Token("EOF", None, n))
+    return toks
+
+
+def _scan_number(src: str, i: int) -> tuple[_Token, int]:
+    n = len(src)
+    start = i
+    if src[i] == "0" and i + 1 < n and src[i + 1] in "xX":
+        j = i + 2
+        while j < n and src[j] in "0123456789abcdefABCDEF":
+            j += 1
+        if j == i + 2:
+            raise CelParseError("invalid hex literal", start, src)
+        if j < n and src[j] in "uU":
+            try:
+                return _Token("UINT", UInt(int(src[i:j], 16)), start), j + 1
+            except Exception:
+                raise CelParseError("uint literal out of range", start, src) from None
+        # range-checked in primary() after any sign folding
+        return _Token("INT", int(src[i:j], 16), start), j
+    j = i
+    is_float = False
+    while j < n and src[j].isdigit():
+        j += 1
+    if j < n and src[j] == "." and j + 1 < n and src[j + 1].isdigit():
+        is_float = True
+        j += 1
+        while j < n and src[j].isdigit():
+            j += 1
+    if j < n and src[j] in "eE":
+        k = j + 1
+        if k < n and src[k] in "+-":
+            k += 1
+        if k < n and src[k].isdigit():
+            is_float = True
+            j = k
+            while j < n and src[j].isdigit():
+                j += 1
+    if not is_float and j < n and src[j] in "uU":
+        return _Token("UINT", UInt(int(src[i:j])), start), j + 1
+    if is_float:
+        return _Token("FLOAT", float(src[i:j]), start), j
+    # no range check here: the parser folds a leading '-' before checking,
+    # so INT_MIN (-9223372036854775808) lexes as 9223372036854775808
+    return _Token("INT", int(src[i:j]), start), j
+
+
+def _scan_string(src: str, i: int, raw: bool) -> tuple[str, int]:
+    n = len(src)
+    quote = src[i]
+    triple = src[i : i + 3] in ('"""', "'''")
+    close = quote * 3 if triple else quote
+    i += len(close)
+    out: list[str] = []
+    while i < n:
+        if src.startswith(close, i):
+            return "".join(out), i + len(close)
+        c = src[i]
+        if c == "\n" and not triple:
+            raise CelParseError("newline in string literal", i, src)
+        if c == "\\" and not raw:
+            if i + 1 >= n:
+                raise CelParseError("unterminated escape", i, src)
+            e = src[i + 1]
+            if e in _ESCAPES:
+                out.append(_ESCAPES[e])
+                i += 2
+            elif e in ("x", "X", "u", "U") or e.isdigit():
+                if e in ("x", "X"):
+                    digits, base, skip = src[i + 2 : i + 4], 16, 4
+                elif e == "u":
+                    digits, base, skip = src[i + 2 : i + 6], 16, 6
+                elif e == "U":
+                    digits, base, skip = src[i + 2 : i + 10], 16, 10
+                else:
+                    digits, base, skip = src[i + 1 : i + 4], 8, 4
+                try:
+                    code = int(digits, base)
+                    out.append(chr(code))
+                except (ValueError, OverflowError):
+                    raise CelParseError(f"invalid escape sequence \\{e}{digits}", i, src) from None
+                i += skip
+            else:
+                raise CelParseError(f"invalid escape \\{e}", i, src)
+        else:
+            out.append(c)
+            i += 1
+    raise CelParseError("unterminated string literal", i, src)
+
+
+_ONE_VAR_MACROS = {"all": "all", "exists": "exists", "exists_one": "exists_one", "existsOne": "exists_one", "map": "map", "filter": "filter"}
+_TWO_VAR_MACROS = {
+    "all": "all", "exists": "exists", "existsOne": "exists_one", "exists_one": "exists_one",
+    "transformList": "transform_list", "transformMap": "transform_map",
+    "transformMapEntry": "transform_map_entry",
+}
+
+
+# Each nesting level costs ~9 interpreter stack frames in this
+# recursive-descent parser, so the cap must stay well inside Python's default
+# 1000-frame recursion limit. cel-go uses 250; real policy conditions are
+# nowhere near either bound.
+_MAX_RECURSION_DEPTH = 80
+
+
+class _Parser:
+    def __init__(self, src: str):
+        self.src = src
+        self.toks = _tokenize(src)
+        self.i = 0
+        self.depth = 0
+
+    def peek(self) -> _Token:
+        return self.toks[self.i]
+
+    def next(self) -> _Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "OP" and t.value == op:
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        t = self.next()
+        if t.kind != "OP" or t.value != op:
+            raise CelParseError(f"expected {op!r}, got {t.value!r}", t.pos, self.src)
+
+    def _check_int_lit(self, v: int, pos: int) -> int:
+        try:
+            return check_int(v)
+        except Exception:
+            raise CelParseError("integer literal out of range", pos, self.src) from None
+
+    def parse(self) -> Node:
+        e = self.expr()
+        t = self.peek()
+        if t.kind != "EOF":
+            raise CelParseError(f"unexpected trailing input {t.value!r}", t.pos, self.src)
+        return e
+
+    def expr(self) -> Node:
+        self.depth += 1
+        if self.depth > _MAX_RECURSION_DEPTH:
+            raise CelParseError("expression recursion limit exceeded", self.peek().pos, self.src)
+        try:
+            return self._expr_inner()
+        finally:
+            self.depth -= 1
+
+    def _expr_inner(self) -> Node:
+        cond = self.or_expr()
+        if self.accept_op("?"):
+            then = self.or_expr()
+            self.expect_op(":")
+            other = self.expr()
+            return Call("_?_:_", (cond, then, other))
+        return cond
+
+    def or_expr(self) -> Node:
+        left = self.and_expr()
+        while self.accept_op("||"):
+            right = self.and_expr()
+            left = Call("_||_", (left, right))
+        return left
+
+    def and_expr(self) -> Node:
+        left = self.relation()
+        while self.accept_op("&&"):
+            right = self.relation()
+            left = Call("_&&_", (left, right))
+        return left
+
+    _REL_NAMES = {"<": "_<_", "<=": "_<=_", ">": "_>_", ">=": "_>=_", "==": "_==_", "!=": "_!=_", "in": "_in_"}
+
+    def relation(self) -> Node:
+        # left-associative: `1 < 2 == true` parses as ((1 < 2) == true)
+        left = self.addition()
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value in ("<", "<=", ">", ">=", "==", "!="):
+                op = t.value
+                self.i += 1
+            elif t.kind == "IDENT" and t.value == "in":
+                op = "in"
+                self.i += 1
+            else:
+                return left
+            left = Call(self._REL_NAMES[op], (left, self.addition()))
+
+    def addition(self) -> Node:
+        left = self.multiplication()
+        while True:
+            if self.accept_op("+"):
+                left = Call("_+_", (left, self.multiplication()))
+            elif self.accept_op("-"):
+                left = Call("_-_", (left, self.multiplication()))
+            else:
+                return left
+
+    def multiplication(self) -> Node:
+        left = self.unary()
+        while True:
+            if self.accept_op("*"):
+                left = Call("_*_", (left, self.unary()))
+            elif self.accept_op("/"):
+                left = Call("_/_", (left, self.unary()))
+            elif self.accept_op("%"):
+                left = Call("_%_", (left, self.unary()))
+            else:
+                return left
+
+    def unary(self) -> Node:
+        if self.accept_op("!"):
+            count = 1
+            while self.accept_op("!"):
+                count += 1
+            operand = self.member()
+            return operand if count % 2 == 0 else Call("!_", (operand,))
+        if self.accept_op("-"):
+            count = 1
+            while self.accept_op("-"):
+                count += 1
+            # fold negation into a directly-following numeric literal so that
+            # INT_MIN parses (cel-go does the same in its parser)
+            nt = self.peek()
+            if nt.kind == "INT":
+                self.next()
+                v = -nt.value if count % 2 == 1 else nt.value
+                e: Node = Lit(self._check_int_lit(v, nt.pos))
+                return self._member_suffix(e)
+            if nt.kind == "FLOAT":
+                self.next()
+                e = Lit(-nt.value if count % 2 == 1 else nt.value)
+                return self._member_suffix(e)
+            operand = self.member()
+            return Call("-_", (operand,)) if count % 2 == 1 else operand
+        return self.member()
+
+    def member(self) -> Node:
+        return self._member_suffix(self.primary())
+
+    def _member_suffix(self, e: Node) -> Node:
+        while True:
+            if self.accept_op("."):
+                t = self.next()
+                if t.kind != "IDENT":
+                    raise CelParseError("expected identifier after '.'", t.pos, self.src)
+                name = t.value
+                if self.accept_op("("):
+                    args = self.arg_list(")")
+                    e = self.member_call(e, name, args)
+                else:
+                    e = Select(e, name)
+            elif self.accept_op("["):
+                idx = self.expr()
+                self.expect_op("]")
+                e = Index(e, idx)
+            else:
+                return e
+
+    def member_call(self, target: Node, name: str, args: list[Node]) -> Node:
+        # macro desugaring
+        if len(args) == 2 and name in _ONE_VAR_MACROS and isinstance(args[0], Ident):
+            kind = _ONE_VAR_MACROS[name]
+            return Comprehension(kind=kind, iter_range=target, iter_var=args[0].name, step=args[1])
+        if len(args) == 3 and name == "map" and isinstance(args[0], Ident):
+            # e.map(x, filter, transform)
+            return Comprehension(kind="map", iter_range=target, iter_var=args[0].name, step=args[2], step2=args[1])
+        if len(args) >= 3 and name in _TWO_VAR_MACROS and isinstance(args[0], Ident) and isinstance(args[1], Ident):
+            kind = _TWO_VAR_MACROS[name]
+            if name in ("transformList", "transformMap", "transformMapEntry"):
+                if len(args) == 3:
+                    return Comprehension(kind=kind, iter_range=target, iter_var=args[0].name, iter_var2=args[1].name, step=args[2])
+                if len(args) == 4:
+                    return Comprehension(kind=kind, iter_range=target, iter_var=args[0].name, iter_var2=args[1].name, step=args[3], step2=args[2])
+            elif len(args) == 3:
+                return Comprehension(kind=kind, iter_range=target, iter_var=args[0].name, iter_var2=args[1].name, step=args[2])
+        return Call(name, tuple(args), target=target)
+
+    def arg_list(self, close: str) -> list[Node]:
+        args: list[Node] = []
+        if self.accept_op(close):
+            return args
+        while True:
+            args.append(self.expr())
+            if self.accept_op(","):
+                if self.accept_op(close):  # trailing comma
+                    return args
+                continue
+            self.expect_op(close)
+            return args
+
+    def primary(self) -> Node:
+        t = self.peek()
+        if t.kind == "OP":
+            if t.value == "(":
+                self.next()
+                e = self.expr()
+                self.expect_op(")")
+                return e
+            if t.value == "[":
+                self.next()
+                items = self.arg_list("]")
+                return ListLit(tuple(items))
+            if t.value == "{":
+                self.next()
+                return self.map_lit()
+            if t.value == ".":
+                # leading-dot absolute reference: `.a.b`
+                self.next()
+                t2 = self.next()
+                if t2.kind != "IDENT":
+                    raise CelParseError("expected identifier after leading '.'", t2.pos, self.src)
+                return self.global_or_call(t2.value)
+            raise CelParseError(f"unexpected token {t.value!r}", t.pos, self.src)
+        if t.kind == "INT":
+            self.next()
+            return Lit(self._check_int_lit(t.value, t.pos))
+        if t.kind in ("UINT", "FLOAT", "STRING", "BYTES"):
+            self.next()
+            return Lit(t.value)
+        if t.kind == "IDENT":
+            self.next()
+            name = t.value
+            if name == "true":
+                return Lit(True)
+            if name == "false":
+                return Lit(False)
+            if name == "null":
+                return Lit(None)
+            if name in _RESERVED:
+                raise CelParseError(f"reserved word {name!r}", t.pos, self.src)
+            return self.global_or_call(name)
+        raise CelParseError(f"unexpected token {t.value!r}", t.pos, self.src)
+
+    def global_or_call(self, name: str) -> Node:
+        # qualified function names: cel.bind, math.greatest, base64.encode, ...
+        if self.accept_op("("):
+            args = self.arg_list(")")
+            if name == "has":
+                if len(args) != 1 or not isinstance(args[0], Select):
+                    raise CelParseError("has() requires a field selection argument", self.peek().pos, self.src)
+                sel = args[0]
+                return Present(sel.operand, sel.field)
+            return Call(name, tuple(args))
+        return Ident(name)
+
+    def map_lit(self) -> Node:
+        entries: list[tuple[Node, Node]] = []
+        if self.accept_op("}"):
+            return MapLit(tuple(entries))
+        while True:
+            k = self.expr()
+            self.expect_op(":")
+            v = self.expr()
+            entries.append((k, v))
+            if self.accept_op(","):
+                if self.accept_op("}"):
+                    return MapLit(tuple(entries))
+                continue
+            self.expect_op("}")
+            return MapLit(tuple(entries))
+
+
+def _rewrite_namespaced(node: Node) -> Node:
+    """Turn Select-chains used as namespaced calls into plain Calls.
+
+    The tokenizer produces ``Call(fn='bind', target=Ident('cel'))`` for
+    ``cel.bind(...)`` via member_call; normalize the known namespaces
+    (cel, math, base64, lists, strings) into global function names
+    ``cel.bind``/``math.greatest``/... and desugar cel.bind into Bind.
+    """
+    if isinstance(node, Call) and isinstance(node.target, Ident) and node.target.name in ("cel", "math", "base64", "lists", "strings"):
+        fn = f"{node.target.name}.{node.fn}"
+        args = tuple(_rewrite_namespaced(a) for a in node.args)
+        if fn == "cel.bind":
+            if len(args) == 3 and isinstance(args[0], Ident):
+                return Bind(args[0].name, args[1], args[2])
+            raise CelParseError("cel.bind requires (ident, init, body)")
+        return Call(fn, args)
+    if isinstance(node, Call):
+        return Call(
+            node.fn,
+            tuple(_rewrite_namespaced(a) for a in node.args),
+            target=_rewrite_namespaced(node.target) if node.target is not None else None,
+        )
+    if isinstance(node, Select):
+        return Select(_rewrite_namespaced(node.operand), node.field)
+    if isinstance(node, Present):
+        return Present(_rewrite_namespaced(node.operand), node.field)
+    if isinstance(node, Index):
+        return Index(_rewrite_namespaced(node.operand), _rewrite_namespaced(node.index))
+    if isinstance(node, ListLit):
+        return ListLit(tuple(_rewrite_namespaced(a) for a in node.items))
+    if isinstance(node, MapLit):
+        return MapLit(tuple((_rewrite_namespaced(k), _rewrite_namespaced(v)) for k, v in node.entries))
+    if isinstance(node, Bind):
+        return Bind(node.name, _rewrite_namespaced(node.init), _rewrite_namespaced(node.body))
+    if isinstance(node, Comprehension):
+        return Comprehension(
+            kind=node.kind,
+            iter_range=_rewrite_namespaced(node.iter_range),
+            iter_var=node.iter_var,
+            step=_rewrite_namespaced(node.step),
+            iter_var2=node.iter_var2,
+            step2=_rewrite_namespaced(node.step2) if node.step2 is not None else None,
+        )
+    return node
+
+
+def parse(src: str) -> Node:
+    """Parse a CEL expression into an AST."""
+    return _rewrite_namespaced(_Parser(src).parse())

@@ -1,0 +1,838 @@
+"""CEL standard library + extension functions.
+
+Covers the function surface the reference enables
+(internal/conditions/cel.go:62-74): the CEL standard library, the strings,
+lists, math, encoders and bindings extensions, and cross-type numeric
+comparisons. Functions are strict: a CelError raised by an argument
+evaluation propagates (absorption happens in the interpreter for
+``||``/``&&``/``?:``/comprehensions only).
+"""
+
+from __future__ import annotations
+
+import base64 as _b64
+import datetime as _dt
+import math as _math
+import re as _re
+from typing import Any, Callable
+
+from .errors import CelError, no_such_key, no_such_overload
+from .values import (
+    CelType,
+    Duration,
+    Timestamp,
+    UInt,
+    celtype_name,
+    check_int,
+    check_uint,
+    compare,
+    is_number,
+    keys_equal,
+    values_equal,
+)
+
+Ctx = Any  # interp.Activation; kept as Any to avoid a circular import
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _as_list(v: Any, fn: str) -> list:
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    raise no_such_overload(fn, v)
+
+
+def _as_str(v: Any, fn: str) -> str:
+    if isinstance(v, str):
+        return v
+    raise no_such_overload(fn, v)
+
+
+def _as_int_index(v: Any, fn: str) -> int:
+    if type(v) is bool or not isinstance(v, int):
+        raise no_such_overload(fn, v)
+    return int(v)
+
+
+_TZ_CACHE: dict[str, _dt.tzinfo] = {}
+
+
+def _resolve_tz(name: str) -> _dt.tzinfo:
+    if name in _TZ_CACHE:
+        return _TZ_CACHE[name]
+    tz: _dt.tzinfo
+    if name in ("UTC", "utc", ""):
+        tz = _dt.timezone.utc
+    elif _re.fullmatch(r"[+-]\d\d:\d\d", name):
+        sign = 1 if name[0] == "+" else -1
+        hh, mm = int(name[1:3]), int(name[4:6])
+        tz = _dt.timezone(sign * _dt.timedelta(hours=hh, minutes=mm))
+    else:
+        try:
+            from zoneinfo import ZoneInfo
+
+            tz = ZoneInfo(name)
+        except Exception:
+            raise CelError(f"unknown timezone {name!r}") from None
+    _TZ_CACHE[name] = tz
+    return tz
+
+
+def _ts_in_tz(ts: Timestamp, args: tuple) -> _dt.datetime:
+    if args:
+        return ts.astimezone(_resolve_tz(_as_str(args[0], "timezone")))
+    return ts
+
+
+# ---------------------------------------------------------------------------
+# conversions
+
+
+def _to_int(v: Any) -> int:
+    if type(v) is bool:
+        raise no_such_overload("int", v)
+    if isinstance(v, UInt):
+        return check_int(int(v))
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float):
+        if _math.isnan(v) or _math.isinf(v):
+            raise CelError("integer overflow")
+        # cel-go rejects doubles outside the representable range
+        if not (-9.223372036854776e18 <= v <= 9.223372036854776e18):
+            raise CelError("integer overflow")
+        return check_int(int(v))
+    if isinstance(v, str):
+        try:
+            return check_int(int(v.strip(), 10))
+        except ValueError:
+            raise CelError(f"cannot convert {v!r} to int") from None
+    if isinstance(v, Timestamp):
+        # Go Time.Unix() floors toward negative infinity for pre-epoch times
+        return int(_math.floor(v.timestamp()))
+    if isinstance(v, Duration):
+        us = (v.days * 86_400 + v.seconds) * 1_000_000 + v.microseconds
+        q = abs(us) // 1_000_000
+        return -q if us < 0 else q
+    raise no_such_overload("int", v)
+
+
+def _to_uint(v: Any) -> UInt:
+    if type(v) is bool:
+        raise no_such_overload("uint", v)
+    if isinstance(v, UInt):
+        return v
+    if isinstance(v, int):
+        return check_uint(v)
+    if isinstance(v, float):
+        if _math.isnan(v) or _math.isinf(v) or v < 0 or v > 1.8446744073709552e19:
+            raise CelError("unsigned integer overflow")
+        return check_uint(int(v))
+    if isinstance(v, str):
+        try:
+            return check_uint(int(v.strip(), 10))
+        except ValueError:
+            raise CelError(f"cannot convert {v!r} to uint") from None
+    raise no_such_overload("uint", v)
+
+
+def _to_double(v: Any) -> float:
+    if type(v) is bool:
+        raise no_such_overload("double", v)
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        try:
+            return float(v.strip())
+        except ValueError:
+            raise CelError(f"cannot convert {v!r} to double") from None
+    raise no_such_overload("double", v)
+
+
+def _double_str(f: float) -> str:
+    if f != f:
+        return "NaN"
+    if f == _math.inf:
+        return "+Inf"
+    if f == -_math.inf:
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _to_string(v: Any) -> str:
+    if type(v) is bool:
+        return "true" if v else "false"
+    if isinstance(v, UInt):
+        return str(int(v))
+    if isinstance(v, Timestamp):
+        return v.rfc3339()
+    if isinstance(v, Duration):
+        # cel-go formats durations as seconds with "s" suffix
+        secs = v.total_seconds()
+        if secs == int(secs):
+            return f"{int(secs)}s"
+        return f"{secs}s"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float):
+        return _double_str(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    raise no_such_overload("string", v)
+
+
+def _to_bool(v: Any) -> bool:
+    if type(v) is bool:
+        return v
+    if isinstance(v, str):
+        s = v.lower()
+        if s in ("true", "t", "1"):
+            return True
+        if s in ("false", "f", "0"):
+            return False
+        raise CelError(f"cannot convert {v!r} to bool")
+    raise no_such_overload("bool", v)
+
+
+def _to_bytes(v: Any) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    raise no_such_overload("bytes", v)
+
+
+def _to_timestamp(v: Any) -> Timestamp:
+    if isinstance(v, Timestamp):
+        return v
+    if isinstance(v, str):
+        return Timestamp.parse(v)
+    if type(v) is not bool and isinstance(v, int):
+        return Timestamp.from_datetime(_dt.datetime.fromtimestamp(int(v), _dt.timezone.utc))
+    raise no_such_overload("timestamp", v)
+
+
+def _to_duration(v: Any) -> Duration:
+    if isinstance(v, Duration):
+        return v
+    if isinstance(v, str):
+        return Duration.parse(v)
+    if type(v) is not bool and isinstance(v, int):
+        return Duration(seconds=int(v))
+    raise no_such_overload("duration", v)
+
+
+def _size(v: Any) -> int:
+    if isinstance(v, (str, bytes, list, tuple, dict)):
+        return len(v)
+    raise no_such_overload("size", v)
+
+
+def _type_of(v: Any) -> CelType:
+    return CelType(celtype_name(v))
+
+
+# ---------------------------------------------------------------------------
+# math extension
+
+
+def _math_minmax(fn: str, args: tuple, pick: Callable) -> Any:
+    vals = list(args[0]) if len(args) == 1 and isinstance(args[0], (list, tuple)) else list(args)
+    if not vals:
+        raise CelError(f"{fn}: no arguments")
+    best = vals[0]
+    if not is_number(best) and type(best) is not bool:
+        raise no_such_overload(fn, best)
+    for v in vals[1:]:
+        if not is_number(v) and type(v) is not bool:
+            raise no_such_overload(fn, v)
+        if pick(compare(v, best)):
+            best = v
+    return best
+
+
+def _require_double(v: Any, fn: str) -> float:
+    if isinstance(v, float):
+        return v
+    raise no_such_overload(fn, v)
+
+
+def _require_int(v: Any, fn: str) -> int:
+    if type(v) is bool or not isinstance(v, int) or isinstance(v, UInt):
+        raise no_such_overload(fn, v)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# global functions: name -> fn(args: tuple, ctx) -> value
+
+FUNCTIONS: dict[str, Callable[..., Any]] = {}
+METHODS: dict[str, Callable[..., Any]] = {}
+
+
+def func(name: str):
+    def deco(f):
+        FUNCTIONS[name] = f
+        return f
+
+    return deco
+
+
+def method(name: str):
+    def deco(f):
+        METHODS[name] = f
+        return f
+
+    return deco
+
+
+@func("size")
+def _f_size(args, ctx):
+    return _size(args[0])
+
+
+@func("int")
+def _f_int(args, ctx):
+    return _to_int(args[0])
+
+
+@func("uint")
+def _f_uint(args, ctx):
+    return _to_uint(args[0])
+
+
+@func("double")
+def _f_double(args, ctx):
+    return _to_double(args[0])
+
+
+@func("string")
+def _f_string(args, ctx):
+    return _to_string(args[0])
+
+
+@func("bool")
+def _f_bool(args, ctx):
+    return _to_bool(args[0])
+
+
+@func("bytes")
+def _f_bytes(args, ctx):
+    return _to_bytes(args[0])
+
+
+@func("timestamp")
+def _f_timestamp(args, ctx):
+    return _to_timestamp(args[0])
+
+
+@func("duration")
+def _f_duration(args, ctx):
+    return _to_duration(args[0])
+
+
+@func("dyn")
+def _f_dyn(args, ctx):
+    return args[0]
+
+
+@func("type")
+def _f_type(args, ctx):
+    return _type_of(args[0])
+
+
+@func("matches")
+def _f_matches(args, ctx):
+    return _m_matches(args[0], (args[1],), ctx)
+
+
+@func("math.greatest")
+def _f_greatest(args, ctx):
+    return _math_minmax("math.greatest", args, lambda c: c > 0)
+
+
+@func("math.least")
+def _f_least(args, ctx):
+    return _math_minmax("math.least", args, lambda c: c < 0)
+
+
+@func("math.ceil")
+def _f_ceil(args, ctx):
+    return float(_math.ceil(_require_double(args[0], "math.ceil")))
+
+
+@func("math.floor")
+def _f_floor(args, ctx):
+    return float(_math.floor(_require_double(args[0], "math.floor")))
+
+
+@func("math.round")
+def _f_round(args, ctx):
+    v = _require_double(args[0], "math.round")
+    # round-half-away-from-zero (Go semantics), not banker's rounding
+    return float(_math.floor(v + 0.5) if v >= 0 else _math.ceil(v - 0.5))
+
+
+@func("math.trunc")
+def _f_trunc(args, ctx):
+    return float(_math.trunc(_require_double(args[0], "math.trunc")))
+
+
+@func("math.abs")
+def _f_abs(args, ctx):
+    v = args[0]
+    if isinstance(v, float):
+        return abs(v)
+    if isinstance(v, UInt):
+        return v
+    if type(v) is not bool and isinstance(v, int):
+        return check_int(abs(v))
+    raise no_such_overload("math.abs", v)
+
+
+@func("math.sign")
+def _f_sign(args, ctx):
+    v = args[0]
+    if isinstance(v, float):
+        if _math.isnan(v):
+            return v
+        return float((v > 0) - (v < 0))
+    if isinstance(v, UInt):
+        return UInt(1 if v > 0 else 0)
+    if type(v) is not bool and isinstance(v, int):
+        return (v > 0) - (v < 0)
+    raise no_such_overload("math.sign", v)
+
+
+@func("math.isNaN")
+def _f_isnan(args, ctx):
+    return _math.isnan(_require_double(args[0], "math.isNaN"))
+
+
+@func("math.isInf")
+def _f_isinf(args, ctx):
+    return _math.isinf(_require_double(args[0], "math.isInf"))
+
+
+@func("math.isFinite")
+def _f_isfinite(args, ctx):
+    return _math.isfinite(_require_double(args[0], "math.isFinite"))
+
+
+@func("math.sqrt")
+def _f_sqrt(args, ctx):
+    v = args[0]
+    if type(v) is bool or not isinstance(v, (int, float)):
+        raise no_such_overload("math.sqrt", v)
+    f = float(v)
+    return _math.sqrt(f) if f >= 0 else float("nan")
+
+
+@func("math.bitAnd")
+def _f_bitand(args, ctx):
+    a, b = args
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        return UInt(a & b)
+    return check_int(_require_int(a, "math.bitAnd") & _require_int(b, "math.bitAnd"))
+
+
+@func("math.bitOr")
+def _f_bitor(args, ctx):
+    a, b = args
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        return UInt(a | b)
+    return check_int(_require_int(a, "math.bitOr") | _require_int(b, "math.bitOr"))
+
+
+@func("math.bitXor")
+def _f_bitxor(args, ctx):
+    a, b = args
+    if isinstance(a, UInt) and isinstance(b, UInt):
+        return UInt(a ^ b)
+    return check_int(_require_int(a, "math.bitXor") ^ _require_int(b, "math.bitXor"))
+
+
+@func("math.bitNot")
+def _f_bitnot(args, ctx):
+    v = args[0]
+    if isinstance(v, UInt):
+        return UInt(v ^ (2**64 - 1))
+    return check_int(~_require_int(v, "math.bitNot"))
+
+
+@func("math.bitShiftLeft")
+def _f_bitshl(args, ctx):
+    v, s = args
+    shift = _require_int(s, "math.bitShiftLeft")
+    if shift < 0:
+        raise CelError("math.bitShiftLeft: negative shift")
+    if isinstance(v, UInt):
+        return UInt((int(v) << shift) & (2**64 - 1)) if shift < 64 else UInt(0)
+    iv = _require_int(v, "math.bitShiftLeft")
+    if shift >= 64:
+        return 0
+    r = (iv << shift) & (2**64 - 1)
+    return r - 2**64 if r >= 2**63 else r
+
+
+@func("math.bitShiftRight")
+def _f_bitshr(args, ctx):
+    v, s = args
+    shift = _require_int(s, "math.bitShiftRight")
+    if shift < 0:
+        raise CelError("math.bitShiftRight: negative shift")
+    if isinstance(v, UInt):
+        return UInt(int(v) >> shift) if shift < 64 else UInt(0)
+    iv = _require_int(v, "math.bitShiftRight")
+    if shift >= 64:
+        return 0
+    return (iv & (2**64 - 1)) >> shift  # logical shift on the 2's complement bits
+
+
+@func("base64.encode")
+def _f_b64enc(args, ctx):
+    v = args[0]
+    if not isinstance(v, bytes):
+        raise no_such_overload("base64.encode", v)
+    return _b64.b64encode(v).decode("ascii")
+
+
+@func("base64.decode")
+def _f_b64dec(args, ctx):
+    v = _as_str(args[0], "base64.decode")
+    try:
+        pad = v + "=" * (-len(v) % 4)
+        return _b64.b64decode(pad)
+    except Exception:
+        raise CelError("base64.decode: invalid input") from None
+
+
+@func("strings.quote")
+def _f_quote(args, ctx):
+    s = _as_str(args[0], "strings.quote")
+    out = ['"']
+    for ch in s:
+        if ch == '"':
+            out.append('\\"')
+        elif ch == "\\":
+            out.append("\\\\")
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\r":
+            out.append("\\r")
+        elif ch == "\t":
+            out.append("\\t")
+        elif ch in ("\a", "\b", "\f", "\v"):
+            out.append({"\a": "\\a", "\b": "\\b", "\f": "\\f", "\v": "\\v"}[ch])
+        else:
+            out.append(ch)
+    out.append('"')
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# member methods: name -> fn(target, args: tuple, ctx) -> value
+
+
+@method("contains")
+def _m_contains(t, args, ctx):
+    return _as_str(args[0], "contains") in _as_str(t, "contains")
+
+
+@method("startsWith")
+def _m_startswith(t, args, ctx):
+    return _as_str(t, "startsWith").startswith(_as_str(args[0], "startsWith"))
+
+
+@method("endsWith")
+def _m_endswith(t, args, ctx):
+    return _as_str(t, "endsWith").endswith(_as_str(args[0], "endsWith"))
+
+
+_RE_CACHE: dict[str, _re.Pattern] = {}
+
+
+def _compile_re(pat: str) -> _re.Pattern:
+    rx = _RE_CACHE.get(pat)
+    if rx is None:
+        try:
+            rx = _re.compile(pat)
+        except _re.error as e:
+            raise CelError(f"invalid regex {pat!r}: {e}") from None
+        _RE_CACHE[pat] = rx
+    return rx
+
+
+@method("matches")
+def _m_matches(t, args, ctx):
+    return bool(_compile_re(_as_str(args[0], "matches")).search(_as_str(t, "matches")))
+
+
+@method("size")
+def _m_size(t, args, ctx):
+    return _size(t)
+
+
+@method("charAt")
+def _m_charat(t, args, ctx):
+    s = _as_str(t, "charAt")
+    i = _as_int_index(args[0], "charAt")
+    if i == len(s):
+        return ""
+    if not 0 <= i < len(s):
+        raise CelError(f"charAt: index out of range: {i}")
+    return s[i]
+
+
+@method("indexOf")
+def _m_indexof(t, args, ctx):
+    s = _as_str(t, "indexOf")
+    sub = _as_str(args[0], "indexOf")
+    start = _as_int_index(args[1], "indexOf") if len(args) > 1 else 0
+    if start < 0 or start > len(s):
+        raise CelError(f"indexOf: index out of range: {start}")
+    return s.find(sub, start)
+
+
+@method("lastIndexOf")
+def _m_lastindexof(t, args, ctx):
+    s = _as_str(t, "lastIndexOf")
+    sub = _as_str(args[0], "lastIndexOf")
+    end = _as_int_index(args[1], "lastIndexOf") if len(args) > 1 else len(s)
+    if end < 0 or end > len(s):
+        raise CelError(f"lastIndexOf: index out of range: {end}")
+    if len(args) > 1:
+        # offset marks the start position for the backwards search in cel-go
+        return s.rfind(sub, 0, end + max(len(sub), 1))
+    return s.rfind(sub)
+
+
+@method("join")
+def _m_join(t, args, ctx):
+    items = _as_list(t, "join")
+    sep = _as_str(args[0], "join") if args else ""
+    parts = []
+    for it in items:
+        if not isinstance(it, str):
+            raise no_such_overload("join", it)
+        parts.append(it)
+    return sep.join(parts)
+
+
+@method("lowerAscii")
+def _m_lowerascii(t, args, ctx):
+    return "".join(c.lower() if "A" <= c <= "Z" else c for c in _as_str(t, "lowerAscii"))
+
+
+@method("upperAscii")
+def _m_upperascii(t, args, ctx):
+    return "".join(c.upper() if "a" <= c <= "z" else c for c in _as_str(t, "upperAscii"))
+
+
+@method("replace")
+def _m_replace(t, args, ctx):
+    s = _as_str(t, "replace")
+    old = _as_str(args[0], "replace")
+    new = _as_str(args[1], "replace")
+    limit = _as_int_index(args[2], "replace") if len(args) > 2 else -1
+    if limit < 0:
+        return s.replace(old, new)
+    return s.replace(old, new, limit)
+
+
+@method("split")
+def _m_split(t, args, ctx):
+    s = _as_str(t, "split")
+    sep = _as_str(args[0], "split")
+    limit = _as_int_index(args[1], "split") if len(args) > 1 else -1
+    if limit == 0:
+        return []
+    if sep == "":
+        chars = list(s)
+        if limit > 0:
+            return chars[: limit - 1] + (["".join(chars[limit - 1 :])] if len(chars) >= limit else [])
+        return chars
+    if limit > 0:
+        return s.split(sep, limit - 1)
+    return s.split(sep)
+
+
+@method("substring")
+def _m_substring(t, args, ctx):
+    s = _as_str(t, "substring")
+    start = _as_int_index(args[0], "substring")
+    end = _as_int_index(args[1], "substring") if len(args) > 1 else len(s)
+    if start < 0 or end < 0 or start > len(s) or end > len(s) or start > end:
+        raise CelError(f"substring: invalid range [{start}:{end}]")
+    return s[start:end]
+
+
+@method("trim")
+def _m_trim(t, args, ctx):
+    return _as_str(t, "trim").strip()
+
+
+@method("reverse")
+def _m_reverse(t, args, ctx):
+    if isinstance(t, str):
+        return t[::-1]
+    if isinstance(t, (list, tuple)):
+        return list(t)[::-1]
+    raise no_such_overload("reverse", t)
+
+
+@method("flatten")
+def _m_flatten(t, args, ctx):
+    items = _as_list(t, "flatten")
+    depth = _as_int_index(args[0], "flatten") if args else 1
+    if depth < 0:
+        raise CelError("flatten: negative depth")
+
+    def fl(xs: list, d: int) -> list:
+        out = []
+        for x in xs:
+            if isinstance(x, (list, tuple)) and d > 0:
+                out.extend(fl(list(x), d - 1))
+            else:
+                out.append(x)
+        return out
+
+    return fl(items, depth)
+
+
+@method("slice")
+def _m_slice(t, args, ctx):
+    items = _as_list(t, "slice")
+    start = _as_int_index(args[0], "slice")
+    end = _as_int_index(args[1], "slice")
+    if start < 0 or end < 0 or start > len(items) or end > len(items) or start > end:
+        raise CelError(f"slice: invalid range [{start}:{end}]")
+    return items[start:end]
+
+
+@method("distinct")
+def _m_distinct(t, args, ctx):
+    items = _as_list(t, "distinct")
+    out: list = []
+    for x in items:
+        if not any(values_equal(x, y) for y in out):
+            out.append(x)
+    return out
+
+
+@method("sort")
+def _m_sort(t, args, ctx):
+    items = _as_list(t, "sort")
+    if not items:
+        return []
+    import functools
+
+    try:
+        return sorted(items, key=functools.cmp_to_key(compare))
+    except CelError:
+        raise
+    except Exception:
+        raise CelError("sort: list is not comparable") from None
+
+
+# --- timestamp / duration accessors ---
+
+
+def _dur_or_ts(t, fn):
+    if isinstance(t, (Timestamp, Duration)):
+        return t
+    raise no_such_overload(fn, t)
+
+
+@method("getFullYear")
+def _m_getfullyear(t, args, ctx):
+    if not isinstance(t, Timestamp):
+        raise no_such_overload("getFullYear", t)
+    return _ts_in_tz(t, args).year
+
+
+@method("getMonth")
+def _m_getmonth(t, args, ctx):
+    if not isinstance(t, Timestamp):
+        raise no_such_overload("getMonth", t)
+    return _ts_in_tz(t, args).month - 1
+
+
+@method("getDayOfYear")
+def _m_getdayofyear(t, args, ctx):
+    if not isinstance(t, Timestamp):
+        raise no_such_overload("getDayOfYear", t)
+    return _ts_in_tz(t, args).timetuple().tm_yday - 1
+
+
+@method("getDayOfMonth")
+def _m_getdayofmonth(t, args, ctx):
+    if not isinstance(t, Timestamp):
+        raise no_such_overload("getDayOfMonth", t)
+    return _ts_in_tz(t, args).day - 1
+
+
+@method("getDate")
+def _m_getdate(t, args, ctx):
+    if not isinstance(t, Timestamp):
+        raise no_such_overload("getDate", t)
+    return _ts_in_tz(t, args).day
+
+
+@method("getDayOfWeek")
+def _m_getdayofweek(t, args, ctx):
+    if not isinstance(t, Timestamp):
+        raise no_such_overload("getDayOfWeek", t)
+    return (_ts_in_tz(t, args).weekday() + 1) % 7  # Sunday == 0
+
+
+def _dur_us(v: Duration) -> int:
+    """Total microseconds, exact (timedelta normalizes fields; reconstruct)."""
+    return (v.days * 86_400 + v.seconds) * 1_000_000 + v.microseconds
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """Go-style division truncated toward zero (floor division differs for negatives)."""
+    q = abs(a) // b
+    return -q if a < 0 else q
+
+
+@method("getHours")
+def _m_gethours(t, args, ctx):
+    v = _dur_or_ts(t, "getHours")
+    if isinstance(v, Duration):
+        return _trunc_div(_dur_us(v), 3_600_000_000)
+    return _ts_in_tz(v, args).hour
+
+
+@method("getMinutes")
+def _m_getminutes(t, args, ctx):
+    v = _dur_or_ts(t, "getMinutes")
+    if isinstance(v, Duration):
+        return _trunc_div(_dur_us(v), 60_000_000)
+    return _ts_in_tz(v, args).minute
+
+
+@method("getSeconds")
+def _m_getseconds(t, args, ctx):
+    v = _dur_or_ts(t, "getSeconds")
+    if isinstance(v, Duration):
+        return _trunc_div(_dur_us(v), 1_000_000)
+    return _ts_in_tz(v, args).second
+
+
+@method("getMilliseconds")
+def _m_getmillis(t, args, ctx):
+    v = _dur_or_ts(t, "getMilliseconds")
+    if isinstance(v, Duration):
+        # Go remainder semantics: sign follows the dividend
+        us = _dur_us(v)
+        r = _trunc_div(us, 1_000) - _trunc_div(us, 1_000_000) * 1000
+        return r
+    return _ts_in_tz(v, args).microsecond // 1000

@@ -1,0 +1,288 @@
+"""CEL value model: wrappers, typing, equality, ordering, arithmetic.
+
+CEL types map onto Python as: int->int, uint->UInt, double->float, bool->bool,
+string->str, bytes->bytes, list->list, map->dict, null->None,
+timestamp->Timestamp (tz-aware datetime), duration->Duration (timedelta).
+64-bit overflow raises CelError, matching cel-go runtime semantics.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import math
+from typing import Any
+
+from .errors import CelError, no_such_overload
+
+INT_MIN = -(2**63)
+INT_MAX = 2**63 - 1
+UINT_MAX = 2**64 - 1
+
+
+class UInt(int):
+    """CEL uint. Subclasses int so hashing/dict keys work naturally."""
+
+    __slots__ = ()
+
+    def __new__(cls, v: int):
+        if not 0 <= v <= UINT_MAX:
+            raise CelError("unsigned integer overflow")
+        return super().__new__(cls, v)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{int(self)}u"
+
+
+class Timestamp(_dt.datetime):
+    """CEL timestamp: a tz-aware datetime pinned to UTC internally."""
+
+    __slots__ = ()
+
+    @classmethod
+    def from_datetime(cls, dt: _dt.datetime) -> "Timestamp":
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=_dt.timezone.utc)
+        dt = dt.astimezone(_dt.timezone.utc)
+        return cls(
+            dt.year, dt.month, dt.day, dt.hour, dt.minute, dt.second,
+            dt.microsecond, tzinfo=_dt.timezone.utc,
+        )
+
+    @classmethod
+    def parse(cls, s: str) -> "Timestamp":
+        txt = s.strip()
+        if txt.endswith(("z", "Z")):
+            txt = txt[:-1] + "+00:00"
+        try:
+            # RFC3339 with fractional seconds of any precision
+            dt = _dt.datetime.fromisoformat(txt)
+        except ValueError:
+            raise CelError(f"invalid timestamp {s!r}") from None
+        if dt.tzinfo is None:
+            raise CelError(f"invalid timestamp {s!r}: missing timezone")
+        return cls.from_datetime(dt)
+
+    def rfc3339(self) -> str:
+        us = self.microsecond
+        base = self.strftime("%Y-%m-%dT%H:%M:%S")
+        if us:
+            frac = f"{us:06d}".rstrip("0")
+            # pad to multiple of 3 digits, matching protobuf JSON formatting
+            pad = (3 - len(frac) % 3) % 3
+            base += "." + frac + "0" * pad
+        return base + "Z"
+
+
+class Duration(_dt.timedelta):
+    """CEL duration (microsecond resolution)."""
+
+    __slots__ = ()
+
+    _UNITS = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
+
+    @classmethod
+    def from_timedelta(cls, td: _dt.timedelta) -> "Duration":
+        return cls(days=td.days, seconds=td.seconds, microseconds=td.microseconds)
+
+    @classmethod
+    def parse(cls, s: str) -> "Duration":
+        # Go duration syntax: [-+]?([0-9]*(\.[0-9]*)?(ns|us|µs|ms|s|m|h))+ or "0"
+        txt = s.strip()
+        if txt in ("0", "+0", "-0"):
+            return cls(0)
+        neg = False
+        if txt and txt[0] in "+-":
+            neg = txt[0] == "-"
+            txt = txt[1:]
+        if not txt:
+            raise CelError(f"invalid duration {s!r}")
+        total = 0.0
+        i, n = 0, len(txt)
+        while i < n:
+            j = i
+            while j < n and (txt[j].isdigit() or txt[j] == "."):
+                j += 1
+            if j == i:
+                raise CelError(f"invalid duration {s!r}")
+            try:
+                num = float(txt[i:j])
+            except ValueError:
+                raise CelError(f"invalid duration {s!r}") from None
+            k = j
+            while k < n and not (txt[k].isdigit() or txt[k] == "."):
+                k += 1
+            unit = txt[j:k].replace("µs", "us")
+            if unit not in cls._UNITS:
+                raise CelError(f"invalid duration {s!r}: unknown unit {unit!r}")
+            total += num * cls._UNITS[unit]
+            i = k
+        if neg:
+            total = -total
+        return cls(seconds=total)
+
+    def go_string(self) -> str:
+        """Format like Go's time.Duration.String()."""
+        total_us = self.days * 86_400_000_000 + self.seconds * 1_000_000 + self.microseconds
+        if total_us == 0:
+            return "0s"
+        neg = total_us < 0
+        us = abs(total_us)
+        out = ""
+        h, rem = divmod(us, 3_600_000_000)
+        m, rem = divmod(rem, 60_000_000)
+        secs = rem / 1_000_000
+        if h:
+            out += f"{h}h"
+        if m:
+            out += f"{m}m"
+        if secs or not out:
+            s_txt = f"{secs:.6f}".rstrip("0").rstrip(".")
+            out += f"{s_txt}s"
+        return ("-" if neg else "") + out
+
+    def total_seconds_float(self) -> float:
+        return self.total_seconds()
+
+
+def celtype_name(v: Any) -> str:
+    if v is None:
+        return "null_type"
+    t = type(v)
+    if t is bool:
+        return "bool"
+    if t is UInt:
+        return "uint"
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, UInt):
+        return "uint"
+    if isinstance(v, Timestamp):
+        return "google.protobuf.Timestamp"
+    if isinstance(v, Duration):
+        return "google.protobuf.Duration"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, float):
+        return "double"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, bytes):
+        return "bytes"
+    if isinstance(v, (list, tuple)):
+        return "list"
+    if isinstance(v, dict):
+        return "map"
+    if callable(getattr(v, "cel_type_name", None)):
+        return v.cel_type_name()
+    return t.__name__
+
+
+class CelType:
+    """A CEL type value (result of type(x))."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, CelType) and other.name == self.name
+
+    def __hash__(self) -> int:
+        return hash(("CelType", self.name))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return self.name
+
+    def cel_type_name(self) -> str:
+        return "type"
+
+
+def is_number(v: Any) -> bool:
+    return not isinstance(v, bool) and isinstance(v, (int, float)) and not isinstance(v, (Timestamp, Duration))
+
+
+def check_int(v: int) -> int:
+    if not INT_MIN <= v <= INT_MAX:
+        raise CelError("integer overflow")
+    return v
+
+
+def check_uint(v: int) -> UInt:
+    if not 0 <= v <= UINT_MAX:
+        raise CelError("unsigned integer overflow")
+    return UInt(v)
+
+
+def values_equal(a: Any, b: Any) -> bool:
+    """CEL equality: cross-type numeric, deep for lists/maps, False on type mismatch."""
+    if type(a) is bool or type(b) is bool:
+        return type(a) is bool and type(b) is bool and a == b
+    if a is None or b is None:
+        return a is None and b is None
+    if is_number(a) and is_number(b):
+        if isinstance(a, float) and math.isnan(a):
+            return False
+        if isinstance(b, float) and math.isnan(b):
+            return False
+        return a == b
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    if isinstance(a, bytes) and isinstance(b, bytes):
+        return a == b
+    if isinstance(a, Timestamp) and isinstance(b, Timestamp):
+        return a == b
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return a == b
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        if len(a) != len(b):
+            return False
+        return all(values_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        if len(a) != len(b):
+            return False
+        for k, v in a.items():
+            found = False
+            for k2, v2 in b.items():
+                if keys_equal(k, k2):
+                    found = values_equal(v, v2)
+                    break
+            if not found:
+                return False
+        return True
+    if isinstance(a, CelType) and isinstance(b, CelType):
+        return a == b
+    eq = getattr(a, "cel_equals", None)
+    if eq is not None:
+        return bool(eq(b))
+    return False
+
+
+def keys_equal(a: Any, b: Any) -> bool:
+    if type(a) is bool or type(b) is bool:
+        return type(a) is bool and type(b) is bool and a == b
+    if is_number(a) and is_number(b):
+        return a == b
+    return type(a) is type(b) and a == b
+
+
+def compare(a: Any, b: Any) -> int:
+    """Three-way compare; raises CelError for unorderable pairs."""
+    if type(a) is bool and type(b) is bool:
+        return (a > b) - (a < b)
+    if is_number(a) and is_number(b):
+        af, bf = a, b
+        if isinstance(af, float) and math.isnan(af):
+            raise CelError("NaN is not ordered")
+        if isinstance(bf, float) and math.isnan(bf):
+            raise CelError("NaN is not ordered")
+        return (af > bf) - (af < bf)
+    if isinstance(a, str) and isinstance(b, str):
+        return (a > b) - (a < b)
+    if isinstance(a, bytes) and isinstance(b, bytes):
+        return (a > b) - (a < b)
+    if isinstance(a, Timestamp) and isinstance(b, Timestamp):
+        return (a > b) - (a < b)
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return (a > b) - (a < b)
+    raise no_such_overload("compare", a, b)

@@ -1,0 +1,135 @@
+"""Glob matching with gobwas/glob semantics and ``:`` as the separator.
+
+Behavioral reference: internal/util/globs_common.go (separator ``:``, bare
+``*`` promoted to ``**``) and the gobwas/glob syntax: ``*`` matches within a
+separator segment, ``**`` crosses separators, ``?`` one non-separator char,
+``[...]``/``[!...]`` char classes, ``{a,b}`` alternates, ``\\`` escapes.
+Compiled patterns are cached.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+SEPARATOR = ":"
+
+
+def _translate(pat: str) -> str:
+    out: list[str] = []
+    i, n = 0, len(pat)
+    sep = re.escape(SEPARATOR)
+    while i < n:
+        c = pat[i]
+        if c == "*":
+            if i + 1 < n and pat[i + 1] == "*":
+                out.append(".*")
+                i += 2
+            else:
+                out.append(f"[^{sep}]*")
+                i += 1
+        elif c == "?":
+            out.append(f"[^{sep}]")
+            i += 1
+        elif c == "[":
+            j = i + 1
+            neg = j < n and pat[j] == "!"
+            if neg:
+                j += 1
+            # a ']' immediately after '[' or '[!' is a literal member
+            k = j
+            if k < n and pat[k] == "]":
+                k += 1
+            while k < n and pat[k] != "]":
+                k += 1
+            if k >= n:  # unterminated class: treat '[' literally
+                out.append(re.escape(c))
+                i += 1
+                continue
+            body = pat[j:k].replace("\\", "\\\\").replace("^", "\\^")
+            out.append(f"[{'^' if neg else ''}{body}]")
+            i = k + 1
+        elif c == "{":
+            # find matching close brace (no nesting of braces inside alternates
+            # beyond simple patterns; gobwas allows nested sub-patterns)
+            depth, k = 1, i + 1
+            while k < n and depth:
+                if pat[k] == "{":
+                    depth += 1
+                elif pat[k] == "}":
+                    depth -= 1
+                elif pat[k] == "\\":
+                    k += 1
+                k += 1
+            if depth:  # unterminated: literal
+                out.append(re.escape(c))
+                i += 1
+                continue
+            inner = pat[i + 1 : k - 1]
+            # split on top-level commas
+            alts, buf, d = [], [], 0
+            m = 0
+            while m < len(inner):
+                ch = inner[m]
+                if ch == "\\" and m + 1 < len(inner):
+                    buf.append(inner[m : m + 2])
+                    m += 2
+                    continue
+                if ch in "{[":
+                    d += 1
+                elif ch in "}]":
+                    d -= 1
+                if ch == "," and d == 0:
+                    alts.append("".join(buf))
+                    buf = []
+                else:
+                    buf.append(ch)
+                m += 1
+            alts.append("".join(buf))
+            out.append("(?:" + "|".join(_translate_inner(a) for a in alts) + ")")
+            i = k
+        elif c == "\\" and i + 1 < n:
+            out.append(re.escape(pat[i + 1]))
+            i += 2
+        else:
+            out.append(re.escape(c))
+            i += 1
+    return "".join(out)
+
+
+def _translate_inner(pat: str) -> str:
+    return _translate(pat)
+
+
+@functools.lru_cache(maxsize=4096)
+def compile_glob(pat: str) -> re.Pattern | None:
+    # backward compat: bare "*" means "**" (ref: globs_common.go fixGlob)
+    if pat == "*":
+        pat = "**"
+    try:
+        return re.compile("(?s)^" + _translate(pat) + "$")
+    except re.error:
+        return None
+
+
+def matches_glob(pat: str, val: str) -> bool:
+    rx = compile_glob(pat)
+    return bool(rx and rx.match(val))
+
+
+def is_glob(pat: str) -> bool:
+    """True if the pattern contains glob metacharacters (needs runtime matching)."""
+    i, n = 0, len(pat)
+    while i < n:
+        c = pat[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c in "*?[{":
+            return True
+        i += 1
+    return False
+
+
+def filter_glob(pat: str, values: list[str]) -> list[str]:
+    return [v for v in values if matches_glob(pat, v)]

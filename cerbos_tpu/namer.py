@@ -1,0 +1,109 @@
+"""Fully-qualified policy names, module IDs and scope chains.
+
+Behavioral reference: internal/namer/namer.go (FQN scheme
+``cerbos.<kind>.<name>.v<version>/<scope>``, name sanitization rules, scope
+parent iteration). Module IDs are stable 64-bit hashes of FQNs; the exact hash
+function is an internal detail in the reference (xxhash) and here (blake2b-8).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Iterator
+
+DERIVED_ROLES_PREFIX = "cerbos.derived_roles"
+EXPORT_CONSTANTS_PREFIX = "cerbos.export_constants"
+EXPORT_VARIABLES_PREFIX = "cerbos.export_variables"
+PRINCIPAL_POLICIES_PREFIX = "cerbos.principal"
+RESOURCE_POLICIES_PREFIX = "cerbos.resource"
+ROLE_POLICIES_PREFIX = "cerbos.role"
+
+DEFAULT_VERSION = "default"
+DEFAULT_SCOPE = ""
+_FQN_PREFIX = "cerbos."
+
+# Naming pattern imposed on resource/principal names before Cerbos 0.30
+# (ref: namer.go:20-21). Names matching it are sanitized for module-ID
+# backward compatibility.
+_OLD_NAME_PATTERN = re.compile(r"^[A-Za-z][\w@.\-/]*(:[A-Za-z][\w@.\-/]*)*$")
+_INVALID_IDENT_CHARS = re.compile(r"[^\w.]+")
+
+
+def sanitize(v: str) -> str:
+    if _OLD_NAME_PATTERN.match(v):
+        return _INVALID_IDENT_CHARS.sub("_", v)
+    return v
+
+
+def module_id(fqn: str) -> int:
+    """Stable 64-bit module ID for an FQN."""
+    return int.from_bytes(hashlib.blake2b(fqn.encode(), digest_size=8).digest(), "big")
+
+
+def _with_scope(fqn: str, scope: str) -> str:
+    return fqn if scope == "" else f"{fqn}/{scope}"
+
+
+def resource_policy_fqn(resource: str, version: str, scope: str = "") -> str:
+    return _with_scope(f"{RESOURCE_POLICIES_PREFIX}.{sanitize(resource)}.v{sanitize(version)}", scope)
+
+
+def principal_policy_fqn(principal: str, version: str, scope: str = "") -> str:
+    return _with_scope(f"{PRINCIPAL_POLICIES_PREFIX}.{sanitize(principal)}.v{sanitize(version)}", scope)
+
+
+def role_policy_fqn(role: str, version: str, scope: str = "") -> str:
+    version = version or DEFAULT_VERSION
+    return _with_scope(f"{ROLE_POLICIES_PREFIX}.{sanitize(role)}.v{sanitize(version)}", scope)
+
+
+def derived_roles_fqn(name: str) -> str:
+    return f"{DERIVED_ROLES_PREFIX}.{sanitize(name)}"
+
+
+def export_constants_fqn(name: str) -> str:
+    return f"{EXPORT_CONSTANTS_PREFIX}.{sanitize(name)}"
+
+
+def export_variables_fqn(name: str) -> str:
+    return f"{EXPORT_VARIABLES_PREFIX}.{sanitize(name)}"
+
+
+def policy_key_from_fqn(fqn: str) -> str:
+    return fqn[len(_FQN_PREFIX):] if fqn.startswith(_FQN_PREFIX) else fqn
+
+
+def fqn_from_policy_key(key: str) -> str:
+    return _FQN_PREFIX + key
+
+
+def scope_from_fqn(fqn: str) -> str:
+    _, sep, scope = fqn.partition("/")
+    return scope if sep else ""
+
+
+def scope_parents(scope: str) -> Iterator[str]:
+    """Yield ancestor scopes, most specific first, ending with the root ``""``.
+
+    ``a.b.c`` -> ``a.b``, ``a``, ``""`` (ref: namer.go ScopeParents).
+    """
+    for i in range(len(scope) - 1, -1, -1):
+        if scope[i] == ".":
+            yield scope[:i]
+        elif i == 0:
+            yield ""
+
+
+def scope_chain(scope: str) -> list[str]:
+    """The scope and all its ancestors, most specific first."""
+    return [scope, *scope_parents(scope)] if scope else [""]
+
+
+def scope_value(scope: str) -> str:
+    return scope[1:] if scope.startswith(".") else scope
+
+
+def rule_fqn(policy_fqn_noscope_kind: str, scope: str, rule_name: str) -> str:
+    """`<policy key>#<rule name>` for output `src` fields."""
+    return f"{policy_key_from_fqn(_with_scope(policy_fqn_noscope_kind, scope))}#{rule_name}"

@@ -1,0 +1,263 @@
+"""Typed policy IR.
+
+Behavioral reference: api/public/cerbos/policy/v1/policy.proto (message shapes)
+and internal/policy/policy.go (wrapper/kind/dependency helpers). This is a
+plain-dataclass rendering of the same model; YAML field names (camelCase) are
+handled by the parser.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .. import namer
+
+EFFECT_ALLOW = "EFFECT_ALLOW"
+EFFECT_DENY = "EFFECT_DENY"
+
+SCOPE_PERMISSIONS_UNSPECIFIED = "SCOPE_PERMISSIONS_UNSPECIFIED"
+SCOPE_PERMISSIONS_OVERRIDE_PARENT = "SCOPE_PERMISSIONS_OVERRIDE_PARENT"
+SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT = (
+    "SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT_FOR_ALLOWS"
+)
+
+KIND_RESOURCE = "RESOURCE"
+KIND_PRINCIPAL = "PRINCIPAL"
+KIND_DERIVED_ROLES = "DERIVED_ROLES"
+KIND_EXPORT_VARIABLES = "EXPORT_VARIABLES"
+KIND_EXPORT_CONSTANTS = "EXPORT_CONSTANTS"
+KIND_ROLE_POLICY = "ROLE_POLICY"
+
+
+@dataclass
+class Match:
+    """A condition matcher: exactly one of expr/all/any/none is set."""
+
+    expr: Optional[str] = None
+    all: Optional[list["Match"]] = None
+    any: Optional[list["Match"]] = None
+    none: Optional[list["Match"]] = None
+
+
+@dataclass
+class Condition:
+    match: Optional[Match] = None
+    script: Optional[str] = None  # deprecated in the reference; parsed, rejected at compile
+
+
+@dataclass
+class OutputWhen:
+    rule_activated: Optional[str] = None
+    condition_not_met: Optional[str] = None
+
+
+@dataclass
+class Output:
+    expr: Optional[str] = None  # deprecated alias for when.rule_activated
+    when: Optional[OutputWhen] = None
+
+
+@dataclass
+class Variables:
+    import_: list[str] = field(default_factory=list)
+    local: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Constants:
+    import_: list[str] = field(default_factory=list)
+    local: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SchemaRef:
+    ref: str = ""
+    ignore_when_actions: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Schemas:
+    principal_schema: Optional[SchemaRef] = None
+    resource_schema: Optional[SchemaRef] = None
+
+
+@dataclass
+class ResourceRule:
+    actions: list[str]
+    effect: str
+    roles: list[str] = field(default_factory=list)
+    derived_roles: list[str] = field(default_factory=list)
+    condition: Optional[Condition] = None
+    name: str = ""
+    output: Optional[Output] = None
+
+
+@dataclass
+class ResourcePolicy:
+    resource: str
+    version: str
+    rules: list[ResourceRule] = field(default_factory=list)
+    import_derived_roles: list[str] = field(default_factory=list)
+    scope: str = ""
+    schemas: Optional[Schemas] = None
+    variables: Optional[Variables] = None
+    constants: Optional[Constants] = None
+    scope_permissions: str = SCOPE_PERMISSIONS_UNSPECIFIED
+
+
+@dataclass
+class PrincipalRuleAction:
+    action: str
+    effect: str
+    condition: Optional[Condition] = None
+    name: str = ""
+    output: Optional[Output] = None
+
+
+@dataclass
+class PrincipalRule:
+    resource: str
+    actions: list[PrincipalRuleAction]
+
+
+@dataclass
+class PrincipalPolicy:
+    principal: str
+    version: str
+    rules: list[PrincipalRule] = field(default_factory=list)
+    scope: str = ""
+    variables: Optional[Variables] = None
+    constants: Optional[Constants] = None
+    scope_permissions: str = SCOPE_PERMISSIONS_UNSPECIFIED
+
+
+@dataclass
+class RoleRule:
+    resource: str
+    allow_actions: list[str]
+    condition: Optional[Condition] = None
+    name: str = ""
+    output: Optional[Output] = None
+
+
+@dataclass
+class RolePolicy:
+    role: str
+    version: str = ""
+    scope: str = ""
+    parent_roles: list[str] = field(default_factory=list)
+    rules: list[RoleRule] = field(default_factory=list)
+    scope_permissions: str = SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT
+    variables: Optional[Variables] = None
+    constants: Optional[Constants] = None
+
+
+@dataclass
+class RoleDef:
+    name: str
+    parent_roles: list[str]
+    condition: Optional[Condition] = None
+
+
+@dataclass
+class DerivedRoles:
+    name: str
+    definitions: list[RoleDef]
+    variables: Optional[Variables] = None
+    constants: Optional[Constants] = None
+
+
+@dataclass
+class ExportVariables:
+    name: str
+    definitions: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ExportConstants:
+    name: str
+    definitions: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Metadata:
+    source_file: str = ""
+    annotations: dict[str, str] = field(default_factory=dict)
+    hash: Optional[int] = None
+    store_identifier: str = ""
+    source_attributes: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Policy:
+    api_version: str = "api.cerbos.dev/v1"
+    disabled: bool = False
+    description: str = ""
+    metadata: Optional[Metadata] = None
+    resource_policy: Optional[ResourcePolicy] = None
+    principal_policy: Optional[PrincipalPolicy] = None
+    derived_roles: Optional[DerivedRoles] = None
+    export_variables: Optional[ExportVariables] = None
+    export_constants: Optional[ExportConstants] = None
+    role_policy: Optional[RolePolicy] = None
+    # deprecated top-level variables map (policy.proto:52)
+    variables: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def kind(self) -> str:
+        if self.resource_policy is not None:
+            return KIND_RESOURCE
+        if self.principal_policy is not None:
+            return KIND_PRINCIPAL
+        if self.derived_roles is not None:
+            return KIND_DERIVED_ROLES
+        if self.export_variables is not None:
+            return KIND_EXPORT_VARIABLES
+        if self.export_constants is not None:
+            return KIND_EXPORT_CONSTANTS
+        if self.role_policy is not None:
+            return KIND_ROLE_POLICY
+        raise ValueError("policy has no policy_type set")
+
+    def fqn(self) -> str:
+        if self.resource_policy is not None:
+            rp = self.resource_policy
+            return namer.resource_policy_fqn(rp.resource, rp.version, namer.scope_value(rp.scope))
+        if self.principal_policy is not None:
+            pp = self.principal_policy
+            return namer.principal_policy_fqn(pp.principal, pp.version, namer.scope_value(pp.scope))
+        if self.derived_roles is not None:
+            return namer.derived_roles_fqn(self.derived_roles.name)
+        if self.export_variables is not None:
+            return namer.export_variables_fqn(self.export_variables.name)
+        if self.export_constants is not None:
+            return namer.export_constants_fqn(self.export_constants.name)
+        if self.role_policy is not None:
+            rp2 = self.role_policy
+            return namer.role_policy_fqn(rp2.role, rp2.version, namer.scope_value(rp2.scope))
+        raise ValueError("policy has no policy_type set")
+
+    def module_id(self) -> int:
+        return namer.module_id(self.fqn())
+
+    def dependencies(self) -> list[str]:
+        """FQNs of policies this one imports (derived roles, exported vars/constants)."""
+        deps: list[str] = []
+
+        def add_var_const(v: Optional[Variables], c: Optional[Constants]) -> None:
+            if v:
+                deps.extend(namer.export_variables_fqn(n) for n in v.import_)
+            if c:
+                deps.extend(namer.export_constants_fqn(n) for n in c.import_)
+
+        if self.resource_policy is not None:
+            deps.extend(namer.derived_roles_fqn(n) for n in self.resource_policy.import_derived_roles)
+            add_var_const(self.resource_policy.variables, self.resource_policy.constants)
+        elif self.principal_policy is not None:
+            add_var_const(self.principal_policy.variables, self.principal_policy.constants)
+        elif self.derived_roles is not None:
+            add_var_const(self.derived_roles.variables, self.derived_roles.constants)
+        elif self.role_policy is not None:
+            add_var_const(self.role_policy.variables, self.role_policy.constants)
+        return deps

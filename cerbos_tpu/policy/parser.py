@@ -1,0 +1,400 @@
+"""YAML/JSON → policy IR with validation.
+
+Behavioral reference: internal/parser/parser.go (YAML to proto with
+validation). CamelCase YAML field names are mapped onto the snake_case IR;
+unknown fields and structural mistakes raise :class:`ParseError` with the
+offending path.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Iterator, Optional
+
+import yaml
+
+from . import model
+
+API_VERSION = "api.cerbos.dev/v1"
+
+
+class ParseError(ValueError):
+    def __init__(self, msg: str, path: str = "", source: str = ""):
+        self.path = path
+        self.source = source
+        loc = f" at {path}" if path else ""
+        src = f" in {source}" if source else ""
+        super().__init__(f"{msg}{loc}{src}")
+
+
+def _expect_map(v: Any, path: str) -> dict:
+    if not isinstance(v, dict):
+        raise ParseError(f"expected a mapping, got {type(v).__name__}", path)
+    return v
+
+
+def _check_keys(m: dict, allowed: set[str], path: str) -> None:
+    """Reject unknown fields: a typo'd key (e.g. ``conditon``) must fail
+    loudly rather than silently weaken a policy (the reference rejects unknown
+    fields by default, parser.go)."""
+    unknown = [k for k in m if k not in allowed]
+    if unknown:
+        raise ParseError(f"unknown field(s): {', '.join(sorted(map(str, unknown)))}", path)
+
+
+def _expect_str(v: Any, path: str) -> str:
+    if not isinstance(v, str):
+        raise ParseError(f"expected a string, got {type(v).__name__}", path)
+    return v
+
+
+def _expect_str_list(v: Any, path: str) -> list[str]:
+    if not isinstance(v, list) or not all(isinstance(x, str) for x in v):
+        raise ParseError("expected a list of strings", path)
+    return v
+
+
+def _parse_match(v: Any, path: str) -> model.Match:
+    m = _expect_map(v, path)
+    _check_keys(m, {"expr", "all", "any", "none"}, path)
+    keys = set(m.keys()) & {"expr", "all", "any", "none"}
+    if len(keys) != 1:
+        raise ParseError("match must have exactly one of expr/all/any/none", path)
+    key = keys.pop()
+    if key == "expr":
+        return model.Match(expr=_expect_str(m["expr"], f"{path}.expr"))
+    inner = _expect_map(m[key], f"{path}.{key}")
+    _check_keys(inner, {"of"}, f"{path}.{key}")
+    of = inner.get("of")
+    if not isinstance(of, list) or not of:
+        raise ParseError("expected a non-empty `of` list", f"{path}.{key}")
+    matches = [_parse_match(x, f"{path}.{key}.of[{i}]") for i, x in enumerate(of)]
+    return model.Match(**{key: matches})
+
+
+def _parse_condition(v: Any, path: str) -> model.Condition:
+    m = _expect_map(v, path)
+    _check_keys(m, {"match", "script"}, path)
+    if "match" in m:
+        return model.Condition(match=_parse_match(m["match"], f"{path}.match"))
+    if "script" in m:
+        return model.Condition(script=_expect_str(m["script"], f"{path}.script"))
+    raise ParseError("condition must have `match` or `script`", path)
+
+
+def _parse_output(v: Any, path: str) -> model.Output:
+    m = _expect_map(v, path)
+    _check_keys(m, {"expr", "when"}, path)
+    out = model.Output()
+    if "expr" in m:
+        out.expr = _expect_str(m["expr"], f"{path}.expr")
+    if "when" in m:
+        w = _expect_map(m["when"], f"{path}.when")
+        _check_keys(w, {"ruleActivated", "conditionNotMet"}, f"{path}.when")
+        out.when = model.OutputWhen(
+            rule_activated=w.get("ruleActivated"),
+            condition_not_met=w.get("conditionNotMet"),
+        )
+    if out.expr is None and out.when is None:
+        raise ParseError("output must have `expr` or `when`", path)
+    return out
+
+
+def _parse_variables(v: Any, path: str) -> model.Variables:
+    m = _expect_map(v, path)
+    _check_keys(m, {"import", "local"}, path)
+    out = model.Variables()
+    if "import" in m:
+        out.import_ = _expect_str_list(m["import"], f"{path}.import")
+    if "local" in m:
+        local = _expect_map(m["local"], f"{path}.local")
+        for k, val in local.items():
+            out.local[k] = _expect_str(val, f"{path}.local.{k}")
+    return out
+
+
+def _parse_constants(v: Any, path: str) -> model.Constants:
+    m = _expect_map(v, path)
+    _check_keys(m, {"import", "local"}, path)
+    out = model.Constants()
+    if "import" in m:
+        out.import_ = _expect_str_list(m["import"], f"{path}.import")
+    if "local" in m:
+        out.local = dict(_expect_map(m["local"], f"{path}.local"))
+    return out
+
+
+def _parse_schema_ref(v: Any, path: str) -> model.SchemaRef:
+    m = _expect_map(v, path)
+    _check_keys(m, {"ref", "ignoreWhen"}, path)
+    ref = _expect_str(m.get("ref", ""), f"{path}.ref")
+    ignore: list[str] = []
+    if "ignoreWhen" in m:
+        iw = _expect_map(m["ignoreWhen"], f"{path}.ignoreWhen")
+        _check_keys(iw, {"actions"}, f"{path}.ignoreWhen")
+        ignore = _expect_str_list(iw.get("actions", []), f"{path}.ignoreWhen.actions")
+        if not ignore:
+            raise ParseError("ignoreWhen.actions must not be empty", path)
+    return model.SchemaRef(ref=ref, ignore_when_actions=ignore)
+
+
+_SCOPE_PERMISSIONS = {
+    "SCOPE_PERMISSIONS_UNSPECIFIED",
+    "SCOPE_PERMISSIONS_OVERRIDE_PARENT",
+    "SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT_FOR_ALLOWS",
+}
+
+_EFFECTS = {"EFFECT_ALLOW", "EFFECT_DENY"}
+
+
+def _parse_effect(v: Any, path: str) -> str:
+    s = _expect_str(v, path)
+    if s not in _EFFECTS:
+        raise ParseError(f"invalid effect {s!r}", path)
+    return s
+
+
+def _parse_scope_permissions(m: dict, path: str) -> str:
+    sp = m.get("scopePermissions", "SCOPE_PERMISSIONS_UNSPECIFIED")
+    if sp not in _SCOPE_PERMISSIONS:
+        raise ParseError(f"invalid scopePermissions {sp!r}", f"{path}.scopePermissions")
+    return sp
+
+
+def _parse_resource_rule(v: Any, path: str) -> model.ResourceRule:
+    m = _expect_map(v, path)
+    _check_keys(m, {"actions", "effect", "roles", "derivedRoles", "condition", "name", "output"}, path)
+    actions = _expect_str_list(m.get("actions"), f"{path}.actions")
+    if not actions:
+        raise ParseError("rule must define at least one action", f"{path}.actions")
+    roles = _expect_str_list(m.get("roles", []), f"{path}.roles")
+    derived_roles = _expect_str_list(m.get("derivedRoles", []), f"{path}.derivedRoles")
+    if not roles and not derived_roles:
+        raise ParseError("rule must define roles or derivedRoles", path)
+    rule = model.ResourceRule(
+        actions=actions,
+        effect=_parse_effect(m.get("effect"), f"{path}.effect"),
+        roles=roles,
+        derived_roles=derived_roles,
+        name=m.get("name", ""),
+    )
+    if "condition" in m:
+        rule.condition = _parse_condition(m["condition"], f"{path}.condition")
+    if "output" in m:
+        rule.output = _parse_output(m["output"], f"{path}.output")
+    return rule
+
+
+def _parse_resource_policy(v: Any, path: str) -> model.ResourcePolicy:
+    m = _expect_map(v, path)
+    _check_keys(m, {"resource", "version", "importDerivedRoles", "rules", "scope", "schemas", "variables", "constants", "scopePermissions"}, path)
+    rp = model.ResourcePolicy(
+        resource=_expect_str(m.get("resource"), f"{path}.resource"),
+        version=_expect_str(m.get("version"), f"{path}.version"),
+        scope=m.get("scope", ""),
+        scope_permissions=_parse_scope_permissions(m, path),
+    )
+    if "importDerivedRoles" in m:
+        rp.import_derived_roles = _expect_str_list(m["importDerivedRoles"], f"{path}.importDerivedRoles")
+    rp.rules = [_parse_resource_rule(r, f"{path}.rules[{i}]") for i, r in enumerate(m.get("rules", []))]
+    if "schemas" in m:
+        sm = _expect_map(m["schemas"], f"{path}.schemas")
+        _check_keys(sm, {"principalSchema", "resourceSchema"}, f"{path}.schemas")
+        schemas = model.Schemas()
+        if "principalSchema" in sm:
+            schemas.principal_schema = _parse_schema_ref(sm["principalSchema"], f"{path}.schemas.principalSchema")
+        if "resourceSchema" in sm:
+            schemas.resource_schema = _parse_schema_ref(sm["resourceSchema"], f"{path}.schemas.resourceSchema")
+        rp.schemas = schemas
+    if "variables" in m:
+        rp.variables = _parse_variables(m["variables"], f"{path}.variables")
+    if "constants" in m:
+        rp.constants = _parse_constants(m["constants"], f"{path}.constants")
+    return rp
+
+
+def _parse_principal_policy(v: Any, path: str) -> model.PrincipalPolicy:
+    m = _expect_map(v, path)
+    _check_keys(m, {"principal", "version", "rules", "scope", "variables", "constants", "scopePermissions"}, path)
+    pp = model.PrincipalPolicy(
+        principal=_expect_str(m.get("principal"), f"{path}.principal"),
+        version=_expect_str(m.get("version"), f"{path}.version"),
+        scope=m.get("scope", ""),
+        scope_permissions=_parse_scope_permissions(m, path),
+    )
+    for i, r in enumerate(m.get("rules", [])):
+        rm = _expect_map(r, f"{path}.rules[{i}]")
+        _check_keys(rm, {"resource", "actions"}, f"{path}.rules[{i}]")
+        actions = []
+        for j, a in enumerate(rm.get("actions", [])):
+            am = _expect_map(a, f"{path}.rules[{i}].actions[{j}]")
+            _check_keys(am, {"action", "effect", "condition", "name", "output"}, f"{path}.rules[{i}].actions[{j}]")
+            pa = model.PrincipalRuleAction(
+                action=_expect_str(am.get("action"), f"{path}.rules[{i}].actions[{j}].action"),
+                effect=_parse_effect(am.get("effect"), f"{path}.rules[{i}].actions[{j}].effect"),
+                name=am.get("name", ""),
+            )
+            if "condition" in am:
+                pa.condition = _parse_condition(am["condition"], f"{path}.rules[{i}].actions[{j}].condition")
+            if "output" in am:
+                pa.output = _parse_output(am["output"], f"{path}.rules[{i}].actions[{j}].output")
+            actions.append(pa)
+        if not actions:
+            raise ParseError("principal rule must define at least one action", f"{path}.rules[{i}]")
+        pp.rules.append(
+            model.PrincipalRule(resource=_expect_str(rm.get("resource"), f"{path}.rules[{i}].resource"), actions=actions)
+        )
+    if "variables" in m:
+        pp.variables = _parse_variables(m["variables"], f"{path}.variables")
+    if "constants" in m:
+        pp.constants = _parse_constants(m["constants"], f"{path}.constants")
+    return pp
+
+
+def _parse_role_policy(v: Any, path: str) -> model.RolePolicy:
+    m = _expect_map(v, path)
+    _check_keys(m, {"role", "version", "scope", "parentRoles", "rules", "scopePermissions", "variables", "constants"}, path)
+    rp = model.RolePolicy(
+        role=_expect_str(m.get("role"), f"{path}.role"),
+        version=m.get("version", ""),
+        scope=m.get("scope", ""),
+    )
+    if "parentRoles" in m:
+        rp.parent_roles = _expect_str_list(m["parentRoles"], f"{path}.parentRoles")
+    for i, r in enumerate(m.get("rules", [])):
+        rm = _expect_map(r, f"{path}.rules[{i}]")
+        _check_keys(rm, {"resource", "allowActions", "condition", "name", "output"}, f"{path}.rules[{i}]")
+        rr = model.RoleRule(
+            resource=_expect_str(rm.get("resource"), f"{path}.rules[{i}].resource"),
+            allow_actions=_expect_str_list(rm.get("allowActions"), f"{path}.rules[{i}].allowActions"),
+            name=rm.get("name", ""),
+        )
+        if not rr.allow_actions:
+            raise ParseError("role rule must define allowActions", f"{path}.rules[{i}].allowActions")
+        if "condition" in rm:
+            rr.condition = _parse_condition(rm["condition"], f"{path}.rules[{i}].condition")
+        if "output" in rm:
+            rr.output = _parse_output(rm["output"], f"{path}.rules[{i}].output")
+        rp.rules.append(rr)
+    sp = m.get("scopePermissions", model.SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT)
+    if sp not in _SCOPE_PERMISSIONS:
+        raise ParseError(f"invalid scopePermissions {sp!r}", f"{path}.scopePermissions")
+    rp.scope_permissions = sp
+    if "variables" in m:
+        rp.variables = _parse_variables(m["variables"], f"{path}.variables")
+    if "constants" in m:
+        rp.constants = _parse_constants(m["constants"], f"{path}.constants")
+    return rp
+
+
+def _parse_derived_roles(v: Any, path: str) -> model.DerivedRoles:
+    m = _expect_map(v, path)
+    _check_keys(m, {"name", "definitions", "variables", "constants"}, path)
+    defs = []
+    for i, d in enumerate(m.get("definitions", [])):
+        dm = _expect_map(d, f"{path}.definitions[{i}]")
+        _check_keys(dm, {"name", "parentRoles", "condition"}, f"{path}.definitions[{i}]")
+        rd = model.RoleDef(
+            name=_expect_str(dm.get("name"), f"{path}.definitions[{i}].name"),
+            parent_roles=_expect_str_list(dm.get("parentRoles"), f"{path}.definitions[{i}].parentRoles"),
+        )
+        if not rd.parent_roles:
+            raise ParseError("derived role must define parentRoles", f"{path}.definitions[{i}].parentRoles")
+        if "condition" in dm:
+            rd.condition = _parse_condition(dm["condition"], f"{path}.definitions[{i}].condition")
+        defs.append(rd)
+    if not defs:
+        raise ParseError("derivedRoles must define at least one definition", f"{path}.definitions")
+    dr = model.DerivedRoles(name=_expect_str(m.get("name"), f"{path}.name"), definitions=defs)
+    if "variables" in m:
+        dr.variables = _parse_variables(m["variables"], f"{path}.variables")
+    if "constants" in m:
+        dr.constants = _parse_constants(m["constants"], f"{path}.constants")
+    return dr
+
+
+def _parse_export_variables(v: Any, path: str) -> model.ExportVariables:
+    m = _expect_map(v, path)
+    _check_keys(m, {"name", "definitions"}, path)
+    defs = _expect_map(m.get("definitions", {}), f"{path}.definitions")
+    for k, val in defs.items():
+        _expect_str(val, f"{path}.definitions.{k}")
+    return model.ExportVariables(name=_expect_str(m.get("name"), f"{path}.name"), definitions=dict(defs))
+
+
+def _parse_export_constants(v: Any, path: str) -> model.ExportConstants:
+    m = _expect_map(v, path)
+    _check_keys(m, {"name", "definitions"}, path)
+    defs = _expect_map(m.get("definitions", {}), f"{path}.definitions")
+    return model.ExportConstants(name=_expect_str(m.get("name"), f"{path}.name"), definitions=dict(defs))
+
+
+_POLICY_TYPE_PARSERS = {
+    "resourcePolicy": ("resource_policy", _parse_resource_policy),
+    "principalPolicy": ("principal_policy", _parse_principal_policy),
+    "derivedRoles": ("derived_roles", _parse_derived_roles),
+    "exportVariables": ("export_variables", _parse_export_variables),
+    "exportConstants": ("export_constants", _parse_export_constants),
+    "rolePolicy": ("role_policy", _parse_role_policy),
+}
+
+
+def parse_policy(doc: Any, source: str = "") -> model.Policy:
+    m = _expect_map(doc, "")
+    _check_keys(
+        m,
+        {"apiVersion", "disabled", "description", "metadata", "variables", "$schema"}
+        | set(_POLICY_TYPE_PARSERS),
+        "",
+    )
+    api_version = m.get("apiVersion")
+    if api_version != API_VERSION:
+        raise ParseError(f"unsupported apiVersion {api_version!r} (want {API_VERSION!r})", "apiVersion", source)
+
+    pol = model.Policy(
+        api_version=api_version,
+        disabled=bool(m.get("disabled", False)),
+        description=m.get("description", ""),
+    )
+    if "metadata" in m:
+        mm = _expect_map(m["metadata"], "metadata")
+        _check_keys(mm, {"sourceFile", "annotations", "hash", "storeIdentifer", "storeIdentifier", "sourceAttributes"}, "metadata")
+        pol.metadata = model.Metadata(
+            source_file=mm.get("sourceFile", ""),
+            annotations=dict(mm.get("annotations", {}) or {}),
+            store_identifier=mm.get("storeIdentifier", mm.get("storeIdentifer", "")),
+        )
+    if "variables" in m:
+        pol.variables = dict(_expect_map(m["variables"], "variables"))
+
+    found = [k for k in _POLICY_TYPE_PARSERS if k in m]
+    if len(found) != 1:
+        raise ParseError(
+            f"policy must define exactly one policy type, found {found or 'none'}", "", source
+        )
+    attr, fn = _POLICY_TYPE_PARSERS[found[0]]
+    try:
+        setattr(pol, attr, fn(m[found[0]], found[0]))
+    except ParseError as e:
+        raise ParseError(str(e), source=source) from None
+    if pol.metadata is None:
+        pol.metadata = model.Metadata(source_file=source)
+    elif not pol.metadata.source_file:
+        pol.metadata.source_file = source
+    return pol
+
+
+def parse_policies(text: str, source: str = "") -> Iterator[model.Policy]:
+    """Parse one or more YAML documents into policies."""
+    for doc in yaml.safe_load_all(io.StringIO(text)):
+        if doc is None:
+            continue
+        yield parse_policy(doc, source=source)
+
+
+def parse_policy_file(path: str) -> model.Policy:
+    with open(path, encoding="utf-8") as f:
+        docs = [d for d in yaml.safe_load_all(f) if d is not None]
+    if len(docs) != 1:
+        raise ParseError(f"expected exactly one policy document, found {len(docs)}", source=path)
+    return parse_policy(docs[0], source=path)

@@ -1,0 +1,333 @@
+import datetime as dt
+
+import pytest
+
+from cerbos_tpu.cel import CelError, parse, evaluate, check
+from cerbos_tpu.cel.checker import CheckError
+from cerbos_tpu.cel.interp import Activation, Message
+from cerbos_tpu.cel.values import Duration, Timestamp, UInt
+
+
+def ev(src, vars=None, now=None):
+    now_fn = (lambda: now) if now is not None else (lambda: Timestamp.from_datetime(dt.datetime(2024, 1, 2, 3, 4, 5, tzinfo=dt.timezone.utc)))
+    return evaluate(parse(src), Activation(vars or {}, now_fn=now_fn))
+
+
+class TestLiteralsAndArithmetic:
+    def test_ints(self):
+        assert ev("1 + 2 * 3") == 7
+        assert ev("(1 + 2) * 3") == 9
+        assert ev("7 / 2") == 3
+        assert ev("-7 / 2") == -3
+        assert ev("7 % -2") == 1
+        assert ev("-7 % 2") == -1
+        assert ev("0x1F") == 31
+
+    def test_int_overflow(self):
+        with pytest.raises(CelError):
+            ev("9223372036854775807 + 1")
+        assert ev("-9223372036854775808") == -(2**63)
+
+    def test_uint(self):
+        assert ev("2u + 3u") == UInt(5)
+        with pytest.raises(CelError):
+            ev("2u - 3u")
+        with pytest.raises(CelError):
+            ev("1 + 2u")
+
+    def test_double(self):
+        assert ev("1.5 + 2.25") == 3.75
+        assert ev("1.0 / 0.0") == float("inf")
+        assert ev("1e3") == 1000.0
+
+    def test_mixed_arith_is_error(self):
+        with pytest.raises(CelError):
+            ev("1 + 1.0")
+
+    def test_string_concat(self):
+        assert ev("'foo' + \"bar\"") == "foobar"
+        assert ev("b'ab' + b'cd'") == b"abcd"
+        assert ev("[1, 2] + [3]") == [1, 2, 3]
+
+    def test_string_escapes(self):
+        assert ev(r"'a\nb'") == "a\nb"
+        assert ev(r"'é'") == "é"
+        assert ev("r'a\\nb'") == "a\\nb"
+
+    def test_div_by_zero(self):
+        with pytest.raises(CelError):
+            ev("1 / 0")
+        with pytest.raises(CelError):
+            ev("1 % 0")
+
+
+class TestComparison:
+    def test_numeric_cross_type(self):
+        assert ev("1 == 1.0") is True
+        assert ev("1 < 1.5") is True
+        assert ev("2u == 2") is True
+        assert ev("1 == '1'") is False
+
+    def test_ordering(self):
+        assert ev("'abc' < 'abd'") is True
+        assert ev("b'a' < b'b'") is True
+        with pytest.raises(CelError):
+            ev("'a' < 1")
+
+    def test_deep_equality(self):
+        assert ev("[1, [2, 3]] == [1, [2, 3]]") is True
+        assert ev("{'a': 1} == {'a': 1}") is True
+        assert ev("{'a': 1} == {'a': 2}") is False
+
+    def test_in(self):
+        assert ev("2 in [1, 2, 3]") is True
+        assert ev("'x' in {'x': 1}") is True
+        assert ev("4 in [1, 2, 3]") is False
+
+
+class TestLogic:
+    def test_short_circuit_absorbs_errors(self):
+        assert ev("true || (1 / 0 > 0)") is True
+        assert ev("(1 / 0 > 0) || true") is True
+        assert ev("false && (1 / 0 > 0)") is False
+        assert ev("(1 / 0 > 0) && false") is False
+        with pytest.raises(CelError):
+            ev("false || (1 / 0 > 0)")
+        with pytest.raises(CelError):
+            ev("true && (1 / 0 > 0)")
+
+    def test_ternary(self):
+        assert ev("1 < 2 ? 'y' : 'n'") == "y"
+        with pytest.raises(CelError):
+            ev("1 ? 'y' : 'n'")
+
+    def test_not(self):
+        assert ev("!false") is True
+        assert ev("!!true") is True
+
+
+class TestStringsAndLists:
+    def test_string_methods(self):
+        assert ev("'hello'.contains('ell')") is True
+        assert ev("'hello'.startsWith('he')") is True
+        assert ev("'hello'.endsWith('lo')") is True
+        assert ev("'hello'.matches('^h.*o$')") is True
+        assert ev("'hello'.size()") == 5
+        assert ev("size('hello')") == 5
+        assert ev("'Hello'.lowerAscii()") == "hello"
+        assert ev("'a,b,c'.split(',')") == ["a", "b", "c"]
+        assert ev("' x '.trim()") == "x"
+        assert ev("'hello'.substring(1, 3)") == "el"
+        assert ev("'hello'.replace('l', 'L')") == "heLLo"
+        assert ev("['a','b'].join('-')") == "a-b"
+        assert ev("'hello'.indexOf('l')") == 2
+        assert ev("'hello'.charAt(1)") == "e"
+
+    def test_list_methods(self):
+        assert ev("[[1],[2,3]].flatten()") == [1, 2, 3]
+        assert ev("[1,2,3,4].slice(1, 3)") == [2, 3]
+        assert ev("[3,1,2].sort()") == [1, 2, 3]
+        assert ev("[1,1,2].distinct()") == [1, 2]
+        assert ev("[1,2,3].reverse()") == [3, 2, 1]
+
+    def test_macros(self):
+        assert ev("[1,2,3].all(x, x > 0)") is True
+        assert ev("[1,2,3].exists(x, x == 2)") is True
+        assert ev("[1,2,3].exists_one(x, x > 2)") is True
+        assert ev("[1,2,3].map(x, x * 2)") == [2, 4, 6]
+        assert ev("[1,2,3].filter(x, x % 2 == 1)") == [1, 3]
+        assert ev("[1,2,3].map(x, x > 1, x * 10)") == [20, 30]
+        assert ev("{'a':1,'b':2}.exists(k, k == 'a')") is True
+
+    def test_macro_error_absorption(self):
+        # exists absorbs errors if a match is found
+        assert ev("[0, 1].exists(x, 1 / x > 0)") is True
+        with pytest.raises(CelError):
+            ev("[0, 0].exists(x, 1 / x > 0)")
+
+    def test_two_var_comprehensions(self):
+        assert ev("{'a':1,'b':2}.all(k, v, v > 0)") is True
+        assert ev("[10, 20].exists(i, v, i == 1 && v == 20)") is True
+        assert ev("{'a':1}.transformList(k, v, k)") == ["a"]
+        assert ev("{'a':1,'b':2}.transformMap(k, v, v * 10)") == {"a": 10, "b": 20}
+
+    def test_bind(self):
+        assert ev("cel.bind(x, 40, x + 2)") == 42
+
+
+class TestHasMacro:
+    def test_has_on_map(self):
+        assert ev("has(m.a)", {"m": {"a": 1}}) is True
+        assert ev("has(m.b)", {"m": {"a": 1}}) is False
+
+    def test_missing_key_is_error(self):
+        with pytest.raises(CelError):
+            ev("m.b == 1", {"m": {"a": 1}})
+
+
+class TestConversionsAndTime:
+    def test_conversions(self):
+        assert ev("int('42')") == 42
+        assert ev("int(3.9)") == 3
+        assert ev("double('2.5')") == 2.5
+        assert ev("string(42)") == "42"
+        assert ev("string(1.0)") == "1"
+        assert ev("string(true)") == "true"
+        assert ev("uint(7)") == UInt(7)
+        assert ev("bool('true')") is True
+        assert ev("type(1) == int") is True
+        assert ev("type('a') == string") is True
+        assert ev("type(type(1)) == type") is True
+
+    def test_timestamp(self):
+        assert ev("timestamp('2024-01-01T00:00:00Z').getFullYear()") == 2024
+        assert ev("timestamp('2024-03-05T10:20:30Z').getMonth()") == 2
+        assert ev("timestamp('2024-03-05T10:20:30Z').getDate()") == 5
+        assert ev("timestamp('2024-03-05T10:20:30Z').getHours()") == 10
+        assert ev("timestamp('2024-01-01T10:00:00Z') < timestamp('2024-01-02T10:00:00Z')") is True
+
+    def test_duration(self):
+        assert ev("duration('1h30m').getMinutes()") == 90
+        assert ev("duration('90s') == duration('1m30s')") is True
+        assert ev("timestamp('2024-01-01T00:00:00Z') + duration('24h') == timestamp('2024-01-02T00:00:00Z')") is True
+
+    def test_now_is_stable(self):
+        now = Timestamp.from_datetime(dt.datetime(2024, 6, 1, tzinfo=dt.timezone.utc))
+        assert ev("now() == now()", now=now) is True
+        assert ev("now().getFullYear()", now=now) == 2024
+        assert ev("timeSince(timestamp('2024-05-31T00:00:00Z')) == duration('24h')", now=now) is True
+
+
+class TestCerbosLib:
+    def test_set_ops(self):
+        assert ev("hasIntersection([1,2], [2,3])") is True
+        assert ev("[1,2].hasIntersection([3,4])") is False
+        assert ev("intersect([1,2,3], [2,3,4])") == [2, 3]
+        assert ev("except([1,2,3], [2])") == [1, 3]
+        assert ev("isSubset([1,2], [1,2,3])") is True
+        assert ev("['a'].isSubset(['a','b'])") is True
+
+    def test_ip_range(self):
+        assert ev("'10.1.2.3'.inIPAddrRange('10.0.0.0/8')") is True
+        assert ev("'192.168.1.1'.inIPAddrRange('10.0.0.0/8')") is False
+
+    def test_paths(self):
+        assert ev("basePath('/a/b/c.txt')") == "c.txt"
+        assert ev("dirPath('/a/b/c.txt')") == "/a/b"
+        assert ev("extPath('/a/b/c.txt')") == ".txt"
+        assert ev("joinPath(['/a', 'b', 'c'])") == "/a/b/c"
+        assert ev("pathHasPrefix('/a/b/c', '/a/b')") is True
+        assert ev("pathHasPrefix('/a/bc', '/a/b')") is False
+        assert ev("pathMatch('/a/b', '/a/*')") is True
+
+    def test_hierarchy(self):
+        assert ev("hierarchy('a.b.c').ancestorOf(hierarchy('a.b.c.d'))") is True
+        assert ev("hierarchy('a.b').descendentOf(hierarchy('a'))") is True
+        assert ev("hierarchy('a.b').siblingOf(hierarchy('a.c'))") is True
+        assert ev("hierarchy('a.b.c').immediateChildOf(hierarchy('a.b'))") is True
+        assert ev("hierarchy('a.b').overlaps(hierarchy('a.b.c'))") is True
+
+
+class TestMathExt:
+    def test_math(self):
+        assert ev("math.greatest(1, 2, 3)") == 3
+        assert ev("math.least([5, 2, 8])") == 2
+        assert ev("math.ceil(1.2)") == 2.0
+        assert ev("math.floor(1.8)") == 1.0
+        assert ev("math.round(1.5)") == 2.0
+        assert ev("math.abs(-3)") == 3
+        assert ev("math.sign(-2.5)") == -1.0
+
+    def test_encoders(self):
+        assert ev("base64.encode(b'hello')") == "aGVsbG8="
+        assert ev("base64.decode('aGVsbG8=')") == b"hello"
+
+
+class TestRequestShape:
+    def _request_vars(self):
+        principal = Message({
+            "id": "john", "roles": ["employee"],
+            "attr": {"dept": "mkt", "clearance": 3.0},
+            "policyVersion": "default", "scope": "",
+        })
+        resource = Message({
+            "kind": "leave_request", "id": "XX1",
+            "attr": {"owner": "john", "tags": ["a", "b"]},
+            "policyVersion": "default", "scope": "",
+        })
+        request = Message({"principal": principal, "resource": resource, "auxData": Message({"jwt": {}})})
+        return {"request": request, "P": principal, "R": resource, "V": {}, "variables": {}}
+
+    def test_select_chain(self):
+        v = self._request_vars()
+        assert ev("request.principal.id == 'john'", v) is True
+        assert ev("P.attr.dept == 'mkt'", v) is True
+        assert ev("R.attr.owner == request.principal.id", v) is True
+        assert ev("'employee' in P.roles", v) is True
+        assert ev("P.attr.clearance >= 3.0", v) is True
+
+    def test_missing_attr_error(self):
+        v = self._request_vars()
+        with pytest.raises(CelError):
+            ev("R.attr.nonexistent == 'x'", v)
+        assert ev("has(R.attr.nonexistent)", v) is False
+        assert ev("has(R.attr.owner)", v) is True
+
+
+class TestChecker:
+    def test_unknown_root(self):
+        with pytest.raises(CheckError):
+            check(parse("unknown_var == 1"))
+
+    def test_bad_request_field(self):
+        with pytest.raises(CheckError):
+            check(parse("request.bogus == 1"))
+        with pytest.raises(CheckError):
+            check(parse("R.attrs.x == 1"))
+
+    def test_good_exprs(self):
+        check(parse("R.attr.x == P.attr.y && 'a' in P.roles"))
+        check(parse("[1,2].all(x, x > 0)"))
+        check(parse("cel.bind(v, R.attr.x, v + v)"))
+
+
+class TestReviewRegressions:
+    """Regressions from the initial code review findings."""
+
+    def test_relation_chains_left_assoc(self):
+        assert ev("1 < 2 == true") is True
+        assert ev("1 in [1] == true") is True
+
+    def test_negative_duration_accessors(self):
+        assert ev("duration('-90m').getHours()") == -1
+        assert ev("duration('-90m').getMinutes()") == -90
+        assert ev("duration('-1500ms').getMilliseconds()") == -500
+        assert ev("duration('-1500ms').getSeconds()") == -1
+
+    def test_nan_division(self):
+        assert ev("math.isNaN(double('nan') / 0.0)") is True
+        assert ev("0.0 / 0.0 != 0.0 / 0.0") is True
+
+    def test_pre_epoch_int_conversion(self):
+        assert ev("int(timestamp('1969-12-31T23:59:59.5Z'))") == -1
+
+    def test_bad_escapes_are_parse_errors(self):
+        from cerbos_tpu.cel.errors import CelParseError
+
+        for bad in [r"'\xzz'", "0x", r"'\u12'", r"'\09'"]:
+            with pytest.raises(CelParseError):
+                parse(bad)
+
+    def test_deep_nesting_is_parse_error(self):
+        from cerbos_tpu.cel.errors import CelParseError
+
+        with pytest.raises(CelParseError):
+            parse("(" * 200 + "1" + ")" * 200)
+
+    def test_map_key_type_discrimination(self):
+        # Python would conflate True/1 as dict keys; CEL must not
+        assert ev("{1: 'a'}[1]") == "a"
+        with pytest.raises(CelError):
+            ev("{1: 'a'}[true]")
+        assert ev("true in {1: 'a'}") is False
+        assert ev("1 in {1: 'a'}") is True

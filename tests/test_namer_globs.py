@@ -1,0 +1,48 @@
+from cerbos_tpu import globs, namer
+
+
+def test_scope_parents():
+    assert list(namer.scope_parents("a.b.c")) == ["a.b", "a", ""]
+    assert list(namer.scope_parents("a")) == [""]
+    assert list(namer.scope_parents("")) == []
+    assert namer.scope_chain("a.b") == ["a.b", "a", ""]
+    assert namer.scope_chain("") == [""]
+
+
+def test_fqns():
+    assert namer.resource_policy_fqn("leave_request", "default") == "cerbos.resource.leave_request.vdefault"
+    assert (
+        namer.resource_policy_fqn("leave_request", "20210210", "acme.hr")
+        == "cerbos.resource.leave_request.v20210210/acme.hr"
+    )
+    assert namer.principal_policy_fqn("daffy_duck", "dev") == "cerbos.principal.daffy_duck.vdev"
+    assert namer.role_policy_fqn("acme_admin", "", "acme") == "cerbos.role.acme_admin.vdefault/acme"
+    assert namer.derived_roles_fqn("apatr_common_roles") == "cerbos.derived_roles.apatr_common_roles"
+    assert namer.policy_key_from_fqn("cerbos.resource.x.vdefault") == "resource.x.vdefault"
+
+
+def test_sanitize():
+    assert namer.sanitize("a:b/c") == "a_b_c"
+    # names not matching the legacy pattern pass through untouched
+    assert namer.sanitize("ns::res") == "ns::res"
+
+
+def test_glob_separator_semantics():
+    assert globs.matches_glob("view:*", "view:public")
+    assert not globs.matches_glob("view:*", "view:public:extra")
+    assert globs.matches_glob("view:**", "view:public:extra")
+    # bare * is promoted to ** (matches everything)
+    assert globs.matches_glob("*", "anything:at:all")
+    assert globs.matches_glob("a?c", "abc")
+    assert not globs.matches_glob("a?c", "a:c")
+    assert globs.matches_glob("{view,edit}:*", "edit:doc")
+    assert not globs.matches_glob("{view,edit}:*", "delete:doc")
+    assert globs.matches_glob("[vV]iew", "View")
+    assert not globs.matches_glob("[!v]iew", "view")
+
+
+def test_is_glob():
+    assert globs.is_glob("view:*")
+    assert not globs.is_glob("view:public")
+    assert not globs.is_glob("view\\*")
+    assert globs.is_glob("{a,b}")

@@ -1,0 +1,141 @@
+import pytest
+
+from cerbos_tpu import namer
+from cerbos_tpu.policy import ParseError, parse_policy
+from cerbos_tpu.policy.parser import parse_policies
+
+RESOURCE_POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: leave_request
+  version: "20210210"
+  importDerivedRoles:
+    - common_roles
+  rules:
+    - actions: ["view:*"]
+      effect: EFFECT_ALLOW
+      roles: [employee]
+      condition:
+        match:
+          expr: request.resource.attr.owner == request.principal.id
+    - actions: ["approve"]
+      effect: EFFECT_ALLOW
+      derivedRoles: [direct_manager]
+      condition:
+        match:
+          all:
+            of:
+              - expr: request.resource.attr.status == "PENDING_APPROVAL"
+              - expr: "'GB' in request.resource.attr.geographies"
+      output:
+        when:
+          ruleActivated: '"approved"'
+"""
+
+PRINCIPAL_POLICY = """
+apiVersion: api.cerbos.dev/v1
+principalPolicy:
+  principal: daffy_duck
+  version: dev
+  rules:
+    - resource: leave_request
+      actions:
+        - action: "*"
+          effect: EFFECT_ALLOW
+          name: dev_admin
+"""
+
+DERIVED_ROLES = """
+apiVersion: api.cerbos.dev/v1
+derivedRoles:
+  name: common_roles
+  definitions:
+    - name: owner
+      parentRoles: [user]
+      condition:
+        match:
+          expr: request.resource.attr.owner == request.principal.id
+    - name: any_employee
+      parentRoles: [employee]
+"""
+
+ROLE_POLICY = """
+apiVersion: api.cerbos.dev/v1
+rolePolicy:
+  role: acme_admin
+  scope: acme.hr
+  parentRoles: [admin]
+  rules:
+    - resource: leave_request
+      allowActions: ["view", "deny"]
+"""
+
+
+def test_parse_resource_policy():
+    p = parse_policy(__import__("yaml").safe_load(RESOURCE_POLICY))
+    rp = p.resource_policy
+    assert rp is not None
+    assert rp.resource == "leave_request"
+    assert rp.rules[0].actions == ["view:*"]
+    assert rp.rules[1].condition.match.all is not None
+    assert len(rp.rules[1].condition.match.all) == 2
+    assert p.fqn() == "cerbos.resource.leave_request.v20210210"
+    assert p.dependencies() == [namer.derived_roles_fqn("common_roles")]
+
+
+def test_parse_principal_policy():
+    p = parse_policy(__import__("yaml").safe_load(PRINCIPAL_POLICY))
+    assert p.principal_policy.rules[0].actions[0].action == "*"
+    assert p.fqn() == "cerbos.principal.daffy_duck.vdev"
+
+
+def test_parse_derived_roles():
+    p = parse_policy(__import__("yaml").safe_load(DERIVED_ROLES))
+    assert len(p.derived_roles.definitions) == 2
+    assert p.derived_roles.definitions[1].condition is None
+
+
+def test_parse_role_policy():
+    p = parse_policy(__import__("yaml").safe_load(ROLE_POLICY))
+    assert p.role_policy.parent_roles == ["admin"]
+    assert p.fqn() == "cerbos.role.acme_admin.vdefault/acme.hr"
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse_policy({"apiVersion": "bogus"})
+    with pytest.raises(ParseError):
+        parse_policy({"apiVersion": "api.cerbos.dev/v1"})  # no policy type
+    with pytest.raises(ParseError):
+        # rule without roles or derivedRoles
+        parse_policy({
+            "apiVersion": "api.cerbos.dev/v1",
+            "resourcePolicy": {
+                "resource": "x", "version": "default",
+                "rules": [{"actions": ["a"], "effect": "EFFECT_ALLOW"}],
+            },
+        })
+
+
+def test_multi_doc():
+    pols = list(parse_policies(RESOURCE_POLICY + "\n---\n" + DERIVED_ROLES))
+    assert len(pols) == 2
+
+
+def test_unknown_fields_rejected():
+    # a typo'd `conditon` must not silently produce an unconditional rule
+    with pytest.raises(ParseError) as ei:
+        parse_policy({
+            "apiVersion": "api.cerbos.dev/v1",
+            "resourcePolicy": {
+                "resource": "x", "version": "default",
+                "rules": [{
+                    "actions": ["a"], "roles": ["r"], "effect": "EFFECT_ALLOW",
+                    "conditon": {"match": {"expr": "false"}},
+                }],
+            },
+        })
+    assert "conditon" in str(ei.value)
+    with pytest.raises(ParseError):
+        parse_policy({"apiVersion": "api.cerbos.dev/v1", "bogusKey": 1,
+                      "resourcePolicy": {"resource": "x", "version": "v"}})
