@@ -140,8 +140,21 @@ class Activation:
 
 
 def evaluate(node: Node, act: Activation) -> Any:
-    """Evaluate; raises CelError for CEL runtime errors."""
-    return _eval(node, act)
+    """Evaluate; raises CelError for CEL runtime errors.
+
+    Host-level arithmetic/conversion failures (OverflowError from datetime
+    math, ValueError from out-of-range timestamps) become CEL error values —
+    cel-go returns error values for these, and a malformed attribute must
+    fail the condition, not crash the check (see review finding on
+    timestamp overflow DoS). TypeError/AttributeError are implementation
+    bugs and still surface.
+    """
+    try:
+        return _eval(node, act)
+    except CelError:
+        raise
+    except (OverflowError, ValueError, ZeroDivisionError) as e:
+        raise CelError(f"evaluation error: {e}") from None
 
 
 def _eval(node: Node, act: Activation) -> Any:

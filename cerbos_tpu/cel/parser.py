@@ -74,13 +74,8 @@ def _tokenize(src: str) -> list[_Token]:
                     is_bytes = True
                 j += 1
             if j < n and src[j] in "'\"" and j - i <= 2:
-                s, j2 = _scan_string(src, j, raw)
-                if is_bytes:
-                    toks.append(_Token("BYTES", s.encode("utf-8") if isinstance(s, str) else s, start))
-                else:
-                    if isinstance(s, bytes):
-                        s = s.decode("utf-8", errors="surrogateescape")
-                    toks.append(_Token("STRING", s, start))
+                s, j2 = _scan_string(src, j, raw, as_bytes=is_bytes)
+                toks.append(_Token("BYTES" if is_bytes else "STRING", s, start))
                 i = j2
                 continue
             # fall through: plain identifier starting with r/b
@@ -152,16 +147,23 @@ def _scan_number(src: str, i: int) -> tuple[_Token, int]:
     return _Token("INT", int(src[i:j]), start), j
 
 
-def _scan_string(src: str, i: int, raw: bool) -> tuple[str, int]:
+def _scan_string(src: str, i: int, raw: bool, as_bytes: bool = False) -> tuple[str | bytes, int]:
+    """Scan a string/bytes literal body.
+
+    In bytes literals, ``\\xFF``/``\\377`` escapes are raw byte values
+    (b"\\xff" is one byte), while plain characters contribute their UTF-8
+    encoding — matching cel-go. In string literals they are code points.
+    """
     n = len(src)
     quote = src[i]
     triple = src[i : i + 3] in ('"""', "'''")
     close = quote * 3 if triple else quote
     i += len(close)
     out: list[str] = []
+    bout = bytearray()
     while i < n:
         if src.startswith(close, i):
-            return "".join(out), i + len(close)
+            return (bytes(bout), i + len(close)) if as_bytes else ("".join(out), i + len(close))
         c = src[i]
         if c == "\n" and not triple:
             raise CelParseError("newline in string literal", i, src)
@@ -170,7 +172,10 @@ def _scan_string(src: str, i: int, raw: bool) -> tuple[str, int]:
                 raise CelParseError("unterminated escape", i, src)
             e = src[i + 1]
             if e in _ESCAPES:
-                out.append(_ESCAPES[e])
+                if as_bytes:
+                    bout.extend(_ESCAPES[e].encode("utf-8"))
+                else:
+                    out.append(_ESCAPES[e])
                 i += 2
             elif e in ("x", "X", "u", "U") or e.isdigit():
                 if e in ("x", "X"):
@@ -181,16 +186,32 @@ def _scan_string(src: str, i: int, raw: bool) -> tuple[str, int]:
                     digits, base, skip = src[i + 2 : i + 10], 16, 10
                 else:
                     digits, base, skip = src[i + 1 : i + 4], 8, 4
-                try:
-                    code = int(digits, base)
-                    out.append(chr(code))
-                except (ValueError, OverflowError):
-                    raise CelParseError(f"invalid escape sequence \\{e}{digits}", i, src) from None
+                if as_bytes and e not in ("u", "U"):
+                    # hex/octal escapes in bytes literals are raw byte values
+                    try:
+                        b = int(digits, base)
+                        if not 0 <= b <= 0xFF:
+                            raise ValueError
+                        bout.append(b)
+                    except (ValueError, OverflowError):
+                        raise CelParseError(f"invalid escape sequence \\{e}{digits}", i, src) from None
+                else:
+                    try:
+                        ch = chr(int(digits, base))
+                    except (ValueError, OverflowError):
+                        raise CelParseError(f"invalid escape sequence \\{e}{digits}", i, src) from None
+                    if as_bytes:
+                        bout.extend(ch.encode("utf-8"))
+                    else:
+                        out.append(ch)
                 i += skip
             else:
                 raise CelParseError(f"invalid escape \\{e}", i, src)
         else:
-            out.append(c)
+            if as_bytes:
+                bout.extend(c.encode("utf-8"))
+            else:
+                out.append(c)
             i += 1
     raise CelParseError("unterminated string literal", i, src)
 

@@ -1,0 +1,477 @@
+"""Policy compiler: policy IR → runnable policy sets.
+
+Behavioral reference: internal/compile (derived-roles import resolution,
+exported constants/variables resolution with topological ordering of
+variable definitions, condition compilation). Conditions are parsed and
+checked here; evaluation uses the AST directly (the reference compiles CEL
+programs lazily from source, ruletable.go:506-538).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .. import namer
+from ..cel import ast as cel_ast
+from ..cel import parse as cel_parse
+from ..cel.checker import check as cel_check
+from ..cel.errors import CelParseError
+from ..util import normalize_attr
+from ..policy import model
+
+
+class CompileError(Exception):
+    def __init__(self, errors: list[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors) if errors else "compile error")
+
+
+@dataclass(frozen=True)
+class CompiledExpr:
+    original: str
+    node: cel_ast.Node
+
+
+@dataclass(frozen=True)
+class CompiledCondition:
+    kind: str  # expr | all | any | none
+    expr: Optional[CompiledExpr] = None
+    children: tuple["CompiledCondition", ...] = ()
+
+
+@dataclass(frozen=True)
+class CompiledVariable:
+    name: str
+    expr: CompiledExpr
+
+
+@dataclass(frozen=True)
+class CompiledOutput:
+    rule_activated: Optional[CompiledExpr] = None
+    condition_not_met: Optional[CompiledExpr] = None
+
+
+@dataclass(frozen=True)
+class PolicyParams:
+    """Shared constants + ordered variables for a policy (rule-row params)."""
+
+    constants: dict[str, Any] = field(default_factory=dict)
+    ordered_variables: tuple[CompiledVariable, ...] = ()
+
+    def cache_key(self) -> int:
+        return id(self)
+
+
+@dataclass
+class CompiledDerivedRole:
+    name: str
+    parent_roles: frozenset[str]
+    condition: Optional[CompiledCondition]
+    params: PolicyParams
+    origin_fqn: str
+
+
+@dataclass
+class CompiledResourceRule:
+    actions: tuple[str, ...]
+    roles: tuple[str, ...]
+    derived_roles: tuple[str, ...]
+    effect: str
+    name: str
+    condition: Optional[CompiledCondition] = None
+    output: Optional[CompiledOutput] = None
+
+
+@dataclass
+class CompiledResourcePolicy:
+    fqn: str
+    resource: str  # sanitized
+    raw_resource: str
+    version: str
+    scope: str
+    scope_permissions: str
+    params: PolicyParams
+    rules: list[CompiledResourceRule]
+    derived_roles: dict[str, CompiledDerivedRole]
+    schemas: Optional[model.Schemas] = None
+    source_attributes: dict[str, Any] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    kind: str = "RESOURCE"
+
+
+@dataclass
+class CompiledPrincipalRule:
+    resource: str  # raw (may be a glob)
+    action: str
+    effect: str
+    name: str
+    condition: Optional[CompiledCondition] = None
+    output: Optional[CompiledOutput] = None
+
+
+@dataclass
+class CompiledPrincipalPolicy:
+    fqn: str
+    principal: str
+    version: str
+    scope: str
+    scope_permissions: str
+    params: PolicyParams
+    rules: list[CompiledPrincipalRule]
+    source_attributes: dict[str, Any] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    kind: str = "PRINCIPAL"
+
+
+@dataclass
+class CompiledRoleRule:
+    resource: str
+    allow_actions: frozenset[str]
+    name: str
+    condition: Optional[CompiledCondition] = None
+    output: Optional[CompiledOutput] = None
+
+
+@dataclass
+class CompiledRolePolicy:
+    fqn: str
+    role: str
+    version: str
+    scope: str
+    parent_roles: tuple[str, ...]
+    params: PolicyParams
+    rules: list[CompiledRoleRule]  # flattened (resource, rule) pairs keep proto order
+    source_attributes: dict[str, Any] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    kind: str = "ROLE"
+
+
+CompiledPolicy = CompiledResourcePolicy | CompiledPrincipalPolicy | CompiledRolePolicy
+
+
+class _Ctx:
+    def __init__(self, repo: dict[str, model.Policy], source: str):
+        self.repo = repo
+        self.source = source
+        self.errors: list[str] = []
+
+    def err(self, msg: str) -> None:
+        self.errors.append(f"{self.source}: {msg}" if self.source else msg)
+
+
+def _compile_expr(src: str, ctx: _Ctx, where: str) -> Optional[CompiledExpr]:
+    try:
+        node = cel_parse(src)
+        cel_check(node)
+        return CompiledExpr(original=src, node=node)
+    except CelParseError as e:
+        ctx.err(f"{where}: invalid expression {src!r}: {e}")
+        return None
+
+
+def _compile_match(m: model.Match, ctx: _Ctx, where: str) -> Optional[CompiledCondition]:
+    if m.expr is not None:
+        ce = _compile_expr(m.expr, ctx, where)
+        return CompiledCondition(kind="expr", expr=ce) if ce else None
+    for kind in ("all", "any", "none"):
+        children = getattr(m, kind)
+        if children is not None:
+            compiled = [_compile_match(c, ctx, where) for c in children]
+            if any(c is None for c in compiled):
+                return None
+            return CompiledCondition(kind=kind, children=tuple(compiled))  # type: ignore[arg-type]
+    ctx.err(f"{where}: empty match")
+    return None
+
+
+def _compile_condition(c: Optional[model.Condition], ctx: _Ctx, where: str) -> Optional[CompiledCondition]:
+    if c is None:
+        return None
+    if c.script is not None:
+        ctx.err(f"{where}: script conditions are not supported")
+        return None
+    if c.match is None:
+        ctx.err(f"{where}: condition must define match")
+        return None
+    return _compile_match(c.match, ctx, where)
+
+
+def _compile_output(o: Optional[model.Output], ctx: _Ctx, where: str) -> Optional[CompiledOutput]:
+    if o is None:
+        return None
+    rule_activated = None
+    condition_not_met = None
+    if o.when is not None:
+        if o.when.rule_activated:
+            rule_activated = _compile_expr(o.when.rule_activated, ctx, f"{where}.output.when.ruleActivated")
+        if o.when.condition_not_met:
+            condition_not_met = _compile_expr(o.when.condition_not_met, ctx, f"{where}.output.when.conditionNotMet")
+    elif o.expr:
+        # deprecated output.expr is an alias for when.ruleActivated
+        rule_activated = _compile_expr(o.expr, ctx, f"{where}.output.expr")
+    if rule_activated is None and condition_not_met is None:
+        return None
+    return CompiledOutput(rule_activated=rule_activated, condition_not_met=condition_not_met)
+
+
+def _resolve_constants(c: Optional[model.Constants], ctx: _Ctx) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    if c is None:
+        return out
+    for imp in c.import_:
+        fqn = namer.export_constants_fqn(imp)
+        pol = ctx.repo.get(fqn)
+        if pol is None or pol.export_constants is None:
+            ctx.err(f"imported constants {imp!r} ({fqn}) not found")
+            continue
+        for k, v in pol.export_constants.definitions.items():
+            out[k] = normalize_attr(v)
+    for k, v in c.local.items():
+        out[k] = normalize_attr(v)
+    return out
+
+
+def _variable_refs(node: cel_ast.Node) -> set[str]:
+    """Names referenced as variables.X / V.X inside an expression."""
+    refs: set[str] = set()
+    for n in cel_ast.walk(node):
+        if isinstance(n, cel_ast.Select) and isinstance(n.operand, cel_ast.Ident):
+            if n.operand.name in ("variables", "V"):
+                refs.add(n.field)
+        elif isinstance(n, cel_ast.Index) and isinstance(n.operand, cel_ast.Ident):
+            if n.operand.name in ("variables", "V") and isinstance(n.index, cel_ast.Lit) and isinstance(n.index.value, str):
+                refs.add(n.index.value)
+    return refs
+
+
+def _resolve_variables(
+    v: Optional[model.Variables],
+    deprecated_top_level: dict[str, str],
+    ctx: _Ctx,
+) -> tuple[CompiledVariable, ...]:
+    defs: dict[str, str] = {}
+    if v is not None:
+        for imp in v.import_:
+            fqn = namer.export_variables_fqn(imp)
+            pol = ctx.repo.get(fqn)
+            if pol is None or pol.export_variables is None:
+                ctx.err(f"imported variables {imp!r} ({fqn}) not found")
+                continue
+            defs.update(pol.export_variables.definitions)
+    # deprecated top-level policy.variables map merges under local
+    defs.update(deprecated_top_level)
+    if v is not None:
+        defs.update(v.local)
+
+    compiled: dict[str, CompiledVariable] = {}
+    deps: dict[str, set[str]] = {}
+    for name, src in defs.items():
+        ce = _compile_expr(src, ctx, f"variable {name}")
+        if ce is None:
+            continue
+        compiled[name] = CompiledVariable(name=name, expr=ce)
+        deps[name] = _variable_refs(ce.node) & set(defs.keys())
+
+    # topological order (ref: internal/compile/variables.go sortVariables)
+    ordered: list[CompiledVariable] = []
+    state: dict[str, int] = {}  # 0=unvisited 1=visiting 2=done
+
+    def visit(name: str, chain: list[str]) -> None:
+        st = state.get(name, 0)
+        if st == 2:
+            return
+        if st == 1:
+            ctx.err(f"circular dependency between variables: {' -> '.join(chain + [name])}")
+            return
+        state[name] = 1
+        for dep in sorted(deps.get(name, ())):
+            if dep in compiled:
+                visit(dep, chain + [name])
+        state[name] = 2
+        ordered.append(compiled[name])
+
+    for name in defs:
+        if name in compiled:
+            visit(name, [])
+
+    return tuple(ordered)
+
+
+def _params(
+    variables: Optional[model.Variables],
+    constants: Optional[model.Constants],
+    deprecated_vars: dict[str, str],
+    ctx: _Ctx,
+) -> PolicyParams:
+    return PolicyParams(
+        constants=_resolve_constants(constants, ctx),
+        ordered_variables=_resolve_variables(variables, deprecated_vars, ctx),
+    )
+
+
+def _rule_name(name: str, idx: int) -> str:
+    return name or f"rule-{idx:03d}"
+
+
+def _compile_resource_policy(pol: model.Policy, ctx: _Ctx) -> CompiledResourcePolicy:
+    rp = pol.resource_policy
+    assert rp is not None
+    scope = namer.scope_value(rp.scope)
+    params = _params(rp.variables, rp.constants, pol.variables, ctx)
+
+    # derived roles: merge imported sets (ref: compile/resource.go)
+    derived_roles: dict[str, CompiledDerivedRole] = {}
+    for imp in rp.import_derived_roles:
+        fqn = namer.derived_roles_fqn(imp)
+        dr_pol = ctx.repo.get(fqn)
+        if dr_pol is None or dr_pol.derived_roles is None:
+            ctx.err(f"imported derived roles {imp!r} ({fqn}) not found")
+            continue
+        dr = dr_pol.derived_roles
+        dr_params = _params(dr.variables, dr.constants, dr_pol.variables, ctx)
+        for d in dr.definitions:
+            if d.name in derived_roles:
+                ctx.err(f"duplicate derived role definition {d.name!r}")
+                continue
+            derived_roles[d.name] = CompiledDerivedRole(
+                name=d.name,
+                parent_roles=frozenset(d.parent_roles),
+                condition=_compile_condition(d.condition, ctx, f"derived role {d.name}"),
+                params=dr_params,
+                origin_fqn=fqn,
+            )
+
+    rules = []
+    for i, r in enumerate(rp.rules, start=1):
+        for dr_name in r.derived_roles:
+            if dr_name not in derived_roles:
+                ctx.err(f"rule references unknown derived role {dr_name!r}")
+        rules.append(
+            CompiledResourceRule(
+                actions=tuple(r.actions),
+                roles=tuple(r.roles),
+                derived_roles=tuple(d for d in r.derived_roles if d in derived_roles),
+                effect=r.effect,
+                name=_rule_name(r.name, i),
+                condition=_compile_condition(r.condition, ctx, f"rule {_rule_name(r.name, i)}"),
+                output=_compile_output(r.output, ctx, f"rule {_rule_name(r.name, i)}"),
+            )
+        )
+
+    meta = pol.metadata or model.Metadata()
+    return CompiledResourcePolicy(
+        fqn=pol.fqn(),
+        resource=namer.sanitize(rp.resource),
+        raw_resource=rp.resource,
+        version=rp.version,
+        scope=scope,
+        scope_permissions=rp.scope_permissions,
+        params=params,
+        rules=rules,
+        derived_roles=derived_roles,
+        schemas=rp.schemas,
+        source_attributes=dict(meta.source_attributes),
+        annotations=dict(meta.annotations),
+    )
+
+
+def _compile_principal_policy(pol: model.Policy, ctx: _Ctx) -> CompiledPrincipalPolicy:
+    pp = pol.principal_policy
+    assert pp is not None
+    params = _params(pp.variables, pp.constants, pol.variables, ctx)
+    rules: list[CompiledPrincipalRule] = []
+    idx = 0
+    for r in pp.rules:
+        for a in r.actions:
+            idx += 1
+            name = _rule_name(a.name, idx)
+            rules.append(
+                CompiledPrincipalRule(
+                    resource=r.resource,
+                    action=a.action,
+                    effect=a.effect,
+                    name=name,
+                    condition=_compile_condition(a.condition, ctx, f"rule {name}"),
+                    output=_compile_output(a.output, ctx, f"rule {name}"),
+                )
+            )
+    meta = pol.metadata or model.Metadata()
+    return CompiledPrincipalPolicy(
+        fqn=pol.fqn(),
+        principal=pp.principal,
+        version=pp.version,
+        scope=namer.scope_value(pp.scope),
+        scope_permissions=pp.scope_permissions,
+        params=params,
+        rules=rules,
+        source_attributes=dict(meta.source_attributes),
+        annotations=dict(meta.annotations),
+    )
+
+
+def _compile_role_policy(pol: model.Policy, ctx: _Ctx) -> CompiledRolePolicy:
+    rp = pol.role_policy
+    assert rp is not None
+    params = _params(rp.variables, rp.constants, pol.variables, ctx)
+    rules = []
+    for i, r in enumerate(rp.rules):
+        rules.append(
+            CompiledRoleRule(
+                resource=r.resource,
+                allow_actions=frozenset(r.allow_actions),
+                name=r.name or f"{rp.role}_rule-{i:03d}",
+                condition=_compile_condition(r.condition, ctx, f"role rule {i}"),
+                output=_compile_output(r.output, ctx, f"role rule {i}"),
+            )
+        )
+    meta = pol.metadata or model.Metadata()
+    return CompiledRolePolicy(
+        fqn=pol.fqn(),
+        role=rp.role,
+        version=rp.version or namer.DEFAULT_VERSION,
+        scope=namer.scope_value(rp.scope),
+        parent_roles=tuple(rp.parent_roles),
+        params=params,
+        rules=rules,
+        source_attributes=dict(meta.source_attributes),
+        annotations=dict(meta.annotations),
+    )
+
+
+def compile_policy(pol: model.Policy, repo: dict[str, model.Policy]) -> CompiledPolicy:
+    """Compile a single policy against a repo of policies (for imports)."""
+    source = (pol.metadata.source_file if pol.metadata else "") or pol.fqn()
+    ctx = _Ctx(repo, source)
+    kind = pol.kind
+    result: Optional[CompiledPolicy] = None
+    if kind == model.KIND_RESOURCE:
+        result = _compile_resource_policy(pol, ctx)
+    elif kind == model.KIND_PRINCIPAL:
+        result = _compile_principal_policy(pol, ctx)
+    elif kind == model.KIND_ROLE_POLICY:
+        result = _compile_role_policy(pol, ctx)
+    else:
+        raise CompileError([f"{source}: policy kind {kind} is not directly compilable"])
+    if ctx.errors:
+        raise CompileError(ctx.errors)
+    return result
+
+
+def compile_policy_set(policies: list[model.Policy]) -> list[CompiledPolicy]:
+    """Compile all directly-runnable policies in the set; derived-roles and
+    export policies act as imports only. Disabled policies are skipped."""
+    repo = {p.fqn(): p for p in policies if not p.disabled}
+    out: list[CompiledPolicy] = []
+    errors: list[str] = []
+    for p in policies:
+        if p.disabled:
+            continue
+        if p.kind in (model.KIND_RESOURCE, model.KIND_PRINCIPAL, model.KIND_ROLE_POLICY):
+            try:
+                out.append(compile_policy(p, repo))
+            except CompileError as e:
+                errors.extend(e.errors)
+    if errors:
+        raise CompileError(errors)
+    return out

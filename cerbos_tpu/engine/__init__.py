@@ -1,0 +1,12 @@
+from .types import (  # noqa: F401
+    ActionEffect,
+    AuxData,
+    CheckInput,
+    CheckOutput,
+    EvalParams,
+    OutputEntry,
+    Principal,
+    Resource,
+    ValidationError,
+)
+from .engine import Engine  # noqa: F401

@@ -1,0 +1,53 @@
+"""Engine facade: batch dispatch over the rule table.
+
+Behavioral reference: internal/engine/engine.go (Check entry, audit hook).
+The reference fans small batches onto a goroutine pool; here the batch path
+is the TPU evaluator (cerbos_tpu.tpu) and the CPU oracle serves small
+batches serially, mirroring the reference's parallelismThreshold=5 split.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Callable, Optional, Sequence
+
+from ..ruletable import RuleTable, build_rule_table, check_input
+from . import types as T
+
+if TYPE_CHECKING:  # avoid a circular import with cerbos_tpu.compile
+    from ..compile.compiler import CompiledPolicy
+
+
+class Engine:
+    def __init__(
+        self,
+        rule_table: RuleTable,
+        schema_mgr: Any = None,
+        eval_params: Optional[T.EvalParams] = None,
+        tpu_evaluator: Any = None,
+        tpu_batch_threshold: int = 5,
+        on_decision: Optional[Callable[[list[T.CheckInput], list[T.CheckOutput]], None]] = None,
+    ):
+        self.rule_table = rule_table
+        self.schema_mgr = schema_mgr
+        self.eval_params = eval_params or T.EvalParams()
+        self.tpu_evaluator = tpu_evaluator
+        self.tpu_batch_threshold = tpu_batch_threshold
+        self.on_decision = on_decision
+
+    @classmethod
+    def from_policies(cls, policies: "list[CompiledPolicy]", **kwargs) -> "Engine":
+        return cls(build_rule_table(policies), **kwargs)
+
+    def check(
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+    ) -> list[T.CheckOutput]:
+        params = params or self.eval_params
+        if self.tpu_evaluator is not None and len(inputs) >= self.tpu_batch_threshold:
+            outputs = self.tpu_evaluator.check(list(inputs), params)
+        else:
+            outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
+        if self.on_decision is not None:
+            self.on_decision(list(inputs), outputs)
+        return outputs

@@ -1,0 +1,118 @@
+"""Engine request/response types.
+
+Behavioral reference: api/public/cerbos/engine/v1/engine.proto (CheckInput,
+CheckOutput, Principal, Resource, AuxData) and internal/evaluator (EvalParams,
+CheckOpts). Attribute values follow protobuf Struct semantics: JSON numbers
+become doubles at ingestion so CEL sees the same types as the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..util import normalize_attr
+
+EFFECT_ALLOW = "EFFECT_ALLOW"
+EFFECT_DENY = "EFFECT_DENY"
+EFFECT_NO_MATCH = "EFFECT_NO_MATCH"
+
+NO_POLICY_MATCH = "NO_MATCH"
+NO_MATCH_SCOPE_PERMISSIONS = "NO_MATCH_FOR_SCOPE_PERMISSIONS"
+
+KIND_PRINCIPAL = "PRINCIPAL"
+KIND_RESOURCE = "RESOURCE"
+
+
+@dataclass
+class Principal:
+    id: str
+    roles: list[str]
+    attr: dict[str, Any] = field(default_factory=dict)
+    policy_version: str = ""
+    scope: str = ""
+
+    def __post_init__(self) -> None:
+        self.attr = {k: normalize_attr(v) for k, v in self.attr.items()}
+
+
+@dataclass
+class Resource:
+    kind: str
+    id: str = ""
+    attr: dict[str, Any] = field(default_factory=dict)
+    policy_version: str = ""
+    scope: str = ""
+
+    def __post_init__(self) -> None:
+        self.attr = {k: normalize_attr(v) for k, v in self.attr.items()}
+
+
+@dataclass
+class AuxData:
+    jwt: dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.jwt = {k: normalize_attr(v) for k, v in self.jwt.items()}
+
+
+@dataclass
+class CheckInput:
+    principal: Principal
+    resource: Resource
+    actions: list[str]
+    request_id: str = ""
+    aux_data: Optional[AuxData] = None
+
+
+@dataclass
+class ActionEffect:
+    effect: str
+    policy: str
+    scope: str = ""
+
+
+@dataclass
+class OutputEntry:
+    src: str
+    action: str = ""
+    val: Any = None
+    error: str = ""
+
+
+@dataclass
+class ValidationError:
+    path: str
+    message: str
+    source: str  # SOURCE_PRINCIPAL | SOURCE_RESOURCE
+
+
+@dataclass
+class CheckOutput:
+    request_id: str
+    resource_id: str
+    actions: dict[str, ActionEffect] = field(default_factory=dict)
+    effective_derived_roles: list[str] = field(default_factory=list)
+    validation_errors: list[ValidationError] = field(default_factory=list)
+    outputs: list[OutputEntry] = field(default_factory=list)
+
+
+@dataclass
+class EvalParams:
+    """Ref: internal/evaluator/evaluator.go:91-97."""
+
+    globals: dict[str, Any] = field(default_factory=dict)
+    now_fn: Optional[Callable[[], Any]] = None
+    default_policy_version: str = "default"
+    default_scope: str = ""
+    lenient_scope_search: bool = False
+
+
+def effective_scope(scope: str, params: EvalParams) -> str:
+    if scope == "":
+        scope = params.default_scope
+    return scope[1:] if scope.startswith(".") else scope
+
+
+def effective_version(version: str, params: EvalParams) -> str:
+    return version or params.default_policy_version

@@ -1,0 +1,4 @@
+from .rows import RuleRow, rows_from_policy  # noqa: F401
+from .index import Index  # noqa: F401
+from .table import RuleTable, build_rule_table  # noqa: F401
+from .check import check_input  # noqa: F401

@@ -1,0 +1,472 @@
+"""Dimension index over rule rows with glob dims and role-policy synthesis.
+
+Behavioral reference: internal/ruletable/index (bitmap index with exact dims
+for scope/version/policyKind/principal and glob dims for role/action/resource;
+query = AND of dimension sets; synthetic role-policy DENY bindings generated
+at query time, index.go:305-515). Sets of integer row IDs stand in for the
+reference's hierarchical bitmaps; the TPU lowering packs these into dense
+mask tensors instead.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .. import globs, namer
+from ..compile import CompiledCondition
+from .rows import (
+    EFFECT_DENY,
+    EFFECT_UNSPECIFIED,
+    KIND_PRINCIPAL,
+    KIND_RESOURCE,
+    RuleRow,
+)
+from ..compile.compiler import CompiledOutput
+from ..policy.model import SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT
+
+
+class _GlobDim:
+    """Literal + glob pattern buckets (ref: index/glob_dimension.go)."""
+
+    __slots__ = ("literals", "globs", "_cache")
+
+    def __init__(self) -> None:
+        self.literals: dict[str, set[int]] = {}
+        self.globs: dict[str, set[int]] = {}
+        self._cache: dict[str, frozenset[int]] = {}
+
+    def add(self, value: str, rid: int) -> None:
+        bucket = self.globs if globs.is_glob(value) or value == "*" else self.literals
+        bucket.setdefault(value, set()).add(rid)
+        self._cache.clear()
+
+    def remove(self, value: str, rid: int) -> None:
+        bucket = self.globs if globs.is_glob(value) or value == "*" else self.literals
+        ids = bucket.get(value)
+        if ids is not None:
+            ids.discard(rid)
+            if not ids:
+                del bucket[value]
+        self._cache.clear()
+
+    def query(self, value: str) -> frozenset[int]:
+        hit = self._cache.get(value)
+        if hit is not None:
+            return hit
+        out: set[int] = set()
+        lit = self.literals.get(value)
+        if lit:
+            out |= lit
+        for pat, ids in self.globs.items():
+            if globs.matches_glob(pat, value):
+                out |= ids
+        res = frozenset(out)
+        if len(self._cache) > 65536:
+            self._cache.clear()
+        self._cache[value] = res
+        return res
+
+    def query_multiple(self, values: Iterable[str]) -> frozenset[int]:
+        out: set[int] = set()
+        for v in values:
+            out |= self.query(v)
+        return frozenset(out)
+
+
+class Index:
+    def __init__(self) -> None:
+        self.rows: list[Optional[RuleRow]] = []
+        self._free_ids: list[int] = []
+        self.scope: dict[str, set[int]] = {}
+        self.version: dict[str, set[int]] = {}
+        self.policy_kind: dict[str, set[int]] = {}
+        self.principal: dict[str, set[int]] = {}
+        self.resource = _GlobDim()
+        self.role = _GlobDim()
+        self.action = _GlobDim()
+        self.allow_actions_ids: set[int] = set()
+        self.fqn_ids: dict[str, set[int]] = {}
+        # scope -> role -> transitive parent roles (ref: index.go:729-773)
+        self.parent_roles: dict[str, dict[str, list[str]]] = {}
+        self._raw_parent_roles: dict[str, dict[str, list[str]]] = {}
+        self._parent_roles_dirty = False
+
+    # -- building ---------------------------------------------------------
+
+    def index_rules(self, rules: list[RuleRow]) -> None:
+        for row in rules:
+            rid = self._free_ids.pop() if self._free_ids else len(self.rows)
+            row.id = rid
+            if rid == len(self.rows):
+                self.rows.append(row)
+            else:
+                self.rows[rid] = row
+            self.scope.setdefault(row.scope, set()).add(rid)
+            self.version.setdefault(row.version, set()).add(rid)
+            self.policy_kind.setdefault(row.policy_kind, set()).add(rid)
+            if row.principal:
+                self.principal.setdefault(row.principal, set()).add(rid)
+            if row.resource:
+                self.resource.add(row.resource, rid)
+            if row.role:
+                self.role.add(row.role, rid)
+            if row.action is not None:
+                self.action.add(row.action, rid)
+            if row.allow_actions is not None:
+                self.allow_actions_ids.add(rid)
+            self.fqn_ids.setdefault(row.origin_fqn, set()).add(rid)
+
+    def delete_policy(self, fqn: str) -> None:
+        ids = self.fqn_ids.pop(fqn, None)
+        if not ids:
+            return
+        for rid in ids:
+            row = self.rows[rid]
+            if row is None:
+                continue
+            self.rows[rid] = None
+            self._free_ids.append(rid)
+            for dim, key in ((self.scope, row.scope), (self.version, row.version), (self.policy_kind, row.policy_kind)):
+                s = dim.get(key)
+                if s is not None:
+                    s.discard(rid)
+                    if not s:
+                        del dim[key]
+            if row.principal:
+                s = self.principal.get(row.principal)
+                if s is not None:
+                    s.discard(rid)
+                    if not s:
+                        del self.principal[row.principal]
+            if row.resource:
+                self.resource.remove(row.resource, rid)
+            if row.role:
+                self.role.remove(row.role, rid)
+            if row.action is not None:
+                self.action.remove(row.action, rid)
+            self.allow_actions_ids.discard(rid)
+
+    def index_parent_roles(self, scope_parent_roles: dict[str, dict[str, list[str]]]) -> None:
+        """Record parent-role definitions; the transitive closure is computed
+        lazily on first use (ingest runs once per policy, so recomputing the
+        closure eagerly would make table builds quadratic)."""
+        self._raw_parent_roles = scope_parent_roles
+        self._parent_roles_dirty = True
+
+    def _compile_parent_roles(self, scope_parent_roles: dict[str, dict[str, list[str]]]) -> None:
+        compiled: dict[str, dict[str, list[str]]] = {}
+        for scope, role_parents in scope_parent_roles.items():
+            compiled[scope] = {}
+            for role in role_parents:
+                parents: set[str] = set()
+                visited: set[str] = set()
+
+                def collect(r: str) -> None:
+                    if r in visited:
+                        return
+                    visited.add(r)
+                    for pr in role_parents.get(r, ()):
+                        parents.add(pr)
+                        collect(pr)
+
+                collect(role)
+                compiled[scope][role] = sorted(parents)
+        self.parent_roles = compiled
+
+    # -- queries ----------------------------------------------------------
+
+    def add_parent_roles(self, scopes: list[str], roles: list[str]) -> list[str]:
+        """roles + union of their transitive parent roles across scopes
+        (ref: index.go:700-727; result order: originals then parents)."""
+        if self._parent_roles_dirty:
+            self._compile_parent_roles(self._raw_parent_roles)
+            self._parent_roles_dirty = False
+        if not self.parent_roles:
+            return roles
+        merged: dict[str, list[str]] = {}
+        for scope in scopes:
+            c = self.parent_roles.get(scope)
+            if not c:
+                continue
+            for role, parents in c.items():
+                merged.setdefault(role, []).extend(parents)
+        if not merged:
+            return roles
+        result = list(roles)
+        for role in roles:
+            result.extend(merged.get(role, ()))
+        return result
+
+    def scoped_principal_exists(self, version: str, scopes: list[str]) -> bool:
+        if not scopes:
+            return False
+        v = self.version.get(version)
+        k = self.policy_kind.get(KIND_PRINCIPAL)
+        if not v or not k:
+            return False
+        s: set[int] = set()
+        for sc in scopes:
+            s |= self.scope.get(sc, set())
+        return bool(v & k & s)
+
+    def scoped_resource_exists(self, version: str, resource: str, scopes: list[str]) -> bool:
+        if not scopes:
+            return False
+        v = self.version.get(version)
+        k = self.policy_kind.get(KIND_RESOURCE)
+        if not v or not k:
+            return False
+        s: set[int] = set()
+        for sc in scopes:
+            s |= self.scope.get(sc, set())
+        if not s:
+            return False
+        r = self.resource.query(resource)
+        return bool(v & k & s & r)
+
+    def query(
+        self,
+        version: str,
+        resource: str,
+        scope: str,
+        action: str,
+        roles: list[str],
+        policy_kind: str,
+        principal_id: str,
+    ) -> list[RuleRow]:
+        """Rows matching all dimensions, with role-policy synthetic DENYs
+        prepended (ref: index.go:199-321). Empty/zero args mean match-all."""
+        if not any(r is not None for r in self.rows):
+            return []
+
+        principal_ids: Optional[frozenset[int] | set[int]] = None
+        if principal_id:
+            p = self.principal.get(principal_id)
+            if not p:
+                return []
+            principal_ids = p
+
+        scope_ids = self.scope.get(scope)
+        if scope_ids is None:
+            return []
+
+        dims: list[set[int] | frozenset[int]] = [scope_ids]
+        if version:
+            v = self.version.get(version)
+            if not v:
+                return []
+            dims.append(v)
+        resource_ids: Optional[frozenset[int]] = None
+        if resource:
+            resource_ids = self.resource.query(resource)
+            if not resource_ids:
+                return []
+            dims.append(resource_ids)
+        role_ids: Optional[frozenset[int]] = None
+        if roles:
+            role_ids = self.role.query_multiple(roles)
+            if not role_ids:
+                return []
+            dims.append(role_ids)
+        if policy_kind:
+            k = self.policy_kind.get(policy_kind)
+            if not k:
+                return []
+            dims.append(k)
+        if principal_ids is not None:
+            dims.append(principal_ids)
+
+        base = set(dims[0])
+        for d in dims[1:]:
+            base &= d
+            if not base:
+                return []
+
+        result_ids: set[int] = set()
+        if action:
+            action_ids = self.action.query(action)
+            if action_ids:
+                result_ids = base & action_ids
+        else:
+            result_ids = base
+
+        out: list[RuleRow] = []
+        # synthetic role-policy DENYs come first (index.go:303-307)
+        if action and resource and policy_kind == KIND_RESOURCE and self.allow_actions_ids:
+            self._append_role_policy_denies(
+                [resource], roles, [action],
+                version_ids=self.version.get(version) if version else None,
+                scope_ids=scope_ids,
+                role_ids=role_ids,
+                out=out,
+            )
+
+        for rid in sorted(result_ids):
+            row = self.rows[rid]
+            if row is not None:
+                out.append(row)
+        return out
+
+    def _append_role_policy_denies(
+        self,
+        resources: list[str],
+        roles: list[str],
+        target_actions: list[str],
+        version_ids: Optional[set[int]],
+        scope_ids: Optional[set[int]],
+        role_ids: Optional[frozenset[int]],
+        out: list[RuleRow],
+    ) -> None:
+        """Ref: index.go:337-515."""
+        candidate = set(self.allow_actions_ids)
+        if version_ids is not None:
+            candidate &= version_ids
+        if scope_ids is not None:
+            candidate &= scope_ids
+        if role_ids is not None:
+            candidate &= role_ids
+        if not candidate:
+            return
+
+        role_policy_rep: dict[str, RuleRow] = {}
+        role_order: list[str] = []
+        for rid in sorted(candidate):
+            b = self.rows[rid]
+            if b is None:
+                continue
+            if b.role not in role_policy_rep:
+                role_policy_rep[b.role] = b
+                role_order.append(b.role)
+
+        if not roles:
+            roles = role_order
+
+        for resource in resources:
+            res_ids = self.resource.query(resource)
+            resource_matched = (candidate & res_ids) if res_ids else set()
+            matched_by_role: dict[str, list[RuleRow]] = {}
+            for rid in sorted(resource_matched):
+                b = self.rows[rid]
+                if b is not None:
+                    matched_by_role.setdefault(b.role, []).append(b)
+
+            resource_actions = target_actions
+            if not resource_actions:
+                resource_actions = self._collect_resource_actions(res_ids, version_ids, scope_ids)
+                if not resource_actions:
+                    continue
+
+            for role in roles:
+                rep = role_policy_rep.get(role)
+                if rep is None:
+                    continue
+                role_bindings = matched_by_role.get(role, [])
+                if not role_bindings:
+                    # role policy exists, but doesn't cover this resource
+                    for action in resource_actions:
+                        out.append(_no_match_role_policy_deny(role, rep.version, rep.scope, resource, action))
+                    continue
+
+                for action in resource_actions:
+                    matched = [
+                        rb
+                        for rb in role_bindings
+                        if any(a == action or globs.matches_glob(a, action) for a in (rb.allow_actions or ()))
+                    ]
+                    if not matched:
+                        rb0 = role_bindings[0]
+                        out.append(_no_match_role_policy_deny(role, rb0.version, rb0.scope, rb0.resource, action))
+                        continue
+                    for mb in matched:
+                        if mb.condition is None:
+                            # pure ACL allow falls through; keep its output via
+                            # a no-effect binding (index.go:449-470)
+                            if mb.emit_output is not None:
+                                out.append(
+                                    RuleRow(
+                                        origin_fqn=mb.origin_fqn,
+                                        scope=mb.scope,
+                                        version=mb.version,
+                                        policy_kind=KIND_RESOURCE,
+                                        resource=mb.resource,
+                                        role=mb.role,
+                                        action=action,
+                                        emit_output=mb.emit_output,
+                                        name=mb.name,
+                                        params=mb.params,
+                                        from_role_policy=True,
+                                        id=mb.id,
+                                    )
+                                )
+                            continue
+                        # conditional allow → synthetic DENY on the negated
+                        # condition, with outputs swapped (index.go:472-509)
+                        emit_output = None
+                        if mb.emit_output is not None:
+                            emit_output = CompiledOutput(
+                                rule_activated=mb.emit_output.condition_not_met,
+                                condition_not_met=mb.emit_output.rule_activated,
+                            )
+                        out.append(
+                            RuleRow(
+                                origin_fqn=mb.origin_fqn,
+                                scope=mb.scope,
+                                version=mb.version,
+                                policy_kind=KIND_RESOURCE,
+                                resource=mb.resource,
+                                role=mb.role,
+                                action=action,
+                                effect=EFFECT_DENY,
+                                condition=CompiledCondition(kind="none", children=(mb.condition,)),
+                                emit_output=emit_output,
+                                scope_permissions=SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT,
+                                name=mb.name,
+                                params=mb.params,
+                                from_role_policy=True,
+                                id=mb.id,
+                            )
+                        )
+
+    def _collect_resource_actions(
+        self,
+        res_ids: frozenset[int],
+        version_ids: Optional[set[int]],
+        scope_ids: Optional[set[int]],
+    ) -> list[str]:
+        if not res_ids:
+            return []
+        ids = set(res_ids)
+        if version_ids is not None:
+            ids &= version_ids
+        if scope_ids is not None:
+            ids &= scope_ids
+        actions: set[str] = set()
+        for rid in ids:
+            b = self.rows[rid]
+            if b is None or b.policy_kind == KIND_PRINCIPAL:
+                continue
+            if b.action is not None:
+                actions.add(b.action)
+            for a in b.allow_actions or ():
+                actions.add(a)
+        return sorted(actions)
+
+    def get_all_rows(self) -> list[RuleRow]:
+        return [r for r in self.rows if r is not None]
+
+
+def _no_match_role_policy_deny(role: str, version: str, scope: str, resource: str, action: str) -> RuleRow:
+    """Ref: index.go:567-583."""
+    return RuleRow(
+        origin_fqn=namer.role_policy_fqn(role, version, scope),
+        scope=scope,
+        version=version,
+        policy_kind=KIND_RESOURCE,
+        resource=resource,
+        role=role,
+        action=action,
+        effect=EFFECT_DENY,
+        from_role_policy=True,
+        no_match_for_scope_permissions=True,
+        id=-1,
+    )

@@ -1,0 +1,236 @@
+"""Flattening compiled policies into rule-table rows.
+
+Behavioral reference: internal/ruletable/ruletable.go:91-441 —
+addResourcePolicy (derived-role rows expanded per parent role, carrying the
+derived-role condition), addPrincipalPolicy (role ``*``), addRolePolicy
+(AllowActions rows), noop rows for empty policies, and the
+REQUIRE_PARENTAL_CONSENT allow→DENY(none(condition)) rewrite.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .. import namer
+from ..compile import (
+    CompiledCondition,
+    CompiledOutput,
+    CompiledPolicy,
+    CompiledPrincipalPolicy,
+    CompiledResourcePolicy,
+    CompiledRolePolicy,
+    PolicyParams,
+)
+from ..policy.model import (
+    SCOPE_PERMISSIONS_OVERRIDE_PARENT,
+    SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT,
+    SCOPE_PERMISSIONS_UNSPECIFIED,
+)
+
+EFFECT_ALLOW = "EFFECT_ALLOW"
+EFFECT_DENY = "EFFECT_DENY"
+EFFECT_UNSPECIFIED = "EFFECT_UNSPECIFIED"
+
+KIND_PRINCIPAL = "PRINCIPAL"
+KIND_RESOURCE = "RESOURCE"
+
+
+@dataclass
+class RuleRow:
+    origin_fqn: str
+    scope: str
+    version: str
+    policy_kind: str
+    resource: str = ""
+    role: str = ""
+    action: Optional[str] = None
+    allow_actions: Optional[frozenset[str]] = None
+    condition: Optional[CompiledCondition] = None
+    derived_role_condition: Optional[CompiledCondition] = None
+    effect: str = EFFECT_UNSPECIFIED
+    scope_permissions: str = SCOPE_PERMISSIONS_UNSPECIFIED
+    origin_derived_role: str = ""
+    emit_output: Optional[CompiledOutput] = None
+    name: str = ""
+    principal: str = ""
+    params: Optional[PolicyParams] = None
+    derived_role_params: Optional[PolicyParams] = None
+    evaluation_key: str = ""
+    from_role_policy: bool = False
+    no_match_for_scope_permissions: bool = False
+    # assigned by the index
+    id: int = -1
+
+    def eval_key(self) -> str:
+        return self.evaluation_key
+
+
+def _negate_rpc_allow(cond: Optional[CompiledCondition], effect: str, raw_scope_permissions: str):
+    """REQUIRE_PARENTAL_CONSENT rewrite (ruletable.go:191-202): a conditional
+    ALLOW becomes DENY-when-not(condition)."""
+    if (
+        raw_scope_permissions == SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT
+        and effect == EFFECT_ALLOW
+        and cond is not None
+    ):
+        return CompiledCondition(kind="none", children=(cond,)), EFFECT_DENY
+    return cond, effect
+
+
+def _defaulted(sp: str) -> str:
+    return SCOPE_PERMISSIONS_OVERRIDE_PARENT if sp == SCOPE_PERMISSIONS_UNSPECIFIED else sp
+
+
+def _resource_policy_rows(p: CompiledResourcePolicy) -> list[RuleRow]:
+    rows: list[RuleRow] = []
+    sp = _defaulted(p.scope_permissions)
+    if not p.rules:
+        # noop row: the policy exists in this scope even with no rules
+        # (ruletable.go:243-258)
+        rows.append(
+            RuleRow(
+                origin_fqn=p.fqn,
+                resource=p.resource,
+                scope=p.scope,
+                scope_permissions=sp,
+                version=p.version,
+                policy_kind=KIND_RESOURCE,
+                params=PolicyParams(),
+                derived_role_params=PolicyParams(),
+            )
+        )
+        return rows
+
+    policy_key = namer.policy_key_from_fqn(p.fqn)
+    for rule in p.rules:
+        rule_fqn = f"{policy_key}#{rule.name}"
+        evaluation_key = f"{p.fqn}#{rule_fqn}"
+        for action in rule.actions:
+            for role in rule.roles:
+                cond, effect = _negate_rpc_allow(rule.condition, rule.effect, p.scope_permissions)
+                rows.append(
+                    RuleRow(
+                        origin_fqn=p.fqn,
+                        resource=p.resource,
+                        role=role,
+                        action=action,
+                        condition=cond,
+                        effect=effect,
+                        scope=p.scope,
+                        scope_permissions=sp,
+                        version=p.version,
+                        emit_output=rule.output,
+                        name=rule.name,
+                        params=p.params,
+                        evaluation_key=evaluation_key,
+                        policy_kind=KIND_RESOURCE,
+                    )
+                )
+            for dr_name in rule.derived_roles:
+                dr = p.derived_roles.get(dr_name)
+                if dr is None:
+                    continue
+                dr_eval_key = f"{namer.derived_roles_fqn(dr_name)}#{rule_fqn}"
+                for parent_role in sorted(dr.parent_roles):
+                    cond, effect = _negate_rpc_allow(rule.condition, rule.effect, p.scope_permissions)
+                    rows.append(
+                        RuleRow(
+                            origin_fqn=p.fqn,
+                            resource=p.resource,
+                            role=parent_role,
+                            action=action,
+                            condition=cond,
+                            derived_role_condition=dr.condition,
+                            effect=effect,
+                            scope=p.scope,
+                            scope_permissions=sp,
+                            version=p.version,
+                            origin_derived_role=dr_name,
+                            emit_output=rule.output,
+                            name=rule.name,
+                            params=p.params,
+                            derived_role_params=dr.params,
+                            evaluation_key=dr_eval_key,
+                            policy_kind=KIND_RESOURCE,
+                        )
+                    )
+    return rows
+
+
+def _principal_policy_rows(p: CompiledPrincipalPolicy) -> list[RuleRow]:
+    rows: list[RuleRow] = []
+    sp = _defaulted(p.scope_permissions)
+    if not p.rules:
+        rows.append(
+            RuleRow(
+                origin_fqn=p.fqn,
+                scope=p.scope,
+                scope_permissions=sp,
+                version=p.version,
+                principal=p.principal,
+                policy_kind=KIND_PRINCIPAL,
+                params=PolicyParams(),
+                derived_role_params=PolicyParams(),
+            )
+        )
+        return rows
+
+    for rule in p.rules:
+        rule_fqn = f"{namer.policy_key_from_fqn(p.fqn)}#{rule.name}"
+        evaluation_key = f"{namer.principal_policy_fqn(p.principal, p.version, p.scope)}#{rule_fqn}"
+        cond, effect = _negate_rpc_allow(rule.condition, rule.effect, p.scope_permissions)
+        rows.append(
+            RuleRow(
+                origin_fqn=p.fqn,
+                resource=namer.sanitize(rule.resource),
+                role="*",  # principal rules are role-agnostic (ruletable.go:163-165)
+                action=rule.action,
+                condition=cond,
+                effect=effect,
+                scope=p.scope,
+                scope_permissions=sp,
+                version=p.version,
+                emit_output=rule.output,
+                name=rule.name,
+                principal=p.principal,
+                params=p.params,
+                evaluation_key=evaluation_key,
+                policy_kind=KIND_PRINCIPAL,
+            )
+        )
+    return rows
+
+
+def _role_policy_rows(p: CompiledRolePolicy) -> list[RuleRow]:
+    rows: list[RuleRow] = []
+    policy_key = namer.policy_key_from_fqn(p.fqn)
+    for idx, rule in enumerate(p.rules):
+        rows.append(
+            RuleRow(
+                origin_fqn=p.fqn,
+                role=p.role,
+                resource=rule.resource,
+                allow_actions=rule.allow_actions,
+                condition=rule.condition,
+                emit_output=rule.output,
+                name=rule.name,
+                scope=p.scope,
+                version=p.version,
+                params=p.params,
+                evaluation_key=f"{policy_key}#{p.role}_rule-{idx:03d}",
+                policy_kind=KIND_RESOURCE,
+                from_role_policy=True,
+            )
+        )
+    return rows
+
+
+def rows_from_policy(p: CompiledPolicy) -> list[RuleRow]:
+    if isinstance(p, CompiledResourcePolicy):
+        return _resource_policy_rows(p)
+    if isinstance(p, CompiledPrincipalPolicy):
+        return _principal_policy_rows(p)
+    if isinstance(p, CompiledRolePolicy):
+        return _role_policy_rows(p)
+    raise TypeError(f"unknown compiled policy type {type(p)}")

@@ -1,0 +1,185 @@
+"""The rule table: indexed rows + scope maps + per-policy metadata.
+
+Behavioral reference: internal/ruletable/ruletable.go:466-933 (RuleTable
+struct, scope maps, scope permissions map, policy derived roles, GetAllScopes,
+CombineScopes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .. import namer
+from ..compile import (
+    CompiledDerivedRole,
+    CompiledPolicy,
+    CompiledPrincipalPolicy,
+    CompiledResourcePolicy,
+    CompiledRolePolicy,
+)
+from ..policy import model
+from .index import Index
+from .rows import KIND_PRINCIPAL, KIND_RESOURCE, RuleRow, rows_from_policy
+
+
+@dataclass
+class PolicyMeta:
+    fqn: str
+    name: str
+    version: str
+    kind: str  # RESOURCE | PRINCIPAL | ROLE
+    source_attributes: dict[str, Any] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+class RuleTable:
+    def __init__(self) -> None:
+        self.idx = Index()
+        self.principal_scope_map: dict[str, bool] = {}
+        self.resource_scope_map: dict[str, bool] = {}
+        self.scope_scope_permissions: dict[str, str] = {}
+        # module_id -> derived role name -> CompiledDerivedRole
+        self.policy_derived_roles: dict[int, dict[str, CompiledDerivedRole]] = {}
+        self.schemas: dict[int, model.Schemas] = {}
+        self.meta: dict[int, PolicyMeta] = {}
+        self.scope_parent_roles: dict[str, dict[str, list[str]]] = {}
+
+    # -- build ------------------------------------------------------------
+
+    def ingest_policy(self, p: CompiledPolicy) -> None:
+        mod_id = namer.module_id(p.fqn)
+        if isinstance(p, CompiledResourcePolicy):
+            self.meta[mod_id] = PolicyMeta(
+                fqn=p.fqn, name=p.resource, version=p.version, kind="RESOURCE",
+                source_attributes=p.source_attributes, annotations=p.annotations,
+            )
+            if p.schemas is not None:
+                self.schemas[mod_id] = p.schemas
+            if p.derived_roles:
+                self.policy_derived_roles[mod_id] = dict(p.derived_roles)
+        elif isinstance(p, CompiledPrincipalPolicy):
+            self.meta[mod_id] = PolicyMeta(
+                fqn=p.fqn, name=p.principal, version=p.version, kind="PRINCIPAL",
+                source_attributes=p.source_attributes, annotations=p.annotations,
+            )
+        elif isinstance(p, CompiledRolePolicy):
+            self.meta[mod_id] = PolicyMeta(
+                fqn=p.fqn, name=p.role, version=p.version, kind="ROLE",
+                source_attributes=p.source_attributes, annotations=p.annotations,
+            )
+            self.scope_parent_roles.setdefault(p.scope, {})[p.role] = list(p.parent_roles)
+
+        rows = rows_from_policy(p)
+        self._index_rows(rows)
+        self.idx.index_parent_roles(self.scope_parent_roles)
+
+    def _index_rows(self, rows: list[RuleRow]) -> None:
+        for row in rows:
+            if row.scope_permissions != model.SCOPE_PERMISSIONS_UNSPECIFIED:
+                self.scope_scope_permissions[row.scope] = row.scope_permissions
+            if row.policy_kind == KIND_PRINCIPAL:
+                self.principal_scope_map[row.scope] = True
+            elif row.policy_kind == KIND_RESOURCE:
+                self.resource_scope_map[row.scope] = True
+        self.idx.index_rules(rows)
+
+    def delete_policy(self, fqn: str) -> None:
+        self.idx.delete_policy(fqn)
+        mod_id = namer.module_id(fqn)
+        self.meta.pop(mod_id, None)
+        self.schemas.pop(mod_id, None)
+        self.policy_derived_roles.pop(mod_id, None)
+        # scope maps/permissions are rebuilt from surviving rows
+        self._rebuild_scope_maps()
+
+    def _rebuild_scope_maps(self) -> None:
+        self.principal_scope_map.clear()
+        self.resource_scope_map.clear()
+        self.scope_scope_permissions.clear()
+        for row in self.idx.get_all_rows():
+            if row.scope_permissions != model.SCOPE_PERMISSIONS_UNSPECIFIED:
+                self.scope_scope_permissions[row.scope] = row.scope_permissions
+            if row.policy_kind == KIND_PRINCIPAL:
+                self.principal_scope_map[row.scope] = True
+            elif row.policy_kind == KIND_RESOURCE:
+                self.resource_scope_map[row.scope] = True
+
+    # -- lookups ----------------------------------------------------------
+
+    def get_derived_roles(self, fqn: str) -> Optional[dict[str, CompiledDerivedRole]]:
+        return self.policy_derived_roles.get(namer.module_id(fqn))
+
+    def get_schema(self, fqn: str) -> Optional[model.Schemas]:
+        return self.schemas.get(namer.module_id(fqn))
+
+    def get_meta(self, fqn: str) -> Optional[PolicyMeta]:
+        return self.meta.get(namer.module_id(fqn))
+
+    def get_scope_scope_permissions(self, scope: str) -> str:
+        return self.scope_scope_permissions.get(scope, model.SCOPE_PERMISSIONS_UNSPECIFIED)
+
+    def get_all_scopes(
+        self, kind: str, scope: str, name: str, version: str, lenient: bool
+    ) -> tuple[list[str], str, str]:
+        """Ref: ruletable.go:814-848. Returns (scopes most-specific-first,
+        first policy key, first FQN)."""
+        if kind == KIND_PRINCIPAL:
+            fqn_fn = namer.principal_policy_fqn
+            scope_map = self.principal_scope_map
+        else:
+            fqn_fn = namer.resource_policy_fqn
+            scope_map = self.resource_scope_map
+
+        first_key = ""
+        first_fqn = ""
+        scopes: list[str] = []
+        if scope in scope_map:
+            first_fqn = fqn_fn(name, version, scope)
+            first_key = namer.policy_key_from_fqn(first_fqn)
+            scopes.append(scope)
+        elif not lenient:
+            return [], "", ""
+
+        for s in namer.scope_parents(scope):
+            if s in scope_map:
+                scopes.append(s)
+                if not first_key:
+                    first_fqn = fqn_fn(name, version, s)
+                    first_key = namer.policy_key_from_fqn(first_fqn)
+
+        return scopes, first_key, first_fqn
+
+    def combine_scopes(self, principal_scopes: list[str], resource_scopes: list[str]) -> list[str]:
+        """Children-first DFS over the union scope tree (ruletable.go:855-906)."""
+        unique = set(principal_scopes) | set(resource_scopes)
+        children: dict[str, dict] = {}
+
+        for scope in unique:
+            if scope == "":
+                continue
+            cur = children
+            parts = scope.split(".")
+            for part in parts:
+                cur = cur.setdefault(part, {})
+
+        result: list[str] = []
+
+        def dfs(node: dict, prefix: str) -> None:
+            for part, sub in node.items():
+                full = f"{prefix}.{part}" if prefix else part
+                dfs(sub, full)
+                if full in unique:
+                    result.append(full)
+
+        dfs(children, "")
+        if "" in unique:
+            result.append("")
+        return result
+
+
+def build_rule_table(policies: list[CompiledPolicy]) -> RuleTable:
+    rt = RuleTable()
+    for p in policies:
+        rt.ingest_policy(p)
+    return rt

@@ -1,0 +1,26 @@
+"""Shared utilities with no intra-package dependencies."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def normalize_attr(v: Any) -> Any:
+    """structpb.Value semantics: JSON numbers are doubles, maps/lists recurse.
+
+    The reference receives attributes as google.protobuf.Value where every
+    JSON number is a double; CEL cross-type numeric comparison makes
+    ``attr.count == 1`` work. Normalizing at ingestion keeps the CPU oracle
+    and the TPU lowering bit-compatible with that behavior.
+    """
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int):
+        return float(v)
+    if isinstance(v, float):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [normalize_attr(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): normalize_attr(x) for k, x in v.items()}
+    return v

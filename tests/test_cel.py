@@ -331,3 +331,31 @@ class TestReviewRegressions:
             ev("{1: 'a'}[true]")
         assert ev("true in {1: 'a'}") is False
         assert ev("1 in {1: 'a'}") is True
+
+
+class TestReviewRegressions2:
+    def test_timestamp_overflow_is_cel_error(self):
+        # malformed attribute values must fail the condition, not crash
+        with pytest.raises(CelError):
+            ev("timestamp(999999999999999)")
+        with pytest.raises(CelError):
+            ev("timestamp('9999-12-31T23:59:59Z') + duration('100000h')")
+        with pytest.raises(CelError):
+            ev("duration(99999999999999999)")
+        # absorbed by ||
+        assert ev("true || timestamp(999999999999999) > now()") is True
+
+    def test_bytes_hex_escapes_are_raw(self):
+        assert ev(r'size(b"\xff")') == 1
+        assert ev(r'b"\xff"') == b"\xff"
+        assert ev(r'b"\377"') == b"\xff"
+        assert ev(r'b"ÿ"') == b"\xc3\xbf"  # \u escapes stay code points
+        assert ev(r'"\xff"') == "\xff"
+
+    def test_negated_class_matches_separator_like_gobwas(self):
+        # gobwas List/Range matchers are not separator-aware; only * and ?
+        # exclude the separator.
+        from cerbos_tpu.globs import matches_glob
+
+        assert matches_glob("a[!b]c", "a:c")
+        assert not matches_glob("a?c", "a:c")

@@ -1,0 +1,467 @@
+"""Check-algorithm semantics tests, modeled on the reference's golden engine
+cases (internal/test/testdata/engine) but written as an independent corpus
+covering the same behaviors: RBAC, ABAC conditions, derived roles, principal
+policy precedence, scope hierarchies, scope permissions, role policies with
+synthetic denies, wildcards, outputs, and default-deny."""
+
+import yaml
+import pytest
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, Engine, EvalParams, Principal, Resource
+from cerbos_tpu.policy.parser import parse_policies
+
+POLICIES = """
+apiVersion: api.cerbos.dev/v1
+derivedRoles:
+  name: leave_roles
+  definitions:
+    - name: owner
+      parentRoles: [employee]
+      condition:
+        match:
+          expr: request.resource.attr.owner == request.principal.id
+    - name: direct_manager
+      parentRoles: [manager]
+      condition:
+        match:
+          expr: request.resource.attr.managerId == request.principal.id
+    - name: any_employee
+      parentRoles: [employee]
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: leave_request
+  version: default
+  importDerivedRoles: [leave_roles]
+  rules:
+    - actions: ["view:*"]
+      effect: EFFECT_ALLOW
+      derivedRoles: [owner, direct_manager]
+    - actions: ["create"]
+      effect: EFFECT_ALLOW
+      derivedRoles: [any_employee]
+    - actions: ["approve"]
+      effect: EFFECT_ALLOW
+      derivedRoles: [direct_manager]
+      condition:
+        match:
+          expr: request.resource.attr.status == "PENDING_APPROVAL"
+      output:
+        when:
+          ruleActivated: '"approved by " + request.principal.id'
+          conditionNotMet: '"not pending"'
+    - actions: ["*"]
+      effect: EFFECT_ALLOW
+      roles: [admin]
+    - actions: ["delete"]
+      effect: EFFECT_DENY
+      roles: [auditor]
+---
+apiVersion: api.cerbos.dev/v1
+principalPolicy:
+  principal: daffy
+  version: default
+  rules:
+    - resource: leave_request
+      actions:
+        - action: "approve"
+          effect: EFFECT_DENY
+          name: no_approve_for_daffy
+    - resource: "secret_*"
+      actions:
+        - action: "view"
+          effect: EFFECT_ALLOW
+"""
+
+
+def make_engine(src=POLICIES, **kwargs):
+    policies = list(parse_policies(src))
+    return Engine.from_policies(compile_policy_set(policies), **kwargs)
+
+
+def check_one(engine, principal, resource, actions, params=None):
+    out = engine.check(
+        [CheckInput(principal=principal, resource=resource, actions=actions, request_id="t")],
+        params=params,
+    )[0]
+    return out
+
+
+def P(id="john", roles=("employee",), attr=None, scope="", version=""):
+    return Principal(id=id, roles=list(roles), attr=attr or {}, scope=scope, policy_version=version)
+
+
+def R(kind="leave_request", id="XX1", attr=None, scope="", version=""):
+    return Resource(kind=kind, id=id, attr=attr or {}, scope=scope, policy_version=version)
+
+
+class TestBasicRBACAndABAC:
+    def test_owner_can_view(self):
+        out = check_one(make_engine(), P(), R(attr={"owner": "john"}), ["view:public"])
+        assert out.actions["view:public"].effect == "EFFECT_ALLOW"
+        assert out.actions["view:public"].policy == "resource.leave_request.vdefault"
+        assert "owner" in out.effective_derived_roles
+        assert "any_employee" in out.effective_derived_roles
+
+    def test_non_owner_cannot_view(self):
+        out = check_one(make_engine(), P(), R(attr={"owner": "sally"}), ["view:public"])
+        assert out.actions["view:public"].effect == "EFFECT_DENY"
+
+    def test_default_deny_unknown_action(self):
+        out = check_one(make_engine(), P(), R(attr={"owner": "john"}), ["bogus_action"])
+        assert out.actions["bogus_action"].effect == "EFFECT_DENY"
+
+    def test_unknown_resource_kind_no_match(self):
+        out = check_one(make_engine(), P(), R(kind="nonexistent"), ["view"])
+        assert out.actions["view"].effect == "EFFECT_DENY"
+        assert out.actions["view"].policy == "NO_MATCH"
+
+    def test_condition_gates_allow(self):
+        eng = make_engine()
+        ok = check_one(eng, P(id="boss", roles=["manager"]), R(attr={"managerId": "boss", "status": "PENDING_APPROVAL"}), ["approve"])
+        assert ok.actions["approve"].effect == "EFFECT_ALLOW"
+        no = check_one(eng, P(id="boss", roles=["manager"]), R(attr={"managerId": "boss", "status": "DRAFT"}), ["approve"])
+        assert no.actions["approve"].effect == "EFFECT_DENY"
+
+    def test_missing_attr_is_false_not_error(self):
+        out = check_one(make_engine(), P(id="boss", roles=["manager"]), R(attr={"managerId": "boss"}), ["approve"])
+        assert out.actions["approve"].effect == "EFFECT_DENY"
+
+    def test_wildcard_action_glob(self):
+        eng = make_engine()
+        out = check_one(eng, P(), R(attr={"owner": "john"}), ["view:private"])
+        assert out.actions["view:private"].effect == "EFFECT_ALLOW"
+        # ':' is the glob separator: view:* must not match a deeper segment path
+        out2 = check_one(eng, P(), R(attr={"owner": "john"}), ["view:a:b"])
+        assert out2.actions["view:a:b"].effect == "EFFECT_DENY"
+
+    def test_admin_star_matches_everything(self):
+        out = check_one(make_engine(), P(id="root", roles=["admin"]), R(), ["delete", "anything:at:all"])
+        assert out.actions["delete"].effect == "EFFECT_ALLOW"
+        assert out.actions["anything:at:all"].effect == "EFFECT_ALLOW"
+
+    def test_roles_evaluated_independently(self):
+        # Rule-table semantics (check.go:409-417): each role is evaluated
+        # independently and the first independent ALLOW wins, so auditor's
+        # delete-DENY does not block admin's wildcard ALLOW.
+        out = check_one(make_engine(), P(id="x", roles=["auditor", "admin"]), R(), ["delete"])
+        assert out.actions["delete"].effect == "EFFECT_ALLOW"
+        # auditor alone is denied
+        out2 = check_one(make_engine(), P(id="x", roles=["auditor"]), R(), ["delete"])
+        assert out2.actions["delete"].effect == "EFFECT_DENY"
+
+    def test_deny_beats_allow_within_role(self):
+        # Within a single role, an explicit DENY breaks the scope walk even
+        # when another rule allows (check.go:376-384).
+        src = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: thing
+  version: default
+  rules:
+    - actions: ["*"]
+      effect: EFFECT_ALLOW
+      roles: [worker]
+    - actions: ["drop"]
+      effect: EFFECT_DENY
+      roles: [worker]
+"""
+        out = check_one(make_engine(src), P(id="w", roles=["worker"]), R(kind="thing"), ["drop", "push"])
+        assert out.actions["drop"].effect == "EFFECT_DENY"
+        assert out.actions["push"].effect == "EFFECT_ALLOW"
+
+    def test_outputs(self):
+        eng = make_engine()
+        ok = check_one(eng, P(id="boss", roles=["manager"]), R(attr={"managerId": "boss", "status": "PENDING_APPROVAL"}), ["approve"])
+        assert any(o.val == "approved by boss" for o in ok.outputs)
+        no = check_one(eng, P(id="boss", roles=["manager"]), R(attr={"managerId": "boss", "status": "X"}), ["approve"])
+        assert any(o.val == "not pending" for o in no.outputs)
+        src = [o.src for o in ok.outputs][0]
+        assert src.startswith("resource.leave_request.vdefault#")
+
+
+class TestPrincipalPolicyPrecedence:
+    def test_principal_deny_overrides_resource_allow(self):
+        eng = make_engine()
+        out = check_one(
+            eng,
+            P(id="daffy", roles=["manager"]),
+            R(attr={"managerId": "daffy", "status": "PENDING_APPROVAL"}),
+            ["approve"],
+        )
+        assert out.actions["approve"].effect == "EFFECT_DENY"
+        assert out.actions["approve"].policy == "principal.daffy.vdefault"
+
+    def test_principal_glob_resource(self):
+        eng = make_engine()
+        out = check_one(eng, P(id="daffy", roles=["employee"]), R(kind="secret_files"), ["view"])
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        assert out.actions["view"].policy == "principal.daffy.vdefault"
+
+    def test_other_principals_unaffected(self):
+        eng = make_engine()
+        out = check_one(eng, P(id="donald", roles=["employee"]), R(kind="secret_files"), ["view"])
+        assert out.actions["view"].effect == "EFFECT_DENY"
+        assert out.actions["view"].policy == "NO_MATCH"
+
+
+SCOPED_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  rules:
+    - actions: ["view", "edit", "delete"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  scope: acme
+  rules:
+    - actions: ["delete"]
+      effect: EFFECT_DENY
+      roles: [user]
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  scope: acme.hr
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: request.resource.attr.confidential != true
+"""
+
+
+class TestScopes:
+    def test_scope_fallthrough_to_root(self):
+        eng = make_engine(SCOPED_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="acme.hr"), ["edit"])
+        assert out.actions["edit"].effect == "EFFECT_ALLOW"
+        assert out.actions["edit"].scope == ""
+
+    def test_scope_deny_in_middle(self):
+        eng = make_engine(SCOPED_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="acme.hr"), ["delete"])
+        assert out.actions["delete"].effect == "EFFECT_DENY"
+        assert out.actions["delete"].scope == "acme"
+
+    def test_leaf_scope_allow_overrides(self):
+        eng = make_engine(SCOPED_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="acme.hr", attr={"confidential": False}), ["view"])
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        assert out.actions["view"].scope == "acme.hr"
+
+    def test_leaf_condition_false_falls_through(self):
+        # OVERRIDE_PARENT (default): condition false in leaf → falls through
+        # to parent scopes, root allows view
+        eng = make_engine(SCOPED_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="acme.hr", attr={"confidential": True}), ["view"])
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        assert out.actions["view"].scope == ""
+
+    def test_unknown_scope_strict(self):
+        eng = make_engine(SCOPED_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="acme.hr.nosuch"), ["view"])
+        assert out.actions["view"].effect == "EFFECT_DENY"
+        assert out.actions["view"].policy == "NO_MATCH"
+
+    def test_unknown_scope_lenient(self):
+        eng = make_engine(SCOPED_POLICIES)
+        out = check_one(
+            eng, P(id="u", roles=["user"]), R(kind="doc", scope="acme.hr.nosuch"), ["view"],
+            params=EvalParams(lenient_scope_search=True),
+        )
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+
+
+RPC_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  rules:
+    - actions: ["view", "edit"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  scope: tenant
+  scopePermissions: SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT_FOR_ALLOWS
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: request.resource.attr.public == true
+    - actions: ["edit"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+"""
+
+
+class TestScopePermissions:
+    def test_rpc_condition_true_requires_parent(self):
+        eng = make_engine(RPC_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="tenant", attr={"public": True}), ["view"])
+        # child consents (condition true), parent allows → ALLOW from parent
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        assert out.actions["view"].scope == ""
+
+    def test_rpc_condition_false_denies(self):
+        eng = make_engine(RPC_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="tenant", attr={"public": False}), ["view"])
+        # negated-condition DENY row fires in the child scope
+        assert out.actions["view"].effect == "EFFECT_DENY"
+        assert out.actions["view"].scope == "tenant"
+
+    def test_rpc_unconditional_allow_defers_to_parent(self):
+        eng = make_engine(RPC_POLICIES)
+        out = check_one(eng, P(id="u", roles=["user"]), R(kind="doc", scope="tenant"), ["edit"])
+        assert out.actions["edit"].effect == "EFFECT_ALLOW"
+        assert out.actions["edit"].scope == ""
+
+
+ROLE_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  scope: acme
+  rules:
+    - actions: ["view", "edit", "delete", "share"]
+      effect: EFFECT_ALLOW
+      roles: [admin]
+---
+apiVersion: api.cerbos.dev/v1
+rolePolicy:
+  role: intern
+  scope: acme
+  parentRoles: [admin]
+  rules:
+    - resource: doc
+      allowActions: ["view"]
+---
+apiVersion: api.cerbos.dev/v1
+rolePolicy:
+  role: contractor
+  scope: acme
+  parentRoles: [admin]
+  rules:
+    - resource: doc
+      allowActions: ["view", "edit"]
+      condition:
+        match:
+          expr: request.resource.attr.assigned == request.principal.id
+"""
+
+
+class TestRolePolicies:
+    def test_role_policy_narrows_parent(self):
+        eng = make_engine(ROLE_POLICIES)
+        # intern inherits admin via parentRoles but is restricted to view
+        out = check_one(eng, P(id="i1", roles=["intern"]), R(kind="doc", scope="acme"), ["view", "edit", "delete"])
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        assert out.actions["edit"].effect == "EFFECT_DENY"
+        assert out.actions["edit"].policy == "role.intern.vdefault/acme"
+        assert out.actions["delete"].effect == "EFFECT_DENY"
+
+    def test_conditional_role_policy(self):
+        eng = make_engine(ROLE_POLICIES)
+        ok = check_one(eng, P(id="c1", roles=["contractor"]), R(kind="doc", scope="acme", attr={"assigned": "c1"}), ["edit"])
+        assert ok.actions["edit"].effect == "EFFECT_ALLOW"
+        no = check_one(eng, P(id="c1", roles=["contractor"]), R(kind="doc", scope="acme", attr={"assigned": "other"}), ["edit"])
+        assert no.actions["edit"].effect == "EFFECT_DENY"
+
+    def test_plain_admin_unaffected(self):
+        eng = make_engine(ROLE_POLICIES)
+        out = check_one(eng, P(id="a", roles=["admin"]), R(kind="doc", scope="acme"), ["delete"])
+        assert out.actions["delete"].effect == "EFFECT_ALLOW"
+
+
+VARIABLES_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+exportVariables:
+  name: common_vars
+  definitions:
+    flagged: request.resource.attr.flagged == true
+---
+apiVersion: api.cerbos.dev/v1
+exportConstants:
+  name: common_consts
+  definitions:
+    allowed_depts: ["eng", "hr"]
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: report
+  version: default
+  variables:
+    import: [common_vars]
+    local:
+      in_dept: request.principal.attr.dept in C.allowed_depts
+      combo: variables.in_dept && !variables.flagged
+  constants:
+    import: [common_consts]
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: V.combo
+"""
+
+
+class TestVariablesAndConstants:
+    def test_variable_chain(self):
+        eng = make_engine(VARIABLES_POLICIES)
+        ok = check_one(eng, P(id="u", roles=["user"], attr={"dept": "eng"}), R(kind="report", attr={"flagged": False}), ["view"])
+        assert ok.actions["view"].effect == "EFFECT_ALLOW"
+        no = check_one(eng, P(id="u", roles=["user"], attr={"dept": "sales"}), R(kind="report", attr={"flagged": False}), ["view"])
+        assert no.actions["view"].effect == "EFFECT_DENY"
+        no2 = check_one(eng, P(id="u", roles=["user"], attr={"dept": "eng"}), R(kind="report", attr={"flagged": True}), ["view"])
+        assert no2.actions["view"].effect == "EFFECT_DENY"
+
+
+class TestVersions:
+    POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: api
+  version: default
+  rules:
+    - actions: ["call"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: api
+  version: v2
+  rules:
+    - actions: ["call"]
+      effect: EFFECT_DENY
+      roles: [user]
+"""
+
+    def test_version_selection(self):
+        eng = make_engine(self.POLICIES)
+        d = check_one(eng, P(roles=["user"]), R(kind="api"), ["call"])
+        assert d.actions["call"].effect == "EFFECT_ALLOW"
+        v2 = check_one(eng, P(roles=["user"]), R(kind="api", version="v2"), ["call"])
+        assert v2.actions["call"].effect == "EFFECT_DENY"
+        v3 = check_one(eng, P(roles=["user"]), R(kind="api", version="v3"), ["call"])
+        assert v3.actions["call"].policy == "NO_MATCH"
