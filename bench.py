@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Benchmark: batched CheckResources decisions/sec on the TPU evaluator.
+
+Workload mirrors the reference's classic load test
+(hack/loadtest/templates/classic): 200 name-mods × 4 policies = 800 policies
+(the reference's 800-policy config peaks at 8,638 req/s × 4 decisions/req ≈
+34.6k decisions/s on a 4-vCPU c3-standard-4 — BASELINE.md). Prints one JSON
+line; vs_baseline is decisions/sec relative to that reference anchor.
+"""
+
+import json
+import time
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import EvalParams
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table
+from cerbos_tpu.tpu import TpuEvaluator
+from cerbos_tpu.util import bench_corpus
+
+REFERENCE_DECISIONS_PER_SEC = 8638 * 4  # BASELINE.md: max RPS @800 policies × 4 decisions/req
+N_MODS = 200  # × 4 policies per mod = 800 policies
+BATCH = 4096
+ITERS = 8
+
+
+def main() -> None:
+    policies = list(parse_policies(bench_corpus.corpus_yaml(N_MODS)))
+    rt = build_rule_table(compile_policy_set(policies))
+    ev = TpuEvaluator(rt)
+    params = EvalParams()
+
+    inputs = bench_corpus.requests(BATCH, N_MODS)
+    decisions_per_batch = sum(len(i.actions) for i in inputs)
+
+    # warmup: packer caches + jit compile
+    ev.check(inputs, params)
+    ev.check(inputs, params)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        outs = ev.check(inputs, params)
+    dt = time.perf_counter() - t0
+
+    allow = sum(1 for o in outs for e in o.actions.values() if e.effect == "EFFECT_ALLOW")
+    assert allow > 0, "benchmark workload produced no allows — corpus is broken"
+    assert ev.stats["oracle_inputs"] == 0, f"oracle fallbacks in bench: {ev.stats}"
+
+    value = decisions_per_batch * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "check_decisions_per_sec",
+                "value": round(value, 1),
+                "unit": "decisions/s/chip",
+                "vs_baseline": round(value / REFERENCE_DECISIONS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

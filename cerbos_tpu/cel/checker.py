@@ -20,7 +20,7 @@ ROOT_IDENTS = {
     "null_type", "type",
 }
 
-_REQUEST_FIELDS = {"principal", "resource", "auxData"}
+_REQUEST_FIELDS = {"principal", "resource", "auxData", "aux_data"}
 _PRINCIPAL_FIELDS = {"id", "roles", "attr", "policyVersion", "scope"}
 _RESOURCE_FIELDS = {"kind", "id", "attr", "policyVersion", "scope"}
 _RUNTIME_FIELDS = {"effectiveDerivedRoles"}
@@ -96,6 +96,6 @@ def _check_select(node: Node, bound: set[str]) -> None:
             raise CheckError(f"undefined field '{field}' on request.principal")
         if operand.field == "resource" and field not in _RESOURCE_FIELDS:
             raise CheckError(f"undefined field '{field}' on request.resource")
-        if operand.field == "auxData" and field not in _AUXDATA_FIELDS:
+        if operand.field in ("auxData", "aux_data") and field not in _AUXDATA_FIELDS:
             raise CheckError(f"undefined field '{field}' on request.auxData")
     _walk(operand, bound)

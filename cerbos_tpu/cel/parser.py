@@ -178,6 +178,9 @@ def _scan_string(src: str, i: int, raw: bool, as_bytes: bool = False) -> tuple[s
                     out.append(_ESCAPES[e])
                 i += 2
             elif e in ("x", "X", "u", "U") or e.isdigit():
+                if e in ("u", "U") and as_bytes:
+                    # cel-go rejects unicode escapes inside bytes literals
+                    raise CelParseError(f"\\{e} escape is not allowed in bytes literals", i, src)
                 if e in ("x", "X"):
                     digits, base, skip = src[i + 2 : i + 4], 16, 4
                 elif e == "u":
@@ -186,7 +189,7 @@ def _scan_string(src: str, i: int, raw: bool, as_bytes: bool = False) -> tuple[s
                     digits, base, skip = src[i + 2 : i + 10], 16, 10
                 else:
                     digits, base, skip = src[i + 1 : i + 4], 8, 4
-                if as_bytes and e not in ("u", "U"):
+                if as_bytes:
                     # hex/octal escapes in bytes literals are raw byte values
                     try:
                         b = int(digits, base)

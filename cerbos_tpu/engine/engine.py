@@ -10,17 +10,17 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING, Any, Callable, Optional, Sequence
 
-from ..ruletable import RuleTable, build_rule_table, check_input
 from . import types as T
 
-if TYPE_CHECKING:  # avoid a circular import with cerbos_tpu.compile
+if TYPE_CHECKING:  # avoid circular imports (ruletable.check imports engine.types)
     from ..compile.compiler import CompiledPolicy
+    from ..ruletable import RuleTable
 
 
 class Engine:
     def __init__(
         self,
-        rule_table: RuleTable,
+        rule_table: "RuleTable",
         schema_mgr: Any = None,
         eval_params: Optional[T.EvalParams] = None,
         tpu_evaluator: Any = None,
@@ -36,6 +36,8 @@ class Engine:
 
     @classmethod
     def from_policies(cls, policies: "list[CompiledPolicy]", **kwargs) -> "Engine":
+        from ..ruletable import build_rule_table
+
         return cls(build_rule_table(policies), **kwargs)
 
     def check(
@@ -47,6 +49,8 @@ class Engine:
         if self.tpu_evaluator is not None and len(inputs) >= self.tpu_batch_threshold:
             outputs = self.tpu_evaluator.check(list(inputs), params)
         else:
+            from ..ruletable import check_input
+
             outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
         if self.on_decision is not None:
             self.on_decision(list(inputs), outputs)

@@ -1,0 +1,1 @@
+from .mesh import batch_sharding, make_mesh, shard_packed_arrays  # noqa: F401

@@ -1,0 +1,53 @@
+"""Device-mesh sharding for the batch evaluator.
+
+The PDP's scale-out axis is the batch (SURVEY.md §2.5): CheckResources
+batches shard over a 1-D ``data`` mesh via NamedSharding; the lowered rule
+tables (candidate metadata is batch-aligned, condition kernels are closures)
+are replicated. sat_cond gathers across the batch axis ride ICI via the
+XLA-inserted collectives — there is no reference NCCL/MPI to mirror
+(SURVEY.md §5: gRPC only), so this is the native distributed backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_packed_arrays(arrays: dict[str, Any], mesh: Mesh, axis: str = "data") -> dict[str, Any]:
+    """Place packed batch arrays on the mesh, sharding the leading (batch)
+    axis of every array whose leading dim is divisible by the mesh size."""
+    n = mesh.devices.size
+    sharded = batch_sharding(mesh, axis)
+    repl = replicated(mesh)
+
+    def place(a):
+        if hasattr(a, "shape") and a.ndim >= 1 and a.shape[0] % n == 0 and a.shape[0] > 0:
+            return jax.device_put(a, sharded)
+        return jax.device_put(a, repl)
+
+    out = {}
+    for k, v in arrays.items():
+        if isinstance(v, dict):
+            out[k] = {kk: place(vv) for kk, vv in v.items()}
+        else:
+            out[k] = place(v)
+    return out

@@ -196,7 +196,10 @@ def build_request_messages(input: T.CheckInput) -> tuple[Message, Message, Messa
         }
     )
     aux = input.aux_data or T.AuxData()
-    request = Message({"principal": principal, "resource": resource, "auxData": Message({"jwt": aux.jwt})})
+    aux_msg = Message({"jwt": aux.jwt})
+    # cel-go resolves proto fields by their proto (snake_case) names, so the
+    # reference's conditions write `request.aux_data.jwt`; accept both.
+    request = Message({"principal": principal, "resource": resource, "auxData": aux_msg, "aux_data": aux_msg})
     return request, principal, resource
 
 

@@ -236,7 +236,7 @@ class Index:
     ) -> list[RuleRow]:
         """Rows matching all dimensions, with role-policy synthetic DENYs
         prepended (ref: index.go:199-321). Empty/zero args mean match-all."""
-        if not any(r is not None for r in self.rows):
+        if len(self._free_ids) == len(self.rows):  # O(1) empty check
             return []
 
         principal_ids: Optional[frozenset[int] | set[int]] = None

@@ -27,13 +27,14 @@ from ..policy.model import (
     SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT,
     SCOPE_PERMISSIONS_UNSPECIFIED,
 )
+from ..engine.types import (  # canonical sentinel strings
+    EFFECT_ALLOW,
+    EFFECT_DENY,
+    KIND_PRINCIPAL,
+    KIND_RESOURCE,
+)
 
-EFFECT_ALLOW = "EFFECT_ALLOW"
-EFFECT_DENY = "EFFECT_DENY"
 EFFECT_UNSPECIFIED = "EFFECT_UNSPECIFIED"
-
-KIND_PRINCIPAL = "PRINCIPAL"
-KIND_RESOURCE = "RESOURCE"
 
 
 @dataclass

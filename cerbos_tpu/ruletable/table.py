@@ -87,9 +87,18 @@ class RuleTable:
     def delete_policy(self, fqn: str) -> None:
         self.idx.delete_policy(fqn)
         mod_id = namer.module_id(fqn)
-        self.meta.pop(mod_id, None)
+        meta = self.meta.pop(mod_id, None)
         self.schemas.pop(mod_id, None)
         self.policy_derived_roles.pop(mod_id, None)
+        # a deleted role policy must stop granting its parent-role inheritance
+        if meta is not None and meta.kind == "ROLE":
+            scope = namer.scope_from_fqn(fqn)
+            role_parents = self.scope_parent_roles.get(scope)
+            if role_parents is not None:
+                role_parents.pop(meta.name, None)
+                if not role_parents:
+                    del self.scope_parent_roles[scope]
+            self.idx.index_parent_roles(self.scope_parent_roles)
         # scope maps/permissions are rebuilt from surviving rows
         self._rebuild_scope_maps()
 

@@ -1,0 +1,18 @@
+"""TPU evaluation backend: lowering, batched condition kernels, effect lattice.
+
+This is the subsystem that replaces the reference's per-request hot loop
+(internal/ruletable/check.go:183-438) with batched device evaluation:
+
+- ``condcompile``  CEL condition AST → vectorized JAX kernel over SoA
+                   attribute columns, with an (value, error) lattice matching
+                   cel-go error-absorption semantics; unsupported fragments
+                   become host-evaluated predicate columns.
+- ``lowering``     rule table → static row metadata + interned condition set.
+- ``packer``       request batch → candidate-row tensors (the analogue of the
+                   reference's bitmap Query, memoized per dimension tuple)
+                   and attribute columns.
+- ``evaluator``    the jitted sat/lattice computation + host assembly of
+                   CheckOutputs (bit-exact vs the CPU oracle).
+"""
+
+from .evaluator import TpuEvaluator  # noqa: F401

@@ -1,0 +1,108 @@
+"""SoA attribute columns and exact-parity scalar encodings.
+
+Doubles are encoded as order-preserving (hi, lo) int32 pairs so the device
+can compare them bit-exactly without f64 arithmetic (TPUs emulate f64; the
+sortable-key trick keeps comparisons in native i32). Strings are interned to
+batch-local i32 ids (equality-only). Each referenced attribute path becomes
+one column set: tag, hi, lo, sid, nan.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+TAG_MISSING = 0
+TAG_NULL = 1
+TAG_BOOL = 2
+TAG_NUM = 3
+TAG_STR = 4
+TAG_OTHER = 5
+
+
+def double_key(v: float) -> int:
+    """Map a double to a uint64 preserving total order (NaN excluded)."""
+    (bits,) = struct.unpack("<Q", struct.pack("<d", float(v)))
+    if bits & (1 << 63):
+        return (~bits) & ((1 << 64) - 1)
+    return bits | (1 << 63)
+
+
+def split_key(key: int) -> tuple[int, int]:
+    """uint64 sortable key → (hi, lo) int32 pair (two's complement)."""
+    hi = (key >> 32) & 0xFFFFFFFF
+    lo = key & 0xFFFFFFFF
+    if hi >= 1 << 31:
+        hi -= 1 << 32
+    if lo >= 1 << 31:
+        lo -= 1 << 32
+    return hi, lo
+
+
+class StringInterner:
+    """Batch-local string → i32 id (0 reserved for 'absent')."""
+
+    def __init__(self) -> None:
+        self.ids: dict[str, int] = {}
+
+    def intern(self, s: str) -> int:
+        i = self.ids.get(s)
+        if i is None:
+            i = len(self.ids) + 1
+            self.ids[s] = i
+        return i
+
+
+@dataclass
+class ColumnBatch:
+    """Encoded columns for one batch: path → arrays of shape [B]."""
+
+    size: int
+    tags: dict[tuple, np.ndarray] = field(default_factory=dict)
+    his: dict[tuple, np.ndarray] = field(default_factory=dict)
+    los: dict[tuple, np.ndarray] = field(default_factory=dict)
+    sids: dict[tuple, np.ndarray] = field(default_factory=dict)
+    nans: dict[tuple, np.ndarray] = field(default_factory=dict)
+    # host-evaluated predicate columns: pred_id -> (val[B], err[B])
+    pred_vals: dict[int, np.ndarray] = field(default_factory=dict)
+    pred_errs: dict[int, np.ndarray] = field(default_factory=dict)
+
+
+def resolve_path(input_obj: Any, path: tuple[str, ...]) -> tuple[bool, Any]:
+    """Walk a path (e.g. ('resource','attr','status')) through a CheckInput.
+
+    Returns (present, value). Intermediate misses → absent.
+    """
+    cur: Any = input_obj
+    for seg in path:
+        if isinstance(cur, dict):
+            if seg not in cur:
+                return False, None
+            cur = cur[seg]
+        else:
+            if not hasattr(cur, seg):
+                return False, None
+            cur = getattr(cur, seg)
+    return True, cur
+
+
+def encode_value(v: Any, present: bool, interner: StringInterner) -> tuple[int, int, int, int, bool]:
+    """→ (tag, hi, lo, sid, is_nan)."""
+    if not present:
+        return TAG_MISSING, 0, 0, 0, False
+    if v is None:
+        return TAG_NULL, 0, 0, 0, False
+    if isinstance(v, bool):
+        return TAG_BOOL, 1 if v else 0, 0, 0, False
+    if isinstance(v, (int, float)):
+        f = float(v)
+        if f != f:
+            return TAG_NUM, 0, 0, 0, True
+        hi, lo = split_key(double_key(f))
+        return TAG_NUM, hi, lo, 0, False
+    if isinstance(v, str):
+        return TAG_STR, 0, 0, interner.intern(v), False
+    return TAG_OTHER, 0, 0, 0, False

@@ -1,0 +1,685 @@
+"""Condition compiler: CEL AST → vectorized JAX kernel.
+
+Each distinct (condition, params) pair becomes one kernel computing
+``(value, error)`` per batch element over SoA attribute columns, reproducing
+cel-go semantics: missing keys are errors, ``&&``/``||`` absorb errors
+commutatively, mismatched-type equality is false, mismatched ordering is an
+error. Variables/constants/globals are inlined at compile time (sound:
+conditions are pure and variables are topologically ordered).
+
+Fragments outside the native device op set — regex, timestamps, arithmetic,
+list membership in attribute lists, function calls — compile to *predicate
+columns*: host-evaluated (value, error) bits per input, cached per unique
+referenced-attribute tuple. Paths whose runtime values the device cannot
+compare (lists/dicts under ``==``, strings under ``<``) register fallback
+trigger tags; the packer routes affected inputs to the CPU oracle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..cel import ast as A
+from ..cel.errors import CelError
+from ..compile import CompiledCondition, PolicyParams
+from .columns import (
+    TAG_BOOL,
+    TAG_MISSING,
+    TAG_NULL,
+    TAG_NUM,
+    TAG_OTHER,
+    TAG_STR,
+    StringInterner,
+    double_key,
+    split_key,
+)
+
+TAG_ERR = 6
+
+_ROOT_ALIASES = {
+    "R": ("resource",),
+    "P": ("principal",),
+    "request": (),
+}
+
+
+class Unsupported(Exception):
+    """Raised during compilation when a fragment needs a predicate column."""
+
+
+@dataclass
+class PredSpec:
+    """A host-evaluated boolean subexpression."""
+
+    pred_id: int
+    node: A.Node
+    params: PolicyParams
+    ref_paths: tuple[tuple[str, ...], ...]
+    time_dependent: bool
+
+
+@dataclass
+class CondKernel:
+    cond_id: int
+    paths: set[tuple[str, ...]] = field(default_factory=set)
+    preds: list[PredSpec] = field(default_factory=list)
+    # emit(refs) -> bool ndarray [B]; refs provides col/pred accessors
+    emit: Optional[Callable[["Refs"], Any]] = None
+    # tags that force CPU fallback when seen at a path in a batch
+    fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
+    references_runtime: bool = False
+
+
+class Refs:
+    """Accessors handed to kernel emit functions (jnp or np arrays)."""
+
+    def __init__(self, xp, tags, his, los, sids, nans, pred_vals, pred_errs):
+        self.xp = xp
+        self._tags = tags
+        self._his = his
+        self._los = los
+        self._sids = sids
+        self._nans = nans
+        self._pred_vals = pred_vals
+        self._pred_errs = pred_errs
+
+    def tag(self, path):
+        return self._tags[path]
+
+    def hi(self, path):
+        return self._his[path]
+
+    def lo(self, path):
+        return self._los[path]
+
+    def sid(self, path):
+        return self._sids[path]
+
+    def nan(self, path):
+        return self._nans[path]
+
+    def pred(self, pred_id):
+        return self._pred_vals[pred_id], self._pred_errs[pred_id]
+
+
+# ---------------------------------------------------------------------------
+# typed mini-IR for operands
+
+
+@dataclass(frozen=True)
+class ConstOp:
+    value: Any
+
+
+@dataclass(frozen=True)
+class PathOp:
+    path: tuple[str, ...]
+
+
+@dataclass
+class BoolExpr:
+    """emit(refs) -> (val, err) boolean arrays."""
+
+    emit: Callable[[Refs], tuple[Any, Any]]
+
+
+class _Compiler:
+    def __init__(self, kernel: CondKernel, params: PolicyParams, globals_: dict[str, Any], pred_alloc):
+        self.k = kernel
+        self.params = params
+        self.globals = globals_
+        self.pred_alloc = pred_alloc  # (node, params) -> PredSpec
+        self.var_defs = {v.name: v.expr.node for v in params.ordered_variables}
+
+    # -- variable / constant inlining -------------------------------------
+
+    def inline(self, node: A.Node, depth: int = 0) -> A.Node:
+        if depth > 32:
+            raise Unsupported("variable inlining too deep")
+        if isinstance(node, A.Select) and isinstance(node.operand, A.Ident):
+            root = node.operand.name
+            if root in ("V", "variables"):
+                if node.field in self.var_defs:
+                    return self.inline(self.var_defs[node.field], depth + 1)
+                raise Unsupported(f"undefined variable {node.field}")
+            if root in ("C", "constants"):
+                if node.field in self.params.constants:
+                    return A.Lit(self.params.constants[node.field])
+                raise Unsupported(f"undefined constant {node.field}")
+            if root in ("G", "globals"):
+                if node.field in self.globals:
+                    return A.Lit(self.globals[node.field])
+                raise Unsupported(f"undefined global {node.field}")
+        # recurse
+        if isinstance(node, A.Select):
+            return A.Select(self.inline(node.operand, depth), node.field)
+        if isinstance(node, A.Present):
+            return A.Present(self.inline(node.operand, depth), node.field)
+        if isinstance(node, A.Index):
+            return A.Index(self.inline(node.operand, depth), self.inline(node.index, depth))
+        if isinstance(node, A.Call):
+            return A.Call(
+                node.fn,
+                tuple(self.inline(a, depth) for a in node.args),
+                target=self.inline(node.target, depth) if node.target is not None else None,
+            )
+        if isinstance(node, A.ListLit):
+            return A.ListLit(tuple(self.inline(x, depth) for x in node.items))
+        if isinstance(node, A.MapLit):
+            return A.MapLit(tuple((self.inline(k, depth), self.inline(v, depth)) for k, v in node.entries))
+        if isinstance(node, A.Bind):
+            return A.Bind(node.name, self.inline(node.init, depth), self.inline(node.body, depth))
+        if isinstance(node, A.Comprehension):
+            return A.Comprehension(
+                kind=node.kind,
+                iter_range=self.inline(node.iter_range, depth),
+                iter_var=node.iter_var,
+                step=self.inline(node.step, depth),
+                iter_var2=node.iter_var2,
+                step2=self.inline(node.step2, depth) if node.step2 is not None else None,
+            )
+        return node
+
+    # -- operand classification -------------------------------------------
+
+    def as_operand(self, node: A.Node):
+        if isinstance(node, A.Lit):
+            return ConstOp(node.value)
+        if isinstance(node, A.ListLit):
+            vals = []
+            for item in node.items:
+                if not isinstance(item, A.Lit):
+                    raise Unsupported("non-literal list element")
+                vals.append(item.value)
+            return ConstOp(vals)
+        path = self.path_of(node)
+        if path is not None:
+            self.k.paths.add(path)
+            return PathOp(path)
+        raise Unsupported("operand is not a literal or attribute path")
+
+    def path_of(self, node: A.Node) -> Optional[tuple[str, ...]]:
+        """Select/Index chain rooted at request/R/P → canonical path."""
+        segs: list[str] = []
+        cur = node
+        while True:
+            if isinstance(cur, A.Select):
+                segs.append(cur.field)
+                cur = cur.operand
+            elif isinstance(cur, A.Index) and isinstance(cur.index, A.Lit) and isinstance(cur.index.value, str):
+                segs.append(cur.index.value)
+                cur = cur.operand
+            elif isinstance(cur, A.Ident):
+                if cur.name == "runtime":
+                    self.k.references_runtime = True
+                    return None
+                if cur.name in _ROOT_ALIASES:
+                    return _ROOT_ALIASES[cur.name] + tuple(reversed(segs))
+                return None
+            else:
+                return None
+
+    # -- boolean compilation ----------------------------------------------
+
+    def compile_bool(self, node: A.Node) -> BoolExpr:
+        if isinstance(node, A.Call) and node.target is None:
+            fn = node.fn
+            if fn == "_&&_":
+                return self._logic(node.args, is_and=True)
+            if fn == "_||_":
+                return self._logic(node.args, is_and=False)
+            if fn == "!_":
+                inner = self.compile_bool(node.args[0])
+
+                def emit_not(refs, inner=inner):
+                    v, e = inner.emit(refs)
+                    return ~v & ~e, e
+
+                return BoolExpr(emit_not)
+            if fn == "_?_:_":
+                c = self.compile_bool(node.args[0])
+                t = self.compile_bool(node.args[1])
+                f = self.compile_bool(node.args[2])
+
+                def emit_ternary(refs, c=c, t=t, f=f):
+                    cv, ce = c.emit(refs)
+                    tv, te = t.emit(refs)
+                    fv, fe = f.emit(refs)
+                    pick_t = cv & ~ce
+                    pick_f = ~cv & ~ce
+                    err = ce | (pick_t & te) | (pick_f & fe)
+                    val = ((pick_t & tv) | (pick_f & fv)) & ~err
+                    return val, err
+
+                return BoolExpr(emit_ternary)
+            if fn in ("_==_", "_!=_"):
+                return self._equality(node.args[0], node.args[1], negate=(fn == "_!=_"))
+            if fn in ("_<_", "_<=_", "_>_", "_>=_"):
+                return self._ordering(fn, node.args[0], node.args[1])
+            if fn == "_in_":
+                return self._in(node.args[0], node.args[1])
+            raise Unsupported(f"function {fn}")
+        if isinstance(node, A.Present):
+            return self._has(node)
+        if isinstance(node, A.Lit):
+            if isinstance(node.value, bool):
+                b = node.value
+
+                def emit_lit(refs, b=b):
+                    xp = refs.xp
+                    shape = self._any_shape(refs)
+                    return xp.full(shape, b, dtype=bool), xp.zeros(shape, dtype=bool)
+
+                return BoolExpr(emit_lit)
+            raise Unsupported("non-bool literal in boolean position")
+        # bare attribute path in boolean position: true iff value is bool true
+        path = self.path_of(node)
+        if path is not None:
+            self.k.paths.add(path)
+
+            def emit_path(refs, path=path):
+                tag = refs.tag(path)
+                val = (tag == TAG_BOOL) & (refs.hi(path) == 1)
+                err = (tag == TAG_MISSING) | (tag == TAG_ERR)
+                return val & ~err, err
+
+            return BoolExpr(emit_path)
+        raise Unsupported("unsupported boolean expression")
+
+    def _any_shape(self, refs: Refs):
+        for d in (refs._tags, refs._pred_vals):
+            for v in d.values():
+                return v.shape
+        return (1,)
+
+    def _logic(self, args, is_and: bool) -> BoolExpr:
+        parts = [self.compile_bool(a) for a in args]
+
+        def emit(refs):
+            vals_errs = [p.emit(refs) for p in parts]
+            if is_and:
+                # false if any (false & !err); err if no false and any err
+                any_false = None
+                any_err = None
+                all_true = None
+                for v, e in vals_errs:
+                    f = ~v & ~e
+                    any_false = f if any_false is None else (any_false | f)
+                    any_err = e if any_err is None else (any_err | e)
+                    t = v & ~e
+                    all_true = t if all_true is None else (all_true & t)
+                err = ~any_false & any_err
+                val = all_true & ~err
+                return val, err
+            any_true = None
+            any_err = None
+            for v, e in vals_errs:
+                t = v & ~e
+                any_true = t if any_true is None else (any_true | t)
+                any_err = e if any_err is None else (any_err | e)
+            err = ~any_true & any_err
+            val = any_true
+            return val, err
+
+        return BoolExpr(emit)
+
+    def _has(self, node: A.Present) -> BoolExpr:
+        path = self.path_of(A.Select(node.operand, node.field))
+        if path is None:
+            raise Unsupported("has() on non-path")
+        self.k.paths.add(path)
+
+        def emit(refs, path=path):
+            tag = refs.tag(path)
+            err = tag == TAG_ERR
+            val = ~err & (tag != TAG_MISSING)
+            return val, err
+
+        return BoolExpr(emit)
+
+    # value-compare helpers; `a` is PathOp, b is ConstOp/PathOp
+
+    def _equality(self, lhs_n: A.Node, rhs_n: A.Node, negate: bool) -> BoolExpr:
+        lhs, rhs = self.as_operand(lhs_n), self.as_operand(rhs_n)
+        if isinstance(lhs, ConstOp) and isinstance(rhs, PathOp):
+            lhs, rhs = rhs, lhs
+        if isinstance(lhs, ConstOp):
+            raise Unsupported("constant == constant")  # let constant folding live on host
+        assert isinstance(lhs, PathOp)
+        # lists/dicts at an eq path can't be compared on device
+        self._add_fallback(lhs.path, {TAG_OTHER})
+        if isinstance(rhs, PathOp):
+            self._add_fallback(rhs.path, {TAG_OTHER})
+
+            def emit_pp(refs, a=lhs.path, b=rhs.path, negate=negate):
+                ta, tb = refs.tag(a), refs.tag(b)
+                err = (ta == TAG_MISSING) | (ta == TAG_ERR) | (tb == TAG_MISSING) | (tb == TAG_ERR)
+                same_num = (ta == TAG_NUM) & (tb == TAG_NUM) & ~refs.nan(a) & ~refs.nan(b) & (refs.hi(a) == refs.hi(b)) & (refs.lo(a) == refs.lo(b))
+                same_str = (ta == TAG_STR) & (tb == TAG_STR) & (refs.sid(a) == refs.sid(b))
+                same_bool = (ta == TAG_BOOL) & (tb == TAG_BOOL) & (refs.hi(a) == refs.hi(b))
+                same_null = (ta == TAG_NULL) & (tb == TAG_NULL)
+                val = same_num | same_str | same_bool | same_null
+                if negate:
+                    val = ~val
+                return val & ~err, err
+
+            return BoolExpr(emit_pp)
+
+        cval = rhs.value
+        if isinstance(cval, list):
+            raise Unsupported("list equality")
+        if isinstance(cval, bool):
+            want = 1 if cval else 0
+
+            def emit_pb(refs, p=lhs.path, want=want, negate=negate):
+                tag = refs.tag(p)
+                err = (tag == TAG_MISSING) | (tag == TAG_ERR)
+                val = (tag == TAG_BOOL) & (refs.hi(p) == want)
+                if negate:
+                    val = ~val
+                return val & ~err, err
+
+            return BoolExpr(emit_pb)
+        if cval is None:
+
+            def emit_pn(refs, p=lhs.path, negate=negate):
+                tag = refs.tag(p)
+                err = (tag == TAG_MISSING) | (tag == TAG_ERR)
+                val = tag == TAG_NULL
+                if negate:
+                    val = ~val
+                return val & ~err, err
+
+            return BoolExpr(emit_pn)
+        if isinstance(cval, (int, float)):
+            f = float(cval)
+            if f != f:
+
+                def emit_pnan(refs, p=lhs.path, negate=negate):
+                    tag = refs.tag(p)
+                    err = (tag == TAG_MISSING) | (tag == TAG_ERR)
+                    xp = refs.xp
+                    val = xp.zeros_like(err)
+                    if negate:
+                        val = ~val
+                    return val & ~err, err
+
+                return BoolExpr(emit_pnan)
+            hi, lo = split_key(double_key(f))
+
+            def emit_pf(refs, p=lhs.path, hi=hi, lo=lo, negate=negate):
+                tag = refs.tag(p)
+                err = (tag == TAG_MISSING) | (tag == TAG_ERR)
+                val = (tag == TAG_NUM) & ~refs.nan(p) & (refs.hi(p) == hi) & (refs.lo(p) == lo)
+                if negate:
+                    val = ~val
+                return val & ~err, err
+
+            return BoolExpr(emit_pf)
+        if isinstance(cval, str):
+            sid = self.interner.intern(cval)
+
+            def emit_ps(refs, p=lhs.path, sid=sid, negate=negate):
+                tag = refs.tag(p)
+                err = (tag == TAG_MISSING) | (tag == TAG_ERR)
+                val = (tag == TAG_STR) & (refs.sid(p) == sid)
+                if negate:
+                    val = ~val
+                return val & ~err, err
+
+            return BoolExpr(emit_ps)
+        raise Unsupported(f"equality against {type(cval).__name__} constant")
+
+    def _ordering(self, fn: str, lhs_n: A.Node, rhs_n: A.Node) -> BoolExpr:
+        lhs, rhs = self.as_operand(lhs_n), self.as_operand(rhs_n)
+        flip = {"_<_": "_>_", "_<=_": "_>=_", "_>_": "_<_", "_>=_": "_<=_"}
+        if isinstance(lhs, ConstOp) and isinstance(rhs, PathOp):
+            lhs, rhs = rhs, lhs
+            fn = flip[fn]
+        if isinstance(lhs, ConstOp):
+            raise Unsupported("constant ordering")
+        assert isinstance(lhs, PathOp)
+        # strings/bools/other under ordering → CPU fallback when seen
+        self._add_fallback(lhs.path, {TAG_STR, TAG_OTHER})
+
+        def cmp(refs, ahi, alo, bhi, blo, fn):
+            lt = (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+            eq = (ahi == bhi) & (alo == blo)
+            if fn == "_<_":
+                return lt
+            if fn == "_<=_":
+                return lt | eq
+            if fn == "_>_":
+                return ~lt & ~eq
+            return ~lt
+
+        if isinstance(rhs, PathOp):
+            self._add_fallback(rhs.path, {TAG_STR, TAG_OTHER})
+
+            def emit_pp(refs, a=lhs.path, b=rhs.path, fn=fn):
+                ta, tb = refs.tag(a), refs.tag(b)
+                numeric = (ta == TAG_NUM) & (tb == TAG_NUM) & ~refs.nan(a) & ~refs.nan(b)
+                err = ~numeric
+                val = numeric & cmp(refs, refs.hi(a), refs.lo(a), refs.hi(b), refs.lo(b), fn)
+                return val, err
+
+            return BoolExpr(emit_pp)
+        cval = rhs.value
+        if isinstance(cval, bool) or not isinstance(cval, (int, float)):
+            raise Unsupported("non-numeric ordering constant")
+        f = float(cval)
+        if f != f:
+            raise Unsupported("NaN ordering constant")
+        hi, lo = split_key(double_key(f))
+
+        def emit_pc(refs, p=lhs.path, hi=hi, lo=lo, fn=fn):
+            tag = refs.tag(p)
+            numeric = (tag == TAG_NUM) & ~refs.nan(p)
+            err = ~numeric
+            xp = refs.xp
+            chi = xp.asarray(hi, dtype=refs.hi(p).dtype)
+            clo = xp.asarray(lo, dtype=refs.lo(p).dtype)
+            val = numeric & cmp(refs, refs.hi(p), refs.lo(p), chi, clo, fn)
+            return val, err
+
+        return BoolExpr(emit_pc)
+
+    def _in(self, lhs_n: A.Node, rhs_n: A.Node) -> BoolExpr:
+        lhs = self.as_operand(lhs_n)
+        rhs = self.as_operand(rhs_n)
+        if isinstance(lhs, PathOp) and isinstance(rhs, ConstOp) and isinstance(rhs.value, list):
+            # OR of equalities against each element
+            parts = []
+            for el in rhs.value:
+                parts.append(self._equality(lhs_n, A.Lit(el), negate=False))
+
+            def emit(refs, parts=parts, p=lhs.path):
+                tag = refs.tag(p)
+                err = (tag == TAG_MISSING) | (tag == TAG_ERR)
+                val = None
+                for part in parts:
+                    v, _ = part.emit(refs)
+                    val = v if val is None else (val | v)
+                if val is None:
+                    xp = refs.xp
+                    val = xp.zeros_like(err)
+                return val & ~err, err
+
+            return BoolExpr(emit)
+        raise Unsupported("in over attribute lists")
+
+    def _add_fallback(self, path: tuple[str, ...], tags: set[int]) -> None:
+        cur = self.k.fallback_tags.get(path, frozenset())
+        self.k.fallback_tags[path] = cur | frozenset(tags)
+
+    interner: StringInterner  # set by compile_condition
+
+
+def _pred_refs(node: A.Node) -> tuple[set[tuple[str, ...]], bool, bool]:
+    """(referenced request paths, references_runtime, time_dependent)."""
+    paths: set[tuple[str, ...]] = set()
+    refs_runtime = False
+    time_dep = False
+    for n in A.walk(node):
+        if isinstance(n, A.Ident):
+            if n.name == "runtime":
+                refs_runtime = True
+        if isinstance(n, A.Call) and n.fn in ("now", "timeSince"):
+            time_dep = True
+        if isinstance(n, A.Select) and isinstance(n.operand, A.Ident) and n.operand.name in _ROOT_ALIASES:
+            paths.add(_ROOT_ALIASES[n.operand.name] + (n.field,))
+    return paths, refs_runtime, time_dep
+
+
+class ConditionSetCompiler:
+    """Compiles the distinct (condition, params) pairs of a rule table."""
+
+    def __init__(self, globals_: dict[str, Any], interner: StringInterner):
+        self.globals = globals_
+        self.interner = interner
+        self.kernels: list[CondKernel] = []
+        self._by_key: dict[tuple[int, int], int] = {}
+        self.preds: list[PredSpec] = []
+
+    def cond_id(self, cond: Optional[CompiledCondition], params: Optional[PolicyParams]) -> int:
+        """Intern a (condition, params) pair; -1 for condition-less.
+
+        Interning is *structural* (condition text + params content), the
+        analogue of the reference's FunctionalCore dedup by behavioral hash
+        (index.go:26-32,119-148): policy corpora replicate identical
+        conditions across many policies, and one kernel serves them all.
+        """
+        if cond is None:
+            return -1
+        id_key = (id(cond), id(params))
+        hit = self._by_key.get(id_key)
+        if hit is not None:
+            return hit
+        struct_key = (_cond_struct_key(cond), _params_struct_key(params))
+        hit = self._by_key.get(struct_key)
+        if hit is not None:
+            self._by_key[id_key] = hit
+            return hit
+        cid = len(self.kernels)
+        kernel = self._compile(cond, params or PolicyParams(), cid)
+        self.kernels.append(kernel)
+        self._by_key[id_key] = cid
+        self._by_key[struct_key] = cid
+        return cid
+
+    def _alloc_pred(self, node: A.Node, params: PolicyParams) -> PredSpec:
+        paths, refs_runtime, time_dep = _pred_refs(node)
+        spec = PredSpec(
+            pred_id=len(self.preds),
+            node=node,
+            params=params,
+            ref_paths=tuple(sorted(paths)),
+            time_dependent=time_dep,
+        )
+        self.preds.append(spec)
+        return spec
+
+    def _compile(self, cond: CompiledCondition, params: PolicyParams, cid: int) -> CondKernel:
+        kernel = CondKernel(cond_id=cid)
+        comp = _Compiler(kernel, params, self.globals, self._alloc_pred)
+        comp.interner = self.interner
+
+        def compile_tree(c: CompiledCondition) -> Callable[[Refs], Any]:
+            """Condition-tree node → emit(refs) -> sat bool array.
+
+            all/any/none combine *satisfied* child results (each child's
+            errors collapse to false at its own boundary — check.go:650-702),
+            which is not the same as CEL && / ||.
+            """
+            if c.kind == "expr":
+                node = comp.inline(c.expr.node)
+                try:
+                    be = comp.compile_bool(node)
+
+                    def emit_expr(refs, be=be):
+                        v, e = be.emit(refs)
+                        return v & ~e
+
+                    return emit_expr
+                except Unsupported:
+                    if kernel.references_runtime:
+                        raise
+                    spec = self._alloc_pred(node, params)
+                    kernel.preds.append(spec)
+
+                    def emit_pred(refs, pid=spec.pred_id):
+                        v, e = refs.pred(pid)
+                        return v & ~e
+
+                    return emit_pred
+            subs = [compile_tree(ch) for ch in c.children]
+            if c.kind == "all":
+                def emit_all(refs, subs=subs):
+                    out = None
+                    for s in subs:
+                        v = s(refs)
+                        out = v if out is None else (out & v)
+                    return out
+                return emit_all
+            if c.kind == "any":
+                def emit_any(refs, subs=subs):
+                    out = None
+                    for s in subs:
+                        v = s(refs)
+                        out = v if out is None else (out | v)
+                    return out
+                return emit_any
+            if c.kind == "none":
+                def emit_none(refs, subs=subs):
+                    out = None
+                    for s in subs:
+                        v = s(refs)
+                        out = v if out is None else (out | v)
+                    return ~out
+                return emit_none
+            raise ValueError(f"unknown condition kind {c.kind}")
+
+        try:
+            kernel.emit = compile_tree(cond)
+        except Unsupported:
+            # runtime-referencing conditions can't be batched at all
+            kernel.emit = None
+        return kernel
+
+
+def _cond_struct_key(c: CompiledCondition):
+    if c.kind == "expr":
+        return ("e", c.expr.original)
+    return (c.kind[0], tuple(_cond_struct_key(ch) for ch in c.children))
+
+
+def _freeze_val(v):
+    if isinstance(v, list):
+        return tuple(_freeze_val(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze_val(x)) for k, x in v.items()))
+    return v
+
+
+def _params_struct_key(params: Optional[PolicyParams]):
+    if params is None:
+        return None
+    return (
+        tuple(sorted((k, _freeze_val(v)) for k, v in params.constants.items())),
+        tuple((v.name, v.expr.original) for v in params.ordered_variables),
+    )
+
+
+def evaluate_pred_host(spec: PredSpec, input_obj, eval_ctx_factory) -> tuple[bool, bool]:
+    """Evaluate a predicate column entry on the host → (value, error)."""
+    from ..cel.interp import evaluate
+
+    act = eval_ctx_factory(spec.params)
+    try:
+        v = evaluate(spec.node, act)
+    except CelError:
+        return False, True
+    return v is True, False

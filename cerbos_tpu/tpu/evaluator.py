@@ -1,0 +1,473 @@
+"""Batched device evaluator: condition kernels + effect-resolution lattice.
+
+The device computes ``sat_cond[B, C]`` (every distinct condition over every
+input) and resolves effects as a masked reduction over
+(policy-type, role-slot, scope-depth) — the reference's sequential
+short-circuits (check.go:183-438) become "evaluate everything, select by
+priority", which is sound because conditions are pure. The host then
+assembles CheckOutputs, reconstructing policy attribution, outputs and
+effective derived roles from the device's winning (pt, role, depth, j).
+
+Sharding: the batch axis shards over a jax Mesh ("data" axis); candidate
+tensors are batch-aligned so the same jit works single-chip or multi-chip
+(see cerbos_tpu.parallel.mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+from .. import namer
+from ..engine import types as T
+from ..ruletable.check import EvalContext, build_request_messages, check_input
+from ..ruletable.table import RuleTable
+from .condcompile import Refs
+from .lowering import (
+    EFFECT_ALLOW_CODE,
+    EFFECT_DENY_CODE,
+    LoweredTable,
+    SP_OVERRIDE,
+    lower_table,
+)
+from .packer import PackedBatch, Packer, PT_PRINCIPAL, PT_RESOURCE
+
+CODE_NO_MATCH = 0
+CODE_ALLOW = 1
+CODE_DENY = 2
+
+_BIG = 127
+
+
+def _compute(
+    xp,
+    kernels,
+    K: int,
+    J: int,
+    D: int,
+    tags,
+    his,
+    los,
+    sids,
+    nans,
+    pred_vals,
+    pred_errs,
+    ba_input,
+    cand_cond,
+    cand_drcond,
+    cand_effect,
+    cand_pt,
+    cand_depth,
+    cand_valid,
+    scope_sp,
+):
+    """Pure array computation: jittable with `xp=jnp`, testable with numpy.
+
+    Returns (final [BA,4], role_results [BA,K,2,2], win_j [BA,K,2],
+    sat_cond [B,C]) — see module docstring for the lattice.
+    """
+    refs = Refs(xp, tags, his, los, sids, nans, pred_vals, pred_errs)
+    B = next(iter(tags.values())).shape[0] if tags else (next(iter(pred_vals.values())).shape[0] if pred_vals else 1)
+
+    sat_list = []
+    for k in kernels:
+        if k.emit is None:
+            sat_list.append(xp.zeros(B, dtype=bool))
+        else:
+            sat_list.append(k.emit(refs))
+    C = len(kernels)
+    if C:
+        sat_cond = xp.stack(sat_list, axis=1)  # [B, C]
+    else:
+        sat_cond = xp.zeros((B, 1), dtype=bool)
+
+    BA = cand_cond.shape[0]
+    sat_by_input = sat_cond[ba_input]  # [BA, C]
+
+    ba_idx = xp.arange(BA)[:, None, None]
+    cond_ok = cand_cond >= 0
+    drcond_ok = cand_drcond >= 0
+    cond_safe = xp.where(cond_ok, cand_cond, 0)
+    drcond_safe = xp.where(drcond_ok, cand_drcond, 0)
+    sat_c = xp.where(cond_ok, sat_by_input[ba_idx, cond_safe], True)
+    sat_dr = xp.where(drcond_ok, sat_by_input[ba_idx, drcond_safe], True)
+    sat = cand_valid & sat_c & sat_dr  # [BA, K, J]
+
+    deny_mask = sat & (cand_effect == EFFECT_DENY_CODE)
+    allow_mask = sat & (cand_effect == EFFECT_ALLOW_CODE)
+
+    sp_by_ba = scope_sp[ba_input]  # [BA, 2, D]
+
+    role_codes = []
+    role_depths = []
+    winjs = []
+    for pt in (PT_PRINCIPAL, PT_RESOURCE):
+        pt_mask = cand_pt == pt
+        # per-depth any / first-j
+        code = xp.zeros((BA, K), dtype=xp.int8)
+        depth_out = xp.full((BA, K), D, dtype=xp.int8)
+        wj = xp.full((BA, K), -1, dtype=xp.int8)
+        decided = xp.zeros((BA, K), dtype=bool)
+        for d in range(D):
+            at_d = pt_mask & (cand_depth == d)
+            deny_d = (deny_mask & at_d).any(axis=2)  # [BA, K]
+            allow_d = (allow_mask & at_d).any(axis=2)
+            sp_d = sp_by_ba[:, pt, d][:, None]  # [BA, 1]
+            allow_ok = allow_d & (sp_d == SP_OVERRIDE)
+            # first satisfied deny j at this depth
+            j_idx = xp.arange(J)[None, None, :]
+            deny_j = xp.where(deny_mask & at_d, j_idx, _BIG).min(axis=2)  # [BA, K]
+            newly_deny = ~decided & deny_d
+            newly_allow = ~decided & ~deny_d & allow_ok
+            code = xp.where(newly_deny, CODE_DENY, xp.where(newly_allow, CODE_ALLOW, code))
+            depth_out = xp.where(newly_deny | newly_allow, d, depth_out)
+            wj = xp.where(newly_deny, deny_j.astype(xp.int8), wj)
+            decided = decided | newly_deny | newly_allow
+        role_codes.append(code)
+        role_depths.append(depth_out)
+        winjs.append(wj)
+
+    role_results = xp.stack(
+        [xp.stack([role_codes[0], role_depths[0]], axis=-1), xp.stack([role_codes[1], role_depths[1]], axis=-1)],
+        axis=2,
+    )  # [BA, K, 2(pt), 2(code,depth)]
+    win_j = xp.stack(winjs, axis=2)  # [BA, K, 2]
+
+    # merge roles within each policy type:
+    #   first role with ALLOW wins; else first role with any non-NO_MATCH
+    def merge(codes, depths, wjs, single_role: bool):
+        if single_role:
+            return codes[:, 0], depths[:, 0], wjs[:, 0], xp.zeros(codes.shape[0], dtype=xp.int8)
+        k_idx = xp.arange(K)[None, :]
+        allow_k = xp.where(codes == CODE_ALLOW, k_idx, _BIG).min(axis=1)
+        nonmatch_k = xp.where(codes != CODE_NO_MATCH, k_idx, _BIG).min(axis=1)
+        pick = xp.where(allow_k < _BIG, allow_k, xp.where(nonmatch_k < _BIG, nonmatch_k, 0))
+        pick = pick.astype(xp.int32)
+        rows = xp.arange(codes.shape[0])
+        return codes[rows, pick], depths[rows, pick], wjs[rows, pick], pick.astype(xp.int8)
+
+    p_code, p_depth, p_wj, p_k = merge(role_codes[0], role_depths[0], winjs[0], single_role=True)
+    r_code, r_depth, r_wj, r_k = merge(role_codes[1], role_depths[1], winjs[1], single_role=False)
+
+    use_p = p_code != CODE_NO_MATCH
+    f_code = xp.where(use_p, p_code, r_code)
+    f_pt = xp.where(use_p, PT_PRINCIPAL, PT_RESOURCE).astype(xp.int8)
+    f_depth = xp.where(use_p, p_depth, r_depth)
+    f_k = xp.where(use_p, p_k, r_k)
+    final = xp.stack([f_code.astype(xp.int8), f_pt, f_depth.astype(xp.int8), f_k], axis=1)
+
+    return final, role_results, win_j, sat_cond
+
+
+def _next_bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _device_eval(lt: LoweredTable, batch: PackedBatch, use_jax: bool = True, jit_cache: Optional[dict] = None):
+    """Run _compute, optionally through a shape-bucketed jax.jit cache."""
+    kernels = lt.compiler.kernels
+    K, J, D = batch.K, batch.J, batch.D
+    BA = batch.cand_cond.shape[0]
+    B = batch.columns.size
+
+    if BA == 0:
+        C = max(len(kernels), 1)
+        return (
+            np.zeros((0, 4), dtype=np.int8),
+            np.zeros((0, K, 2, 2), dtype=np.int8),
+            np.zeros((0, K, 2), dtype=np.int8),
+            np.zeros((B, C), dtype=bool),
+        )
+
+    cols = batch.columns
+    arrays = dict(
+        tags=cols.tags, his=cols.his, los=cols.los, sids=cols.sids, nans=cols.nans,
+        pred_vals=cols.pred_vals, pred_errs=cols.pred_errs,
+        ba_input=batch.ba_input, cand_cond=batch.cand_cond, cand_drcond=batch.cand_drcond,
+        cand_effect=batch.cand_effect, cand_pt=batch.cand_pt, cand_depth=batch.cand_depth,
+        cand_valid=batch.cand_valid, scope_sp=batch.scope_sp,
+    )
+
+    if not use_jax:
+        final, role_results, win_j, sat_cond = _compute(np, kernels, K, J, D, **arrays)
+        return np.asarray(final), np.asarray(role_results), np.asarray(win_j), np.asarray(sat_cond)
+
+    import jax
+    import jax.numpy as jnp
+
+    # pad to shape buckets so jit traces are reused across batches
+    B_pad = _next_bucket(B)
+    BA_pad = _next_bucket(BA)
+
+    def pad_b(a: np.ndarray) -> np.ndarray:
+        if a.shape[0] == B_pad:
+            return a
+        return np.concatenate([a, np.zeros((B_pad - a.shape[0],) + a.shape[1:], dtype=a.dtype)])
+
+    def pad_ba(a: np.ndarray, fill=0) -> np.ndarray:
+        if a.shape[0] == BA_pad:
+            return a
+        pad = np.full((BA_pad - a.shape[0],) + a.shape[1:], fill, dtype=a.dtype)
+        return np.concatenate([a, pad])
+
+    padded = dict(
+        tags={p: pad_b(a) for p, a in cols.tags.items()},
+        his={p: pad_b(a) for p, a in cols.his.items()},
+        los={p: pad_b(a) for p, a in cols.los.items()},
+        sids={p: pad_b(a) for p, a in cols.sids.items()},
+        nans={p: pad_b(a) for p, a in cols.nans.items()},
+        pred_vals={i: pad_b(a) for i, a in cols.pred_vals.items()},
+        pred_errs={i: pad_b(a) for i, a in cols.pred_errs.items()},
+        ba_input=pad_ba(batch.ba_input),
+        cand_cond=pad_ba(batch.cand_cond, -1),
+        cand_drcond=pad_ba(batch.cand_drcond, -1),
+        cand_effect=pad_ba(batch.cand_effect),
+        cand_pt=pad_ba(batch.cand_pt),
+        cand_depth=pad_ba(batch.cand_depth, -1),
+        cand_valid=pad_ba(batch.cand_valid),
+        scope_sp=pad_b(batch.scope_sp),
+    )
+
+    if jit_cache is None:
+        jit_cache = {}
+    key = (B_pad, BA_pad, K, J)
+    fn = jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda **kw: _compute(jnp, kernels, K, J, D, **kw))
+        jit_cache[key] = fn
+    final, role_results, win_j, sat_cond = fn(**padded)
+    return (
+        np.asarray(final)[:BA],
+        np.asarray(role_results)[:BA],
+        np.asarray(win_j)[:BA],
+        np.asarray(sat_cond)[:B],
+    )
+
+
+class TpuEvaluator:
+    """Batched evaluator over a lowered rule table.
+
+    Drop-in for the engine's ``tpu_evaluator`` hook: bit-exact effects vs the
+    CPU oracle, with automatic per-input oracle fallback for anything outside
+    device coverage.
+    """
+
+    def __init__(
+        self,
+        rule_table: RuleTable,
+        globals_: Optional[dict[str, Any]] = None,
+        schema_mgr: Any = None,
+        max_roles: int = 8,
+        max_candidates: int = 32,
+        max_depth: int = 8,
+        use_jax: bool = True,
+    ):
+        self.rule_table = rule_table
+        self.schema_mgr = schema_mgr
+        self.lowered = lower_table(rule_table, globals_)
+        self.packer = Packer(self.lowered, max_roles=max_roles, max_candidates=max_candidates, max_depth=max_depth)
+        self.use_jax = use_jax
+        self.stats = {"device_inputs": 0, "oracle_inputs": 0, "trivial_inputs": 0}
+        self._jit_cache: dict = {}
+
+    def refresh(self) -> None:
+        """Re-lower after a policy reload (storage event hook)."""
+        self.lowered.refresh()
+        self.packer.invalidate()
+        self._jit_cache.clear()
+
+    def check(self, inputs: list[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
+        params = params or T.EvalParams()
+        batch = self.packer.pack(inputs, params)
+        final, role_results, win_j, sat_cond = _device_eval(
+            self.lowered, batch, use_jax=self.use_jax, jit_cache=self._jit_cache
+        )
+
+        outputs: list[T.CheckOutput] = []
+        for bi, plan in enumerate(batch.plans):
+            inp = plan.input
+            if plan.oracle:
+                self.stats["oracle_inputs"] += 1
+                outputs.append(check_input(self.rule_table, inp, params, self.schema_mgr))
+                continue
+            if plan.trivial:
+                self.stats["trivial_inputs"] += 1
+                out = T.CheckOutput(request_id=inp.request_id, resource_id=inp.resource.id)
+                for action in inp.actions:
+                    out.actions[action] = T.ActionEffect(effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH)
+                outputs.append(out)
+                continue
+            self.stats["device_inputs"] += 1
+            outputs.append(self._assemble(plan, bi, batch, final, role_results, win_j, sat_cond, params))
+        return outputs
+
+    # -- host assembly -----------------------------------------------------
+
+    def _assemble(self, plan, bi, batch: PackedBatch, final, role_results, win_j, sat_cond, params) -> T.CheckOutput:
+        inp = plan.input
+        out = T.CheckOutput(request_id=inp.request_id, resource_id=inp.resource.id)
+        start, end = plan.ba_range
+        action_to_ba = {batch.ba_action[ci]: ci for ci in range(start, end)}
+
+        processed_scopes: set[int] = set()  # resource-chain depths processed
+        output_entries: list[T.OutputEntry] = []
+        ec_cache: dict[int, Any] = {}
+
+        def eval_ctx():
+            if "ec" not in ec_cache:
+                request, principal, resource = build_request_messages(inp)
+                ec_cache["ec"] = EvalContext(params, request, principal, resource)
+            return ec_cache["ec"]
+
+        for action in inp.actions:
+            ci = action_to_ba.get(action)
+            if ci is None:
+                out.actions[action] = T.ActionEffect(effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH)
+                continue
+            code, pt, depth, k = (int(x) for x in final[ci])
+
+            chain = plan.principal_scopes if pt == PT_PRINCIPAL else plan.resource_scopes
+            main_key = plan.principal_policy_key if pt == PT_PRINCIPAL else plan.resource_policy_key
+            exists = plan.scoped_principal_exists if pt == PT_PRINCIPAL else plan.scoped_resource_exists
+
+            if code == CODE_ALLOW:
+                ae = T.ActionEffect(effect=T.EFFECT_ALLOW, policy=main_key, scope=chain[depth] if depth < len(chain) else "")
+            elif code == CODE_DENY:
+                policy = main_key if exists else T.NO_POLICY_MATCH
+                wj = int(win_j[ci, k, pt])
+                if 0 <= wj:
+                    entry = self._entry_at(batch, ci, k, wj)
+                    if entry is not None and entry.from_role_policy:
+                        policy = namer.policy_key_from_fqn(entry.origin_fqn)
+                ae = T.ActionEffect(effect=T.EFFECT_DENY, policy=policy, scope=chain[depth] if depth < len(chain) else "")
+            else:
+                # NO_MATCH → default deny (resource-pass attribution)
+                policy = plan.resource_policy_key if plan.scoped_resource_exists else T.NO_POLICY_MATCH
+                ae = T.ActionEffect(effect=T.EFFECT_DENY, policy=policy)
+            out.actions[action] = ae
+
+            # reconstruct processed resource-chain depths + emitted outputs
+            self._reconstruct(
+                plan, bi, batch, ci, role_results, win_j, sat_cond,
+                processed_scopes, output_entries, eval_ctx,
+            )
+
+        # effective derived roles for processed resource scopes
+        if processed_scopes:
+            out.effective_derived_roles = self._effective_derived_roles(
+                plan, bi, sorted(processed_scopes), params, eval_ctx, sat_cond
+            )
+        out.outputs = output_entries
+        return out
+
+    def _entry_at(self, batch: PackedBatch, ci: int, k: int, j: int):
+        per_k = batch.cand_entries[ci]
+        if k < len(per_k) and j < len(per_k[k]):
+            return per_k[k][j]
+        return None
+
+    def _reconstruct(self, plan, bi, batch, ci, role_results, win_j, sat_cond, processed_scopes, output_entries, eval_ctx):
+        """Mirror the visit order to collect processed scopes + outputs."""
+        inp = plan.input
+        sat_b = sat_cond[bi]
+        # principal pass decided?
+        p_code = int(role_results[ci, 0, PT_PRINCIPAL, 0])
+        passes = [(PT_PRINCIPAL, [0])]
+        if p_code == CODE_NO_MATCH:
+            ks = list(range(min(len(plan.roles), batch.K)))
+            passes.append((PT_RESOURCE, ks))
+
+        for pt, ks in passes:
+            chain = plan.principal_scopes if pt == PT_PRINCIPAL else plan.resource_scopes
+            for k in ks:
+                code = int(role_results[ci, k, pt, 0])
+                depth = int(role_results[ci, k, pt, 1])
+                max_depth = min(depth, len(chain) - 1) if code != CODE_NO_MATCH else len(chain) - 1
+                if pt == PT_RESOURCE:
+                    for d in range(0, max_depth + 1):
+                        processed_scopes.add(d)
+                # outputs from visited candidates
+                entries = batch.cand_entries[ci][k] if k < len(batch.cand_entries[ci]) else []
+                wj = int(win_j[ci, k, pt]) if code == CODE_DENY else -1
+                for j, e in enumerate(entries):
+                    if e is None or e.pt != pt:
+                        continue
+                    if code != CODE_NO_MATCH and e.depth > depth:
+                        continue
+                    if code == CODE_DENY and e.depth == depth and wj >= 0 and j > wj:
+                        continue
+                    if not e.has_output or e.row is None or e.row.emit_output is None:
+                        continue
+                    sat = True
+                    if e.cond_id >= 0:
+                        sat = bool(sat_b[e.cond_id])
+                    if e.drcond_id >= 0 and not bool(sat_b[e.drcond_id]):
+                        continue  # derived-role condition unmet: rule skipped entirely
+                    emit = e.row.emit_output
+                    expr = emit.rule_activated if sat else emit.condition_not_met
+                    if expr is None:
+                        continue
+                    ec = eval_ctx()
+                    constants, variables = {}, {}
+                    if e.row.params is not None:
+                        constants = e.row.params.constants
+                        variables = ec.evaluate_variables(constants, e.row.params.ordered_variables)
+                    src = self._rule_src(e)
+                    output_entries.append(
+                        ec.evaluate_output(e.row.name, src, batch.ba_action[ci], expr, constants, variables)
+                    )
+                # stop visiting further roles if this role allowed
+                if code == CODE_ALLOW:
+                    break
+
+    def _rule_src(self, e) -> str:
+        meta = self.rule_table.get_meta(e.origin_fqn)
+        b = e.row
+        if meta is None:
+            return f"{namer.policy_key_from_fqn(e.origin_fqn)}#{b.name}"
+        if meta.kind == "PRINCIPAL":
+            fqn = namer.principal_policy_fqn(meta.name, meta.version, b.scope)
+        elif meta.kind == "RESOURCE":
+            fqn = namer.resource_policy_fqn(meta.name, meta.version, b.scope)
+        else:
+            fqn = namer.role_policy_fqn(meta.name, meta.version, b.scope)
+        return f"{namer.policy_key_from_fqn(fqn)}#{b.name}"
+
+    def _effective_derived_roles(self, plan, bi, depths, params, eval_ctx, sat_cond) -> list[str]:
+        inp = plan.input
+        resource_version = T.effective_version(inp.resource.policy_version, params)
+        rt = self.rule_table
+        all_roles = set(rt.idx.add_parent_roles(
+            [T.effective_scope(inp.resource.scope, params)], list(inp.principal.roles)
+        ))
+        edr: set[str] = set()
+        sat_b = sat_cond[bi]
+        for d in depths:
+            if d >= len(plan.resource_scopes):
+                continue
+            scope = plan.resource_scopes[d]
+            drs = rt.get_derived_roles(namer.resource_policy_fqn(inp.resource.kind, resource_version, scope))
+            if not drs:
+                continue
+            for name, dr in drs.items():
+                if name in edr or not (dr.parent_roles & all_roles):
+                    continue
+                if dr.condition is None:
+                    edr.add(name)
+                    continue
+                cid = self.lowered.dr_cond_ids.get(id(dr), -1)
+                if cid >= 0 and self.lowered.compiler.kernels[cid].emit is not None:
+                    if bool(sat_b[cid]):
+                        edr.add(name)
+                    continue
+                # condition outside device coverage: host-evaluate
+                ec = eval_ctx()
+                variables = ec.evaluate_variables(dr.params.constants, dr.params.ordered_variables)
+                if ec.satisfies_condition(dr.condition, dr.params.constants, variables):
+                    edr.add(name)
+        return sorted(edr)

@@ -1,0 +1,126 @@
+"""Rule table → lowered static tables for the device evaluator.
+
+Produces per-row static metadata (effect codes, policy kinds, condition ids)
+and the compiled condition kernel set. Role-policy rows additionally get
+pre-negated condition ids for query-time synthetic DENYs (the reference
+builds those bindings on the fly, index.go:472-509; here the negation is
+interned once at lowering).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..compile import CompiledCondition
+from ..ruletable.rows import KIND_PRINCIPAL, RuleRow
+from ..ruletable.table import RuleTable
+from ..policy.model import (
+    SCOPE_PERMISSIONS_OVERRIDE_PARENT,
+    SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT,
+)
+from .columns import StringInterner
+from .condcompile import ConditionSetCompiler
+
+EFFECT_NONE = 0
+EFFECT_ALLOW_CODE = 1
+EFFECT_DENY_CODE = 2
+
+SP_UNSPECIFIED = 0
+SP_OVERRIDE = 1
+SP_RPC = 2
+
+
+def sp_code(sp: str) -> int:
+    if sp == SCOPE_PERMISSIONS_OVERRIDE_PARENT:
+        return SP_OVERRIDE
+    if sp == SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT:
+        return SP_RPC
+    return SP_UNSPECIFIED
+
+
+@dataclass
+class LoweredRow:
+    row: RuleRow
+    cond_id: int
+    drcond_id: int
+    effect_code: int
+    is_principal: bool
+    needs_oracle: bool
+    # role-policy rows only: condition id of none(condition) for synthetic denies
+    negated_cond_id: int = -1
+
+
+@dataclass
+class LoweredTable:
+    table: RuleTable
+    compiler: ConditionSetCompiler
+    interner: StringInterner
+    rows: dict[int, LoweredRow] = field(default_factory=dict)  # by RuleRow.id
+    paths: set[tuple[str, ...]] = field(default_factory=set)
+    fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
+    dr_cond_ids: dict[int, int] = field(default_factory=dict)  # id(CompiledDerivedRole) -> cond id
+
+    def refresh(self) -> None:
+        """(Re)lower all rows currently in the index. Called at build and on
+        storage reload events (the re-lower + device swap hook)."""
+        self.rows.clear()
+        for row in self.table.idx.get_all_rows():
+            self.rows[row.id] = self._lower_row(row)
+        # derived-role conditions get kernels too, so effectiveDerivedRoles
+        # can be read off the device sat matrix instead of host CEL re-eval
+        self.dr_cond_ids = {}
+        for drs in self.table.policy_derived_roles.values():
+            for dr in drs.values():
+                if dr.condition is not None:
+                    self.dr_cond_ids[id(dr)] = self.compiler.cond_id(dr.condition, dr.params)
+        self._collect_paths()
+
+    def _lower_row(self, row: RuleRow) -> LoweredRow:
+        cond_id = self.compiler.cond_id(row.condition, row.params)
+        drcond_id = self.compiler.cond_id(row.derived_role_condition, row.derived_role_params)
+        needs_oracle = False
+        for cid in (cond_id, drcond_id):
+            if cid >= 0 and self.compiler.kernels[cid].emit is None:
+                needs_oracle = True
+        effect_code = EFFECT_NONE
+        if row.effect == "EFFECT_ALLOW":
+            effect_code = EFFECT_ALLOW_CODE
+        elif row.effect == "EFFECT_DENY":
+            effect_code = EFFECT_DENY_CODE
+        negated_cond_id = -1
+        if row.allow_actions is not None and row.condition is not None:
+            neg = CompiledCondition(kind="none", children=(row.condition,))
+            negated_cond_id = self.compiler.cond_id(neg, row.params)
+            if self.compiler.kernels[negated_cond_id].emit is None:
+                needs_oracle = True
+        return LoweredRow(
+            row=row,
+            cond_id=cond_id,
+            drcond_id=drcond_id,
+            effect_code=effect_code,
+            is_principal=row.policy_kind == KIND_PRINCIPAL,
+            needs_oracle=needs_oracle,
+            negated_cond_id=negated_cond_id,
+        )
+
+    def _collect_paths(self) -> None:
+        self.paths.clear()
+        self.fallback_tags.clear()
+        for k in self.compiler.kernels:
+            self.paths |= k.paths
+            for p, tags in k.fallback_tags.items():
+                self.fallback_tags[p] = self.fallback_tags.get(p, frozenset()) | tags
+            for spec in k.preds:
+                # predicate columns resolve their own paths on the host
+                pass
+
+
+def lower_table(rt: RuleTable, globals_: Optional[dict[str, Any]] = None) -> LoweredTable:
+    interner = StringInterner()
+    compiler = ConditionSetCompiler(globals_ or {}, interner)
+    lt = LoweredTable(table=rt, compiler=compiler, interner=interner)
+    lt.refresh()
+    return lt

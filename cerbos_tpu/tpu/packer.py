@@ -1,0 +1,530 @@
+"""Request batch → device tensors.
+
+The packer is the host half of the TPU evaluator: it resolves scope chains,
+expands parent roles, gathers candidate rule rows per (input, action, role)
+— by calling the same Index.query the CPU oracle uses, memoized per
+dimension tuple — and encodes attribute columns. Inputs the device cannot
+evaluate faithfully (candidate overflow, unsupported value shapes at
+device-compared paths, runtime-referencing conditions) are flagged for CPU
+oracle fallback, so device coverage is a performance property, never a
+correctness property.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from .. import namer
+from ..engine import types as T
+from ..ruletable.rows import KIND_PRINCIPAL, KIND_RESOURCE, RuleRow
+from ..ruletable.check import EvalContext, build_request_messages
+from .columns import (
+    ColumnBatch,
+    TAG_OTHER,
+    encode_value,
+    resolve_path,
+)
+from .condcompile import evaluate_pred_host
+from .lowering import (
+    EFFECT_ALLOW_CODE,
+    EFFECT_DENY_CODE,
+    EFFECT_NONE,
+    LoweredTable,
+    sp_code,
+)
+
+PT_PRINCIPAL = 0
+PT_RESOURCE = 1
+
+
+@dataclass
+class CandEntry:
+    """One candidate binding for an (input, action, role) cell."""
+
+    cond_id: int
+    drcond_id: int
+    effect: int
+    pt: int
+    depth: int
+    from_role_policy: bool
+    origin_fqn: str
+    row: Optional[RuleRow]  # original row (for outputs); None for pure synthetics
+    needs_oracle: bool
+    has_output: bool
+
+
+@dataclass
+class InputPlan:
+    input: T.CheckInput
+    principal_scopes: list[str]
+    resource_scopes: list[str]
+    principal_policy_key: str
+    resource_policy_key: str
+    resource_policy_fqn: str
+    scoped_principal_exists: bool
+    scoped_resource_exists: bool
+    roles: list[str]
+    oracle: bool = False  # fall back to the CPU oracle for this input
+    trivial: bool = False  # no scopes/rows at all: every action default-DENY
+    ba_range: tuple[int, int] = (0, 0)  # [start, end) in the flattened axis
+
+
+@dataclass
+class PackedBatch:
+    plans: list[InputPlan]
+    columns: ColumnBatch
+    # flattened (input, action) axis
+    ba_input: np.ndarray  # [BA] int32 → input index
+    ba_action: list[str]
+    # candidates [BA, K, J]
+    cand_cond: np.ndarray
+    cand_drcond: np.ndarray
+    cand_effect: np.ndarray
+    cand_pt: np.ndarray
+    cand_depth: np.ndarray
+    cand_valid: np.ndarray
+    # scope permissions per input [B, 2, D]
+    scope_sp: np.ndarray
+    # host-side candidate entries for attribution/output reconstruction
+    cand_entries: list[list[list[Optional[CandEntry]]]]  # [BA][K][J]
+    K: int
+    J: int
+    D: int
+
+
+class Packer:
+    def __init__(self, lowered: LoweredTable, max_roles: int = 8, max_candidates: int = 32, max_depth: int = 8):
+        self.lt = lowered
+        self.K = max_roles
+        self.J = max_candidates
+        self.D = max_depth
+        self._cand_cache: dict[tuple, Optional[list[list[CandEntry]]]] = {}
+        self._pred_cache: dict[tuple, tuple[bool, bool]] = {}
+        self._scope_cache: dict[tuple, tuple] = {}
+        self._exists_cache: dict[tuple, bool] = {}
+        self._cell_cache: dict[tuple, Optional[tuple]] = {}
+
+    def invalidate(self) -> None:
+        self._cand_cache.clear()
+        self._pred_cache.clear()
+        self._scope_cache.clear()
+        self._exists_cache.clear()
+        self._cell_cache.clear()
+
+    def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
+        key = (kind, scope, name, version, lenient)
+        hit = self._scope_cache.get(key)
+        if hit is None:
+            hit = self.lt.table.get_all_scopes(kind, scope, name, version, lenient)
+            self._scope_cache[key] = hit
+        return hit
+
+    def _exists(self, kind: str, version: str, name: str, scopes: list[str]) -> bool:
+        key = (kind, version, name, tuple(scopes))
+        hit = self._exists_cache.get(key)
+        if hit is None:
+            idx = self.lt.table.idx
+            if kind == KIND_PRINCIPAL:
+                hit = idx.scoped_principal_exists(version, scopes)
+            else:
+                hit = idx.scoped_resource_exists(version, name, scopes)
+            self._exists_cache[key] = hit
+        return hit
+
+    # -- candidate generation ---------------------------------------------
+
+    def _candidates(
+        self,
+        pt: int,
+        version: str,
+        resource: str,
+        chain: tuple[str, ...],
+        action: str,
+        role: str,
+        pid: str,
+        resource_scope: str,
+    ) -> Optional[list[list[CandEntry]]]:
+        """Candidates per depth for one (pt, action, role); None → oracle."""
+        key = (pt, version, resource, chain, action, role, pid, resource_scope)
+        hit = self._cand_cache.get(key, False)
+        if hit is not False:
+            return hit
+        rt = self.lt.table
+        kind = KIND_PRINCIPAL if pt == PT_PRINCIPAL else KIND_RESOURCE
+        # parent roles expand against the input's resource scope, matching
+        # check.go:221 (AddParentRoles([resourceScope], [role]))
+        parent_roles = rt.idx.add_parent_roles([resource_scope], [role])
+        out: list[list[CandEntry]] = []
+        ok = True
+        for depth, scope in enumerate(chain):
+            if depth >= self.D:
+                ok = False
+                break
+            rows = rt.idx.query(version, resource, scope, action, parent_roles, kind, pid)
+            entries: list[CandEntry] = []
+            for r in rows:
+                e = self._lower_candidate(r, pt, depth)
+                if e is None or e.needs_oracle:
+                    ok = False
+                entries.append(e)  # keep shape; caller bails on not ok
+            out.append(entries)
+        result = out if ok else None
+        self._cand_cache[key] = result
+        return result
+
+    def _lower_candidate(self, r: RuleRow, pt: int, depth: int) -> Optional[CandEntry]:
+        lt = self.lt
+        lr = lt.rows.get(r.id) if r.id >= 0 else None
+        if lr is not None and lr.row is r:
+            # regular indexed row
+            return CandEntry(
+                cond_id=lr.cond_id,
+                drcond_id=lr.drcond_id,
+                effect=lr.effect_code,
+                pt=pt,
+                depth=depth,
+                from_role_policy=r.from_role_policy,
+                origin_fqn=r.origin_fqn,
+                row=r,
+                needs_oracle=lr.needs_oracle,
+                has_output=r.emit_output is not None,
+            )
+        # synthetic bindings produced by Index.query
+        if r.no_match_for_scope_permissions:
+            return CandEntry(
+                cond_id=-1, drcond_id=-1, effect=EFFECT_DENY_CODE, pt=pt, depth=depth,
+                from_role_policy=True, origin_fqn=r.origin_fqn, row=r,
+                needs_oracle=False, has_output=False,
+            )
+        if r.from_role_policy and r.id >= 0:
+            lr = lt.rows.get(r.id)
+            if lr is None:
+                return None
+            if r.effect == "EFFECT_DENY":
+                # negated-condition synthetic deny
+                return CandEntry(
+                    cond_id=lr.negated_cond_id, drcond_id=-1, effect=EFFECT_DENY_CODE,
+                    pt=pt, depth=depth, from_role_policy=True, origin_fqn=r.origin_fqn,
+                    row=r, needs_oracle=lr.negated_cond_id >= 0 and lt.compiler.kernels[lr.negated_cond_id].emit is None,
+                    has_output=r.emit_output is not None,
+                )
+            # no-effect output carrier
+            return CandEntry(
+                cond_id=-1, drcond_id=-1, effect=EFFECT_NONE, pt=pt, depth=depth,
+                from_role_policy=True, origin_fqn=r.origin_fqn, row=r,
+                needs_oracle=False, has_output=r.emit_output is not None,
+            )
+        return None
+
+    # -- packing -----------------------------------------------------------
+
+    def pack(self, inputs: list[T.CheckInput], params: T.EvalParams) -> PackedBatch:
+        rt = self.lt.table
+        plans: list[InputPlan] = []
+        for inp in inputs:
+            principal_scope = T.effective_scope(inp.principal.scope, params)
+            principal_version = T.effective_version(inp.principal.policy_version, params)
+            resource_scope = T.effective_scope(inp.resource.scope, params)
+            resource_version = T.effective_version(inp.resource.policy_version, params)
+            p_scopes, p_key, _p_fqn = self._get_all_scopes(
+                KIND_PRINCIPAL, principal_scope, inp.principal.id, principal_version, params.lenient_scope_search
+            )
+            r_scopes, r_key, r_fqn = self._get_all_scopes(
+                KIND_RESOURCE, resource_scope, inp.resource.kind, resource_version, params.lenient_scope_search
+            )
+            plan = InputPlan(
+                input=inp,
+                principal_scopes=p_scopes,
+                resource_scopes=r_scopes,
+                principal_policy_key=p_key,
+                resource_policy_key=r_key,
+                resource_policy_fqn=r_fqn,
+                scoped_principal_exists=self._exists(KIND_PRINCIPAL, principal_version, "", p_scopes),
+                scoped_resource_exists=self._exists(
+                    KIND_RESOURCE, resource_version, namer.sanitize(inp.resource.kind), r_scopes
+                ),
+                roles=list(inp.principal.roles),
+            )
+            if not p_scopes and not r_scopes:
+                plan.trivial = True
+            elif not plan.scoped_principal_exists and not plan.scoped_resource_exists:
+                plan.trivial = True
+            if len(plan.roles) > self.K or len(p_scopes) > self.D or len(r_scopes) > self.D:
+                plan.oracle = True
+            plans.append(plan)
+
+        # Per-(input, action) candidate cells, memoized by shape key. The cell
+        # block for one (version, kind, chains, roles, action, pid) tuple is
+        # identical across inputs — real traffic repeats a few hundred shapes.
+        cell_blocks = self._cell_cache
+
+        def cell_block(plan: InputPlan, action: str) -> Optional[tuple]:
+            inp = plan.input
+            resource_version = T.effective_version(inp.resource.policy_version, params)
+            resource_scope = T.effective_scope(inp.resource.scope, params)
+            pid = inp.principal.id
+            if pid not in self.lt.table.idx.principal:
+                pid_key = ""
+            else:
+                pid_key = pid
+            key = (
+                resource_version, inp.resource.kind, tuple(plan.principal_scopes),
+                tuple(plan.resource_scopes), tuple(plan.roles), action, pid_key, resource_scope,
+            )
+            hit = cell_blocks.get(key, False)
+            if hit is not False:
+                return hit
+            sanitized = namer.sanitize(inp.resource.kind)
+            per_k_entries: list[list[CandEntry]] = []
+            ok = True
+            for k, role in enumerate(plan.roles):
+                entries: list[CandEntry] = []
+                for pt, chain, qpid in (
+                    (PT_PRINCIPAL, tuple(plan.principal_scopes), pid),
+                    (PT_RESOURCE, tuple(plan.resource_scopes), ""),
+                ):
+                    if pt == PT_PRINCIPAL and k > 0:
+                        continue  # principal pass uses only the first role
+                    cands = self._candidates(
+                        pt, resource_version, sanitized, chain, action, role, qpid, resource_scope
+                    )
+                    if cands is None:
+                        ok = False
+                        break
+                    for depth_entries in cands:
+                        entries.extend(depth_entries)
+                if not ok or len(entries) > self.J or any(e is None for e in entries):
+                    ok = False
+                    break
+                per_k_entries.append(entries)
+            if not ok:
+                cell_blocks[key] = None
+                return None
+            K_used = len(per_k_entries)
+            J_used = max((len(es) for es in per_k_entries), default=0)
+            block = (
+                np.full((K_used, J_used), -1, dtype=np.int32),  # cond
+                np.full((K_used, J_used), -1, dtype=np.int32),  # drcond
+                np.zeros((K_used, J_used), dtype=np.int8),  # effect
+                np.zeros((K_used, J_used), dtype=np.int8),  # pt
+                np.full((K_used, J_used), -1, dtype=np.int8),  # depth
+                np.zeros((K_used, J_used), dtype=bool),  # valid
+                per_k_entries,
+            )
+            for k, es in enumerate(per_k_entries):
+                for j, e in enumerate(es):
+                    block[0][k, j] = e.cond_id
+                    block[1][k, j] = e.drcond_id
+                    block[2][k, j] = e.effect
+                    block[3][k, j] = e.pt
+                    block[4][k, j] = e.depth
+                    block[5][k, j] = True
+            cell_blocks[key] = block
+            return block
+
+        # first pass: resolve blocks, learn max K/J actually used
+        ba_input: list[int] = []
+        ba_action: list[str] = []
+        blocks: list[tuple] = []
+        K_max, J_max = 1, 1
+        for bi, plan in enumerate(plans):
+            start = len(ba_input)
+            if not plan.trivial and not plan.oracle:
+                seen = set()
+                pending = []
+                for a in plan.input.actions:
+                    if a in seen:
+                        continue
+                    seen.add(a)
+                    blk = cell_block(plan, a)
+                    if blk is None:
+                        plan.oracle = True
+                        break
+                    pending.append((a, blk))
+                if not plan.oracle:
+                    for a, blk in pending:
+                        ba_input.append(bi)
+                        ba_action.append(a)
+                        blocks.append(blk)
+                        K_max = max(K_max, blk[0].shape[0])
+                        J_max = max(J_max, blk[0].shape[1])
+            plan.ba_range = (start, len(ba_input))
+
+        BA, D = len(ba_input), self.D
+        K = min(_pow2(K_max), self.K)
+        J = min(_pow2(J_max), self.J)
+        cand_cond = np.full((BA, K, J), -1, dtype=np.int32)
+        cand_drcond = np.full((BA, K, J), -1, dtype=np.int32)
+        cand_effect = np.zeros((BA, K, J), dtype=np.int8)
+        cand_pt = np.zeros((BA, K, J), dtype=np.int8)
+        cand_depth = np.full((BA, K, J), -1, dtype=np.int8)
+        cand_valid = np.zeros((BA, K, J), dtype=bool)
+        cand_entries: list[list[list[Optional[CandEntry]]]] = []
+        for ci, blk in enumerate(blocks):
+            kk, jj = blk[0].shape
+            cand_cond[ci, :kk, :jj] = blk[0]
+            cand_drcond[ci, :kk, :jj] = blk[1]
+            cand_effect[ci, :kk, :jj] = blk[2]
+            cand_pt[ci, :kk, :jj] = blk[3]
+            cand_depth[ci, :kk, :jj] = blk[4]
+            cand_valid[ci, :kk, :jj] = blk[5]
+            cand_entries.append(blk[6])
+
+        # scope permissions per input [B, 2, D]
+        scope_sp = np.zeros((len(plans), 2, D), dtype=np.int8)
+        for bi, plan in enumerate(plans):
+            for pi, chain in ((PT_PRINCIPAL, plan.principal_scopes), (PT_RESOURCE, plan.resource_scopes)):
+                for d, scope in enumerate(chain[:D]):
+                    scope_sp[bi, pi, d] = sp_code(rt.get_scope_scope_permissions(scope))
+
+        columns = self._encode_columns(plans, params)
+        return PackedBatch(
+            plans=plans,
+            columns=columns,
+            ba_input=np.asarray(ba_input, dtype=np.int32),
+            ba_action=ba_action,
+            cand_cond=cand_cond,
+            cand_drcond=cand_drcond,
+            cand_effect=cand_effect,
+            cand_pt=cand_pt,
+            cand_depth=cand_depth,
+            cand_valid=cand_valid,
+            scope_sp=scope_sp,
+            cand_entries=cand_entries,
+            K=int(K),
+            J=int(J),
+            D=D,
+        )
+
+    # -- columns -----------------------------------------------------------
+
+    def _input_view(self, inp: T.CheckInput) -> dict:
+        aux = inp.aux_data or T.AuxData()
+        jwt = {"jwt": aux.jwt}
+        return {
+            "aux_data": jwt,
+            "principal": {
+                "id": inp.principal.id,
+                "roles": list(inp.principal.roles),
+                "attr": inp.principal.attr,
+                "policyVersion": inp.principal.policy_version,
+                "scope": namer.scope_value(inp.principal.scope),
+            },
+            "resource": {
+                "kind": inp.resource.kind,
+                "id": inp.resource.id,
+                "attr": inp.resource.attr,
+                "policyVersion": inp.resource.policy_version,
+                "scope": namer.scope_value(inp.resource.scope),
+            },
+            "auxData": jwt,
+        }
+
+    def _encode_columns(self, plans: list[InputPlan], params: T.EvalParams) -> ColumnBatch:
+        B = len(plans)
+        cb = ColumnBatch(size=B)
+        interner = self.lt.interner
+        paths = sorted(self.lt.paths)
+        arrays = {
+            p: (
+                np.zeros(B, dtype=np.int8),
+                np.zeros(B, dtype=np.int32),
+                np.zeros(B, dtype=np.int32),
+                np.zeros(B, dtype=np.int32),
+                np.zeros(B, dtype=bool),
+            )
+            for p in paths
+        }
+        from .condcompile import TAG_ERR
+
+        for bi, plan in enumerate(plans):
+            if plan.trivial or plan.oracle:
+                continue
+            view = self._input_view(plan.input)
+            for p in paths:
+                tag, hi, lo, sid, is_nan = self._encode_path(view, p, interner)
+                t, h, l, s, nn = arrays[p]
+                t[bi], h[bi], l[bi], s[bi], nn[bi] = tag, hi, lo, sid, is_nan
+                trig = self.lt.fallback_tags.get(p)
+                if trig and tag in trig:
+                    plan.oracle = True
+        for p in paths:
+            t, h, l, s, nn = arrays[p]
+            cb.tags[p], cb.his[p], cb.los[p], cb.sids[p], cb.nans[p] = t, h, l, s, nn
+
+        # predicate columns
+        preds = self.lt.compiler.preds
+        if preds:
+            now_key = None
+            for spec in preds:
+                vals = np.zeros(B, dtype=bool)
+                errs = np.zeros(B, dtype=bool)
+                for bi, plan in enumerate(plans):
+                    if plan.trivial or plan.oracle:
+                        continue
+                    v, e = self._eval_pred(spec, plan, params)
+                    vals[bi], errs[bi] = v, e
+                cb.pred_vals[spec.pred_id] = vals
+                cb.pred_errs[spec.pred_id] = errs
+        return cb
+
+    def _encode_path(self, view: dict, path: tuple[str, ...], interner):
+        from .condcompile import TAG_ERR
+
+        cur: Any = view
+        for i, seg in enumerate(path):
+            if isinstance(cur, dict):
+                if seg not in cur:
+                    # leaf missing vs intermediate missing (has() semantics)
+                    if i == len(path) - 1:
+                        return (0, 0, 0, 0, False)  # TAG_MISSING
+                    return (TAG_ERR, 0, 0, 0, False)
+                cur = cur[seg]
+            else:
+                return (TAG_ERR, 0, 0, 0, False)
+        return encode_value(cur, True, interner)
+
+    def _eval_pred(self, spec, plan: InputPlan, params: T.EvalParams) -> tuple[bool, bool]:
+        view = self._input_view(plan.input)
+        cache_key = None
+        if not spec.time_dependent:
+            try:
+                ref_vals = tuple(_freeze(resolve_path(view, p)) for p in spec.ref_paths)
+                cache_key = (spec.pred_id, ref_vals)
+            except TypeError:
+                cache_key = None
+        if cache_key is not None:
+            hit = self._pred_cache.get(cache_key)
+            if hit is not None:
+                return hit
+        request, principal, resource = build_request_messages(plan.input)
+        ec = EvalContext(params, request, principal, resource)
+
+        def act_factory(pparams):
+            variables = ec.evaluate_variables(pparams.constants, pparams.ordered_variables)
+            return ec.activation(pparams.constants, variables)
+
+        result = evaluate_pred_host(spec, plan.input, act_factory)
+        if cache_key is not None:
+            self._pred_cache[cache_key] = result
+        return result
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _freeze(v: Any):
+    if isinstance(v, tuple):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v

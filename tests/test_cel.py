@@ -359,3 +359,14 @@ class TestReviewRegressions2:
 
         assert matches_glob("a[!b]c", "a:c")
         assert not matches_glob("a?c", "a:c")
+
+
+def test_bytes_unicode_escape_rejected():
+    from cerbos_tpu.cel.errors import CelParseError
+
+    with pytest.raises(CelParseError):
+        parse('b"\\u00e9"')
+    with pytest.raises(CelParseError):
+        parse('b"\\U000000e9"')
+    # plain unicode characters in bytes literals are fine (UTF-8 encoded)
+    assert evaluate(parse('b"é"'), Activation({})) == "é".encode()

@@ -465,3 +465,13 @@ resourcePolicy:
         assert v2.actions["call"].effect == "EFFECT_DENY"
         v3 = check_one(eng, P(roles=["user"]), R(kind="api", version="v3"), ["call"])
         assert v3.actions["call"].policy == "NO_MATCH"
+
+
+def test_delete_role_policy_removes_parent_inheritance():
+    # review regression: deleting a role policy must stop its parentRoles grant
+    eng = make_engine(ROLE_POLICIES)
+    out = check_one(eng, P(id="i1", roles=["intern"]), R(kind="doc", scope="acme"), ["view"])
+    assert out.actions["view"].effect == "EFFECT_ALLOW"
+    eng.rule_table.delete_policy("cerbos.role.intern.vdefault/acme")
+    out2 = check_one(eng, P(id="i1", roles=["intern"]), R(kind="doc", scope="acme"), ["view"])
+    assert out2.actions["view"].effect == "EFFECT_DENY"

@@ -1,0 +1,206 @@
+"""Differential parity: TPU evaluator vs CPU oracle.
+
+The reference's golden corpus strategy (SURVEY.md §4 tier 1) plus the
+property-based differential fuzzer the reference lacks: random policies and
+requests, CPU oracle vs device path, effects must match exactly.
+"""
+
+import random
+
+import pytest
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table, check_input
+from cerbos_tpu.tpu import TpuEvaluator
+
+import test_engine_check as corpus
+
+
+def assert_parity(rule_table, inputs, params=None, use_jax=False):
+    params = params or EvalParams()
+    ev = TpuEvaluator(rule_table, globals_=params.globals, use_jax=use_jax)
+    got = ev.check(inputs, params)
+    want = [check_input(rule_table, i, params) for i in inputs]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert {a: (e.effect, e.policy, e.scope) for a, e in g.actions.items()} == {
+            a: (e.effect, e.policy, e.scope) for a, e in w.actions.items()
+        }, f"effect mismatch for input {i}: {inputs[i]}"
+        assert g.effective_derived_roles == w.effective_derived_roles, f"edr mismatch for input {i}"
+        assert sorted((o.src, o.action, repr(o.val)) for o in g.outputs) == sorted(
+            (o.src, o.action, repr(o.val)) for o in w.outputs
+        ), f"outputs mismatch for input {i}"
+    return ev
+
+
+def table_for(src):
+    return build_rule_table(compile_policy_set(list(parse_policies(src))))
+
+
+CORPORA = {
+    "main": corpus.POLICIES,
+    "scoped": corpus.SCOPED_POLICIES,
+    "rpc": corpus.RPC_POLICIES,
+    "role_policies": corpus.ROLE_POLICIES,
+    "variables": corpus.VARIABLES_POLICIES,
+}
+
+
+def corpus_inputs():
+    P, R = corpus.P, corpus.R
+    return {
+        "main": [
+            CheckInput(principal=P(), resource=R(attr={"owner": "john"}), actions=["view:public", "approve", "create"]),
+            CheckInput(principal=P(), resource=R(attr={"owner": "sally"}), actions=["view:public"]),
+            CheckInput(principal=P(id="boss", roles=["manager"]), resource=R(attr={"managerId": "boss", "status": "PENDING_APPROVAL"}), actions=["approve"]),
+            CheckInput(principal=P(id="boss", roles=["manager"]), resource=R(attr={"managerId": "boss", "status": "DRAFT"}), actions=["approve"]),
+            CheckInput(principal=P(id="daffy", roles=["manager"]), resource=R(attr={"managerId": "daffy", "status": "PENDING_APPROVAL"}), actions=["approve"]),
+            CheckInput(principal=P(id="daffy", roles=["employee"]), resource=R(kind="secret_files"), actions=["view"]),
+            CheckInput(principal=P(id="x", roles=["auditor", "admin"]), resource=R(), actions=["delete", "view:x"]),
+            CheckInput(principal=P(id="ghost", roles=["nobody"]), resource=R(kind="bogus"), actions=["view"]),
+        ],
+        "scoped": [
+            CheckInput(principal=P(id="u", roles=["user"]), resource=R(kind="doc", scope="acme.hr"), actions=["view", "edit", "delete"]),
+            CheckInput(principal=P(id="u", roles=["user"]), resource=R(kind="doc", scope="acme.hr", attr={"confidential": True}), actions=["view"]),
+            CheckInput(principal=P(id="u", roles=["user"]), resource=R(kind="doc", scope="acme"), actions=["delete"]),
+            CheckInput(principal=P(id="u", roles=["user"]), resource=R(kind="doc"), actions=["view", "delete"]),
+        ],
+        "rpc": [
+            CheckInput(principal=P(id="u", roles=["user"]), resource=R(kind="doc", scope="tenant", attr={"public": True}), actions=["view", "edit"]),
+            CheckInput(principal=P(id="u", roles=["user"]), resource=R(kind="doc", scope="tenant", attr={"public": False}), actions=["view"]),
+        ],
+        "role_policies": [
+            CheckInput(principal=P(id="i1", roles=["intern"]), resource=R(kind="doc", scope="acme"), actions=["view", "edit", "delete"]),
+            CheckInput(principal=P(id="c1", roles=["contractor"]), resource=R(kind="doc", scope="acme", attr={"assigned": "c1"}), actions=["edit", "share"]),
+            CheckInput(principal=P(id="c1", roles=["contractor"]), resource=R(kind="doc", scope="acme", attr={"assigned": "zz"}), actions=["edit"]),
+            CheckInput(principal=P(id="a", roles=["admin"]), resource=R(kind="doc", scope="acme"), actions=["delete"]),
+        ],
+        "variables": [
+            CheckInput(principal=P(id="u", roles=["user"], attr={"dept": "eng"}), resource=R(kind="report", attr={"flagged": False}), actions=["view"]),
+            CheckInput(principal=P(id="u", roles=["user"], attr={"dept": "sales"}), resource=R(kind="report", attr={"flagged": False}), actions=["view"]),
+            CheckInput(principal=P(id="u", roles=["user"], attr={"dept": "eng"}), resource=R(kind="report", attr={"flagged": True}), actions=["view"]),
+        ],
+    }
+
+
+@pytest.mark.parametrize("name", sorted(CORPORA))
+@pytest.mark.parametrize("use_jax", [False, True], ids=["numpy", "jax"])
+def test_corpus_parity(name, use_jax):
+    rt = table_for(CORPORA[name])
+    ev = assert_parity(rt, corpus_inputs()[name], use_jax=use_jax)
+    # the corpora are designed to be device-evaluable
+    assert ev.stats["device_inputs"] > 0
+
+
+FUZZ_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+derivedRoles:
+  name: fuzz_roles
+  definitions:
+    - name: owner
+      parentRoles: [viewer, editor]
+      condition:
+        match:
+          expr: R.attr.owner == P.id
+    - name: senior
+      parentRoles: [editor]
+      condition:
+        match:
+          expr: P.attr.level >= 5
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: widget
+  version: default
+  importDerivedRoles: [fuzz_roles]
+  rules:
+    - actions: ["read"]
+      effect: EFFECT_ALLOW
+      roles: [viewer, editor]
+    - actions: ["write"]
+      effect: EFFECT_ALLOW
+      derivedRoles: [owner]
+    - actions: ["write"]
+      effect: EFFECT_ALLOW
+      derivedRoles: [senior]
+      condition:
+        match:
+          any:
+            of:
+              - expr: R.attr.size < 100
+              - expr: R.attr.kind == "small"
+    - actions: ["purge"]
+      effect: EFFECT_DENY
+      roles: ["*"]
+      condition:
+        match:
+          expr: R.attr.protected == true
+    - actions: ["purge"]
+      effect: EFFECT_ALLOW
+      roles: [editor]
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: widget
+  version: default
+  scope: team
+  rules:
+    - actions: ["read"]
+      effect: EFFECT_DENY
+      roles: [viewer]
+      condition:
+        match:
+          expr: R.attr.restricted == true
+---
+apiVersion: api.cerbos.dev/v1
+principalPolicy:
+  principal: special
+  version: default
+  rules:
+    - resource: widget
+      actions:
+        - action: "read"
+          effect: EFFECT_ALLOW
+        - action: "purge"
+          effect: EFFECT_DENY
+"""
+
+
+@pytest.mark.parametrize("use_jax", [False, True], ids=["numpy", "jax"])
+def test_fuzz_parity(use_jax):
+    rng = random.Random(42)
+    rt = table_for(FUZZ_POLICIES)
+    inputs = []
+    for i in range(200):
+        roles = rng.sample(["viewer", "editor", "ghost"], k=rng.randint(1, 2))
+        pid = rng.choice(["u1", "u2", "special"])
+        attr = {}
+        if rng.random() < 0.8:
+            attr["owner"] = rng.choice(["u1", "u2"])
+        if rng.random() < 0.7:
+            attr["size"] = rng.choice([10, 99, 100, 1000, 50.5])
+        if rng.random() < 0.5:
+            attr["kind"] = rng.choice(["small", "big", ""])
+        if rng.random() < 0.5:
+            attr["protected"] = rng.choice([True, False, "yes", 1])
+        if rng.random() < 0.4:
+            attr["restricted"] = rng.choice([True, False, None])
+        pattr = {}
+        if rng.random() < 0.7:
+            pattr["level"] = rng.choice([1, 5, 7, "9", 4.9])
+        inputs.append(
+            CheckInput(
+                principal=Principal(id=pid, roles=roles, attr=pattr),
+                resource=Resource(
+                    kind="widget",
+                    id=f"w{i}",
+                    attr=attr,
+                    scope=rng.choice(["", "team"]),
+                ),
+                actions=rng.sample(["read", "write", "purge", "zap"], k=rng.randint(1, 3)),
+            )
+        )
+    ev = assert_parity(rt, inputs, use_jax=use_jax)
+    # most inputs should take the device path
+    assert ev.stats["device_inputs"] >= 150, ev.stats
