@@ -1,0 +1,3 @@
+from .log import AuditLog, DecisionFilter, new_audit_log  # noqa: F401
+from .file import FileBackend  # noqa: F401
+from .local import LocalBackend  # noqa: F401

@@ -1,0 +1,42 @@
+"""File audit backend: JSON lines to a file or stdout.
+
+Behavioral reference: internal/audit/file/log.go (zap-based JSON file
+sink).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import Any, TextIO
+
+from .log import register_backend
+
+
+class FileBackend:
+    def __init__(self, path: str = "stdout"):
+        self.path = path
+        self._lock = threading.Lock()
+        if path in ("stdout", "-"):
+            self._fh: TextIO = sys.stdout
+            self._owned = False
+        elif path == "stderr":
+            self._fh = sys.stderr
+            self._owned = False
+        else:
+            self._fh = open(path, "a", encoding="utf-8")
+            self._owned = True
+
+    def write(self, entry: dict) -> None:
+        line = json.dumps({"log.logger": "cerbos.audit", **entry}, separators=(",", ":"), default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._owned:
+            self._fh.close()
+
+
+register_backend("file", lambda conf: FileBackend(path=conf.get("path", "stdout")))

@@ -1,0 +1,170 @@
+"""Audit log core: access/decision entries, filtering, async writes.
+
+Behavioral reference: internal/audit/{log,conf,decision_filter}.go —
+pluggable backends via a registry, decision log filters (accessLogsEnabled /
+decisionLogsEnabled, filter by action/kind), async buffered writes
+(log.go:142-195).
+"""
+
+from __future__ import annotations
+
+import datetime
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .. import globs
+from ..engine import types as T
+
+
+@dataclass
+class DecisionFilter:
+    """Ref: internal/audit/decision_filter.go (ignoreAllowAll + filtered actions)."""
+
+    ignore_allow_all: bool = False
+    ignored_actions: list[str] = field(default_factory=list)
+
+    def keep(self, inputs: list[T.CheckInput], outputs: list[T.CheckOutput]) -> bool:
+        if self.ignore_allow_all and all(
+            e.effect == T.EFFECT_ALLOW for o in outputs for e in o.actions.values()
+        ):
+            return False
+        if self.ignored_actions:
+            all_ignored = all(
+                any(globs.matches_glob(pat, a) for pat in self.ignored_actions)
+                for i in inputs
+                for a in i.actions
+            )
+            if all_ignored:
+                return False
+        return True
+
+
+def _now_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _entry_from_decision(call_id: str, inputs: list[T.CheckInput], outputs: list[T.CheckOutput]) -> dict:
+    return {
+        "callId": call_id,
+        "timestamp": _now_iso(),
+        "kind": "decision",
+        "inputs": [
+            {
+                "requestId": i.request_id,
+                "principal": {"id": i.principal.id, "roles": i.principal.roles},
+                "resource": {"kind": i.resource.kind, "id": i.resource.id},
+                "actions": i.actions,
+            }
+            for i in inputs
+        ],
+        "outputs": [
+            {
+                "resourceId": o.resource_id,
+                "actions": {a: {"effect": e.effect, "policy": e.policy, "scope": e.scope} for a, e in o.actions.items()},
+            }
+            for o in outputs
+        ],
+    }
+
+
+class AuditLog:
+    """Async audit writer over a backend."""
+
+    def __init__(
+        self,
+        backend: Any = None,
+        decision_filter: Optional[DecisionFilter] = None,
+        access_logs_enabled: bool = True,
+        decision_logs_enabled: bool = True,
+    ):
+        self.backend = backend
+        self.decision_filter = decision_filter or DecisionFilter()
+        self.access_logs_enabled = access_logs_enabled
+        self.decision_logs_enabled = decision_logs_enabled
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=4096)
+        self._worker = threading.Thread(target=self._drain, daemon=True, name="audit-writer")
+        self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            entry = self._queue.get()
+            if entry is None:
+                return
+            try:
+                if self.backend is not None:
+                    self.backend.write(entry)
+            except Exception:  # noqa: BLE001
+                import logging
+
+                logging.getLogger("cerbos_tpu.audit").exception("audit write failed")
+
+    def _submit(self, entry: dict) -> None:
+        try:
+            self._queue.put_nowait(entry)
+        except queue.Full:
+            pass  # drop rather than block the request path
+
+    def write_access(self, call_id: str, method: str, peer: str = "") -> None:
+        if not self.access_logs_enabled or self.backend is None:
+            return
+        self._submit({"callId": call_id, "timestamp": _now_iso(), "kind": "access", "method": method, "peer": peer})
+
+    def write_decision(self, call_id: str, inputs: list[T.CheckInput], outputs: list[T.CheckOutput]) -> None:
+        if not self.decision_logs_enabled or self.backend is None:
+            return
+        if not self.decision_filter.keep(inputs, outputs):
+            return
+        self._submit(_entry_from_decision(call_id, inputs, outputs))
+
+    def write_plan(self, call_id: str, plan_input: Any, plan_output: Any) -> None:
+        if not self.decision_logs_enabled or self.backend is None:
+            return
+        self._submit(
+            {
+                "callId": call_id,
+                "timestamp": _now_iso(),
+                "kind": "decision",
+                "planResources": {
+                    "actions": list(getattr(plan_input, "actions", [])),
+                    "kind": getattr(plan_output, "kind", ""),
+                    "resourceKind": getattr(plan_input, "resource_kind", ""),
+                },
+            }
+        )
+
+    def close(self) -> None:
+        self._queue.put(None)
+        self._worker.join(timeout=5)
+        if self.backend is not None and hasattr(self.backend, "close"):
+            self.backend.close()
+
+
+_BACKENDS: dict[str, Callable[[dict], Any]] = {}
+
+
+def register_backend(name: str, factory: Callable[[dict], Any]) -> None:
+    _BACKENDS[name] = factory
+
+
+def new_audit_log(conf: dict) -> Optional[AuditLog]:
+    if not conf.get("enabled", False):
+        return None
+    backend_name = conf.get("backend", "local")
+    factory = _BACKENDS.get(backend_name)
+    if factory is None:
+        raise ValueError(f"unknown audit backend {backend_name!r} (known: {sorted(_BACKENDS)})")
+    backend = factory(conf.get(backend_name, {}))
+    dconf = conf.get("decisionLogFilters", {})
+    check_resources = dconf.get("checkResources", {})
+    return AuditLog(
+        backend=backend,
+        decision_filter=DecisionFilter(
+            ignore_allow_all=bool(check_resources.get("ignoreAllowAll", False)),
+            ignored_actions=list(check_resources.get("ignoredActions", [])),
+        ),
+        access_logs_enabled=bool(conf.get("accessLogsEnabled", True)),
+        decision_logs_enabled=bool(conf.get("decisionLogsEnabled", True)),
+    )

@@ -1,0 +1,163 @@
+"""Auxiliary data: JWT verification and claim extraction.
+
+Behavioral reference: internal/auxdata/{auxdata,jwt}.go — configured key
+sets (local PEM/JWKS files or inline data), token verification, claims
+exposed to CEL as ``request.aux_data.jwt`` (jwt.go:40-242). Supports RS256/
+RS384/RS512, ES256/ES384, and HS256/HS384/HS512; verification can be
+disabled for development (matching the reference's
+``verifyDisabled`` escape hatch).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .engine.types import AuxData
+
+
+class JWTError(ValueError):
+    pass
+
+
+def _b64url(data: str) -> bytes:
+    return base64.urlsafe_b64decode(data + "=" * (-len(data) % 4))
+
+
+@dataclass
+class KeySet:
+    id: str
+    keys: list[Any] = field(default_factory=list)  # public key objects or (b"secret", alg)
+    insecure_no_verification: bool = False
+
+
+def _load_jwks(data: dict) -> list[Any]:
+    keys = []
+    for k in data.get("keys", []):
+        kty = k.get("kty")
+        if kty == "RSA":
+            from cryptography.hazmat.primitives.asymmetric import rsa
+
+            n = int.from_bytes(_b64url(k["n"]), "big")
+            e = int.from_bytes(_b64url(k["e"]), "big")
+            keys.append(rsa.RSAPublicNumbers(e, n).public_key())
+        elif kty == "EC":
+            from cryptography.hazmat.primitives.asymmetric import ec
+
+            curve = {"P-256": ec.SECP256R1(), "P-384": ec.SECP384R1(), "P-521": ec.SECP521R1()}[k["crv"]]
+            x = int.from_bytes(_b64url(k["x"]), "big")
+            y = int.from_bytes(_b64url(k["y"]), "big")
+            keys.append(ec.EllipticCurvePublicNumbers(x, y, curve).public_key())
+        elif kty == "oct":
+            keys.append(("hmac", _b64url(k["k"])))
+    return keys
+
+
+def load_keyset(conf: dict) -> KeySet:
+    """Config shape mirrors the reference auxdata.jwt.keySets entries."""
+    ks = KeySet(id=conf.get("id", ""))
+    if conf.get("insecure", {}).get("disableVerification") or conf.get("disableVerification"):
+        ks.insecure_no_verification = True
+        return ks
+    local = conf.get("local", {})
+    raw: Optional[bytes] = None
+    if local.get("file"):
+        with open(local["file"], "rb") as f:
+            raw = f.read()
+    elif local.get("data"):
+        raw = base64.b64decode(local["data"])
+    if raw is None:
+        raise JWTError(f"keyset {ks.id!r} has no local key material (remote fetch requires egress)")
+    text = raw.decode("utf-8", errors="ignore").strip()
+    if text.startswith("{"):
+        ks.keys = _load_jwks(json.loads(text))
+    elif "BEGIN" in text:
+        from cryptography.hazmat.primitives import serialization
+
+        ks.keys = [serialization.load_pem_public_key(raw)]
+    else:
+        ks.keys = [("hmac", raw)]
+    return ks
+
+
+def _verify_signature(alg: str, key: Any, signing_input: bytes, sig: bytes) -> bool:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, hmac as chmac
+    from cryptography.hazmat.primitives.asymmetric import ec, padding, rsa, utils as asym_utils
+
+    hash_alg = {"256": hashes.SHA256(), "384": hashes.SHA384(), "512": hashes.SHA512()}[alg[2:]]
+    try:
+        if alg.startswith("HS"):
+            if not (isinstance(key, tuple) and key[0] == "hmac"):
+                return False
+            h = chmac.HMAC(key[1], hash_alg)
+            h.update(signing_input)
+            h.verify(sig)
+            return True
+        if alg.startswith("RS"):
+            if not isinstance(key, rsa.RSAPublicKey):
+                return False
+            key.verify(sig, signing_input, padding.PKCS1v15(), hash_alg)
+            return True
+        if alg.startswith("ES"):
+            if not isinstance(key, ec.EllipticCurvePublicKey):
+                return False
+            # JOSE raw (r || s) → DER
+            half = len(sig) // 2
+            r = int.from_bytes(sig[:half], "big")
+            s = int.from_bytes(sig[half:], "big")
+            der = asym_utils.encode_dss_signature(r, s)
+            key.verify(der, signing_input, ec.ECDSA(hash_alg))
+            return True
+    except InvalidSignature:
+        return False
+    except Exception:  # noqa: BLE001
+        return False
+    return False
+
+
+class AuxDataManager:
+    def __init__(self, keysets: list[KeySet], default_keyset_id: str = ""):
+        self.keysets = {ks.id: ks for ks in keysets}
+        self.default_keyset_id = default_keyset_id or (keysets[0].id if len(keysets) == 1 else "")
+
+    @classmethod
+    def from_config(cls, conf: dict) -> "AuxDataManager":
+        jwt_conf = conf.get("jwt", {})
+        keysets = [load_keyset(k) for k in jwt_conf.get("keySets", [])]
+        return cls(keysets)
+
+    def extract(self, token: str, key_set_id: str = "") -> AuxData:
+        """Verify + decode; claims land under request.aux_data.jwt."""
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise JWTError("malformed JWT")
+        try:
+            header = json.loads(_b64url(parts[0]))
+            payload = json.loads(_b64url(parts[1]))
+            sig = _b64url(parts[2])
+        except Exception as e:  # noqa: BLE001
+            raise JWTError(f"malformed JWT: {e}") from None
+
+        ks_id = key_set_id or self.default_keyset_id
+        ks = self.keysets.get(ks_id)
+        if ks is None:
+            raise JWTError(f"unknown keyset {ks_id!r}")
+
+        if not ks.insecure_no_verification:
+            alg = header.get("alg", "")
+            if alg not in ("RS256", "RS384", "RS512", "ES256", "ES384", "HS256", "HS384", "HS512"):
+                raise JWTError(f"unsupported JWT algorithm {alg!r}")
+            signing_input = f"{parts[0]}.{parts[1]}".encode("ascii")
+            if not any(_verify_signature(alg, key, signing_input, sig) for key in ks.keys):
+                raise JWTError("JWT signature verification failed")
+            now = time.time()
+            if "exp" in payload and now > float(payload["exp"]):
+                raise JWTError("JWT has expired")
+            if "nbf" in payload and now < float(payload["nbf"]):
+                raise JWTError("JWT not yet valid")
+
+        return AuxData(jwt=payload)

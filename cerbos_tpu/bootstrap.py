@@ -1,0 +1,137 @@
+"""Wiring: config → store → compiler → rule table → engine → server.
+
+Behavioral reference: internal/server/common.go:36-152 (InitializeCerbosCore):
+audit log → store → policy loader → rule table → schema manager → rule-table
+manager (subscribed to store events) → engine → aux data.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .audit import new_audit_log
+from .auxdata import AuxDataManager
+from .config import Config
+from .engine import EvalParams
+from .engine.engine import Engine
+from .plan import Planner
+from .ruletable.manager import RuleTableManager
+from .schema import SchemaManager
+from .server.service import CerbosService, ServiceLimits
+from .storage import new_store
+
+
+@dataclass
+class Core:
+    config: Config
+    store: Any
+    manager: RuleTableManager
+    engine: Engine
+    service: CerbosService
+    schema_mgr: SchemaManager
+    audit_log: Any
+    tpu_evaluator: Any = None
+
+    def close(self) -> None:
+        if self.audit_log is not None:
+            self.audit_log.close()
+        self.store.close()
+
+
+def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
+    audit_log = new_audit_log(config.section("audit"))
+    store = new_store(config.section("storage"))
+
+    schema_mgr = SchemaManager(store, enforcement=config.get("schema.enforcement", "none"))
+
+    engine_conf = config.section("engine")
+    eval_params = EvalParams(
+        globals=engine_conf.get("globals", {}) or {},
+        default_policy_version=engine_conf.get("defaultPolicyVersion", "default"),
+        default_scope=engine_conf.get("defaultScope", ""),
+        lenient_scope_search=bool(engine_conf.get("lenientScopeSearch", False)),
+    )
+
+    manager = RuleTableManager(store)
+
+    tpu_conf = engine_conf.get("tpu", {})
+    tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
+    tpu_evaluator = None
+    if tpu_enabled:
+        from .tpu import TpuEvaluator
+
+        tpu_evaluator = TpuEvaluator(
+            manager.rule_table,
+            globals_=eval_params.globals,
+            schema_mgr=schema_mgr,
+            max_roles=int(tpu_conf.get("maxRoles", 8)),
+            max_candidates=int(tpu_conf.get("maxCandidates", 32)),
+            max_depth=int(tpu_conf.get("maxDepth", 8)),
+        )
+        manager.evaluator_refresh_hook(tpu_evaluator)
+
+    engine = Engine(
+        manager.rule_table,
+        schema_mgr=schema_mgr,
+        eval_params=eval_params,
+        tpu_evaluator=tpu_evaluator,
+        tpu_batch_threshold=int(tpu_conf.get("batchThreshold", 5)),
+    )
+
+    # keep the engine pointed at the latest table after swaps
+    prev_hook = manager.on_swap
+
+    def swap_engine(rt) -> None:
+        engine.rule_table = rt
+        engine.tpu_evaluator = tpu_evaluator
+        if prev_hook is not None:
+            prev_hook(rt)
+
+    if prev_hook is None:
+        manager.on_swap = swap_engine
+    else:
+        # evaluator hook already set; chain engine update after it
+        def chained(rt) -> None:
+            prev_hook(rt)
+            engine.rule_table = rt
+
+        manager.on_swap = chained
+
+    aux_mgr = AuxDataManager.from_config(config.section("auxData"))
+
+    limits_conf = config.get("server.requestLimits", {}) or {}
+    planner = Planner(manager.rule_table, schema_mgr=schema_mgr)
+
+    def planner_swap(rt) -> None:
+        planner.rt = rt
+
+    outer = manager.on_swap
+
+    def with_planner(rt) -> None:
+        if outer is not None:
+            outer(rt)
+        planner_swap(rt)
+
+    manager.on_swap = with_planner
+
+    service = CerbosService(
+        engine,
+        aux_data_mgr=aux_mgr,
+        limits=ServiceLimits(
+            max_actions_per_resource=int(limits_conf.get("maxActionsPerResource", 50)),
+            max_resources_per_request=int(limits_conf.get("maxResourcesPerRequest", 50)),
+        ),
+        audit_log=audit_log,
+        planner=planner,
+    )
+    return Core(
+        config=config,
+        store=store,
+        manager=manager,
+        engine=engine,
+        service=service,
+        schema_mgr=schema_mgr,
+        audit_log=audit_log,
+        tpu_evaluator=tpu_evaluator,
+    )

@@ -1,0 +1,121 @@
+"""Command-line interface.
+
+Behavioral reference: cmd/cerbos (server / compile subcommands; compile exit
+codes: 3 = lint failure, 4 = test failure, main.go:23-25).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_server(args: argparse.Namespace) -> int:
+    from .bootstrap import initialize
+    from .config import Config
+    from .server.server import Server, ServerConfig
+
+    config = Config.load(args.config, overrides=args.set or [])
+    core = initialize(config)
+    server_conf = config.section("server")
+    server = Server(
+        core.service,
+        ServerConfig(
+            http_listen_addr=server_conf.get("httpListenAddr", "0.0.0.0:3592"),
+            grpc_listen_addr=server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
+        ),
+        admin_service=_admin(core, server_conf),
+    )
+    server.start()
+    print(f"cerbos-tpu serving: http={server.http_port} grpc={server.grpc_port}", flush=True)
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        core.close()
+    return 0
+
+
+def _admin(core, server_conf):
+    admin_conf = server_conf.get("adminAPI", {})
+    if not admin_conf.get("enabled", False):
+        return None
+    from .server.admin import AdminService
+
+    creds = admin_conf.get("adminCredentials", {})
+    return AdminService(
+        core,
+        username=creds.get("username", "cerbos"),
+        password_hash=creds.get("passwordHash", ""),
+        password=creds.get("password", "cerbosAdmin"),
+    )
+
+
+def cmd_compile(args: argparse.Namespace) -> int:
+    from .compile import CompileError, compile_policy_set
+    from .storage.disk import BuildError, DiskStore
+
+    try:
+        store = DiskStore(args.dir)
+        policies = store.get_all()
+        compile_policy_set(policies)
+    except (BuildError, CompileError) as e:
+        errors = getattr(e, "errors", [str(e)])
+        if args.output == "json":
+            print(json.dumps({"errors": errors}, indent=2))
+        else:
+            for err in errors:
+                print(f"ERROR: {err}", file=sys.stderr)
+        return 3
+
+    print(f"Compiled {len(policies)} policies OK", file=sys.stderr)
+
+    if args.skip_tests:
+        return 0
+
+    from .verify.runner import discover_and_run
+
+    results = discover_and_run(args.dir, run_filter=args.run)
+    if results is None:
+        return 0  # no test suites found
+    if args.output == "json":
+        print(json.dumps(results.to_json(), indent=2))
+    else:
+        print(results.summary())
+    return 4 if results.failed else 0
+
+
+def cmd_repl(args: argparse.Namespace) -> int:
+    from .repl import run_repl
+
+    return run_repl()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="cerbos-tpu", description="TPU-native Cerbos-compatible PDP")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_server = sub.add_parser("server", help="start the PDP server")
+    p_server.add_argument("--config", help="path to config YAML")
+    p_server.add_argument("--set", action="append", help="config overrides (key=value)")
+    p_server.set_defaults(fn=cmd_server)
+
+    p_compile = sub.add_parser("compile", help="compile policies and run policy tests")
+    p_compile.add_argument("dir", help="policy directory")
+    p_compile.add_argument("--output", choices=("tree", "json"), default="tree")
+    p_compile.add_argument("--run", help="run only tests matching this regex", default="")
+    p_compile.add_argument("--skip-tests", action="store_true")
+    p_compile.set_defaults(fn=cmd_compile)
+
+    p_repl = sub.add_parser("repl", help="interactive CEL condition REPL")
+    p_repl.set_defaults(fn=cmd_repl)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Configuration: single YAML file with env interpolation + overrides.
+
+Behavioral reference: internal/config/config.go — one YAML document, env
+var interpolation (``${VAR}`` / ``${VAR:default}``), per-section access, CLI
+``--set key=value`` overrides merged on top, sensible defaults.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import yaml
+
+_ENV_RX = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)(?::([^}]*))?\}")
+
+DEFAULTS: dict[str, Any] = {
+    "server": {
+        "httpListenAddr": "0.0.0.0:3592",
+        "grpcListenAddr": "0.0.0.0:3593",
+        "requestLimits": {"maxActionsPerResource": 50, "maxResourcesPerRequest": 50},
+        "adminAPI": {"enabled": False},
+    },
+    "engine": {
+        "defaultPolicyVersion": "default",
+        "defaultScope": "",
+        "lenientScopeSearch": False,
+        "globals": {},
+        "tpu": {"enabled": True, "batchThreshold": 5, "maxRoles": 8, "maxCandidates": 32, "maxDepth": 8},
+    },
+    "storage": {"driver": "disk", "disk": {"directory": "policies", "watchForChanges": False}},
+    "schema": {"enforcement": "none"},
+    "audit": {"enabled": False, "backend": "local"},
+    "auxData": {"jwt": {"keySets": []}},
+    "telemetry": {"disabled": True},
+}
+
+
+def _interpolate(value: Any) -> Any:
+    if isinstance(value, str):
+        def sub(m: re.Match) -> str:
+            return os.environ.get(m.group(1), m.group(2) if m.group(2) is not None else "")
+
+        return _ENV_RX.sub(sub, value)
+    if isinstance(value, dict):
+        return {k: _interpolate(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_interpolate(v) for v in value]
+    return value
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _parse_set(expr: str) -> tuple[list[str], Any]:
+    key, _, raw = expr.partition("=")
+    try:
+        value = yaml.safe_load(raw)
+    except yaml.YAMLError:
+        value = raw
+    return key.strip().split("."), value
+
+
+class Config:
+    def __init__(self, data: dict[str, Any]):
+        self.data = data
+
+    @classmethod
+    def load(cls, path: Optional[str] = None, overrides: Optional[list[str]] = None) -> "Config":
+        data: dict[str, Any] = {}
+        if path:
+            with open(path, encoding="utf-8") as f:
+                data = yaml.safe_load(f) or {}
+        data = _deep_merge(DEFAULTS, _interpolate(data))
+        for expr in overrides or []:
+            keys, value = _parse_set(expr)
+            cur = data
+            for k in keys[:-1]:
+                cur = cur.setdefault(k, {})
+            cur[keys[-1]] = value
+        return cls(data)
+
+    def section(self, name: str) -> dict[str, Any]:
+        v = self.data.get(name, {})
+        return v if isinstance(v, dict) else {}
+
+    def get(self, dotted: str, default: Any = None) -> Any:
+        cur: Any = self.data
+        for k in dotted.split("."):
+            if not isinstance(cur, dict) or k not in cur:
+                return default
+            cur = cur[k]
+        return cur

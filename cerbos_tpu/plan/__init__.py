@@ -1,0 +1,2 @@
+from .types import PlanInput, PlanOutput  # noqa: F401
+from .planner import Planner  # noqa: F401

@@ -1,0 +1,210 @@
+"""Partial CEL evaluation with resource attributes as unknowns.
+
+Behavioral reference: internal/ruletable/planner/planner.go:467-524
+(partialEvaluator: CEL eval with unknowns, residual extraction). Here the
+partial evaluator works directly on the AST: known subtrees (principal,
+provided resource attrs, constants/variables/globals, pure functions)
+collapse to literal values; unknown subtrees (absent resource attrs) stay
+residual. Logic operators short-circuit on known operands.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..cel import ast as A
+from ..cel.errors import CelError
+from ..cel.interp import Activation, evaluate
+
+
+@dataclass
+class Residual:
+    node: A.Node
+
+
+class _Unknown(Exception):
+    """Internal: subtree references an unknown."""
+
+
+class PartialEvaluator:
+    def __init__(self, act: Activation, known_attrs: dict[str, Any], var_defs: dict[str, A.Node]):
+        self.act = act
+        self.known_attrs = known_attrs
+        self.var_defs = var_defs  # variable name -> definition AST (inlined on use)
+
+    def run(self, node: A.Node):
+        """→ concrete value, Residual, or raises CelError."""
+        node = self._inline_vars(node, 0)
+        try:
+            return self._eval(node)
+        except _Unknown:
+            return Residual(self._residualize(node))
+
+    # -- variable inlining (variables may reference resource attrs) --------
+
+    def _inline_vars(self, node: A.Node, depth: int) -> A.Node:
+        if depth > 32:
+            raise CelError("variable inlining too deep")
+        if isinstance(node, A.Select) and isinstance(node.operand, A.Ident) and node.operand.name in ("V", "variables"):
+            if node.field in self.var_defs:
+                return self._inline_vars(self.var_defs[node.field], depth + 1)
+            raise CelError(f"undefined variable {node.field}")
+        if isinstance(node, A.Select):
+            return A.Select(self._inline_vars(node.operand, depth), node.field)
+        if isinstance(node, A.Present):
+            return A.Present(self._inline_vars(node.operand, depth), node.field)
+        if isinstance(node, A.Index):
+            return A.Index(self._inline_vars(node.operand, depth), self._inline_vars(node.index, depth))
+        if isinstance(node, A.Call):
+            return A.Call(
+                node.fn,
+                tuple(self._inline_vars(a, depth) for a in node.args),
+                target=self._inline_vars(node.target, depth) if node.target is not None else None,
+            )
+        if isinstance(node, A.ListLit):
+            return A.ListLit(tuple(self._inline_vars(x, depth) for x in node.items))
+        if isinstance(node, A.MapLit):
+            return A.MapLit(tuple((self._inline_vars(k, depth), self._inline_vars(v, depth)) for k, v in node.entries))
+        if isinstance(node, A.Bind):
+            return A.Bind(node.name, self._inline_vars(node.init, depth), self._inline_vars(node.body, depth))
+        if isinstance(node, A.Comprehension):
+            return A.Comprehension(
+                kind=node.kind,
+                iter_range=self._inline_vars(node.iter_range, depth),
+                iter_var=node.iter_var,
+                step=self._inline_vars(node.step, depth),
+                iter_var2=node.iter_var2,
+                step2=self._inline_vars(node.step2, depth) if node.step2 is not None else None,
+            )
+        return node
+
+    # -- unknown detection --------------------------------------------------
+
+    def _attr_key(self, node: A.Node) -> Optional[str]:
+        """R.attr.<k> / request.resource.attr.<k> (or [k]) → k."""
+        field = None
+        if isinstance(node, A.Select):
+            field = node.field
+            operand = node.operand
+        elif isinstance(node, A.Index) and isinstance(node.index, A.Lit) and isinstance(node.index.value, str):
+            field = node.index.value
+            operand = node.operand
+        else:
+            return None
+        if isinstance(operand, A.Select) and operand.field == "attr":
+            root = operand.operand
+            if isinstance(root, A.Ident) and root.name == "R":
+                return field
+            if (
+                isinstance(root, A.Select)
+                and root.field == "resource"
+                and isinstance(root.operand, A.Ident)
+                and root.operand.name == "request"
+            ):
+                return field
+        return None
+
+    def _is_unknown(self, node: A.Node) -> bool:
+        k = self._attr_key(node)
+        return k is not None and k not in self.known_attrs
+
+    def _eval(self, node: A.Node) -> Any:
+        """Evaluate if fully known, else raise _Unknown."""
+        if self._has_unknown(node):
+            # short-circuitable operators get special treatment
+            if isinstance(node, A.Call) and node.target is None and node.fn in ("_&&_", "_||_"):
+                short = node.fn == "_||_"
+                results = []
+                for arg in node.args:
+                    try:
+                        v = self._eval(arg)
+                        if v is short:
+                            return short
+                        results.append(v)
+                    except _Unknown:
+                        results.append(None)
+                if all(r is not None for r in results):
+                    return not short
+                raise _Unknown
+            if isinstance(node, A.Call) and node.target is None and node.fn == "_?_:_":
+                cond = self._eval(node.args[0])  # may raise _Unknown
+                if not isinstance(cond, bool):
+                    raise CelError("ternary condition is not a bool")
+                return self._eval(node.args[1] if cond else node.args[2])
+            raise _Unknown
+        return evaluate(node, self.act)
+
+    _unknown_cache: dict
+
+    def _has_unknown(self, node: A.Node) -> bool:
+        if self._is_unknown(node):
+            return True
+        if isinstance(node, (A.Select, A.Present)):
+            return self._has_unknown(node.operand)
+        if isinstance(node, A.Index):
+            return self._has_unknown(node.operand) or self._has_unknown(node.index)
+        if isinstance(node, A.Call):
+            if node.target is not None and self._has_unknown(node.target):
+                return True
+            return any(self._has_unknown(a) for a in node.args)
+        if isinstance(node, A.ListLit):
+            return any(self._has_unknown(a) for a in node.items)
+        if isinstance(node, A.MapLit):
+            return any(self._has_unknown(k) or self._has_unknown(v) for k, v in node.entries)
+        if isinstance(node, A.Bind):
+            return self._has_unknown(node.init) or self._has_unknown(node.body)
+        if isinstance(node, A.Comprehension):
+            return (
+                self._has_unknown(node.iter_range)
+                or self._has_unknown(node.step)
+                or (node.step2 is not None and self._has_unknown(node.step2))
+            )
+        return False
+
+    # -- residualization ----------------------------------------------------
+
+    def _residualize(self, node: A.Node) -> A.Node:
+        """Replace fully-known subtrees with literals; keep unknowns."""
+        if not self._has_unknown(node):
+            try:
+                return A.Lit(self._eval(node))
+            except (_Unknown, CelError):
+                return node
+        if isinstance(node, A.Call):
+            if node.fn in ("_&&_", "_||_") and node.target is None:
+                short = node.fn == "_||_"
+                parts: list[A.Node] = []
+                for arg in node.args:
+                    r = self._residualize(arg)
+                    if isinstance(r, A.Lit) and isinstance(r.value, bool):
+                        if r.value is short:
+                            return A.Lit(short)
+                        continue  # neutral element drops out
+                    parts.append(r)
+                if not parts:
+                    return A.Lit(not short)
+                if len(parts) == 1:
+                    return parts[0]
+                out = parts[0]
+                for p in parts[1:]:
+                    out = A.Call(node.fn, (out, p))
+                return out
+            if node.fn == "_?_:_" and node.target is None:
+                cond = self._residualize(node.args[0])
+                if isinstance(cond, A.Lit) and isinstance(cond.value, bool):
+                    return self._residualize(node.args[1] if cond.value else node.args[2])
+                return A.Call(node.fn, (cond, self._residualize(node.args[1]), self._residualize(node.args[2])))
+            if node.fn == "!_" and node.target is None:
+                inner = self._residualize(node.args[0])
+                if isinstance(inner, A.Lit) and isinstance(inner.value, bool):
+                    return A.Lit(not inner.value)
+                return A.Call("!_", (inner,))
+            return A.Call(
+                node.fn,
+                tuple(self._residualize(a) for a in node.args),
+                target=self._residualize(node.target) if node.target is not None else None,
+            )
+        if isinstance(node, (A.Select, A.Present, A.Index, A.ListLit, A.MapLit)):
+            return node  # unknown leaf chains stay as-is
+        return node

@@ -1,0 +1,314 @@
+"""PlanResources: symbolic policy evaluation → filter AST.
+
+Behavioral reference: internal/ruletable/plan.go (role/scope loops mirroring
+check) and internal/ruletable/planner (partial evaluation, ALLOW/DENY filter
+combination, multi-action MergeWithAnd — merge.go). Per action and role:
+``(OR allow-residuals) AND NOT (OR deny-residuals)``; principal policies
+take precedence (a principal DENY blocks regardless of resource policy);
+multiple requested actions AND together.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import namer
+from ..cel import ast as A
+from ..cel.errors import CelError
+from ..engine import types as T
+from ..ruletable.check import EvalContext, build_request_messages
+from ..ruletable.rows import KIND_PRINCIPAL, KIND_RESOURCE
+from ..ruletable.table import RuleTable
+from .partial import PartialEvaluator, Residual
+from .types import (
+    KIND_ALWAYS_ALLOWED,
+    KIND_ALWAYS_DENIED,
+    KIND_CONDITIONAL,
+    Operand,
+    PlanInput,
+    PlanOutput,
+)
+
+TRUE = object()
+FALSE = object()
+# node results: TRUE | FALSE | A.Node (residual)
+
+
+def _or(nodes: list[Any]) -> Any:
+    out: list[A.Node] = []
+    for n in nodes:
+        if n is TRUE:
+            return TRUE
+        if n is FALSE:
+            continue
+        out.append(n)
+    if not out:
+        return FALSE
+    res = out[0]
+    for n in out[1:]:
+        res = A.Call("_||_", (res, n))
+    return res
+
+
+def _and(nodes: list[Any]) -> Any:
+    out: list[A.Node] = []
+    for n in nodes:
+        if n is FALSE:
+            return FALSE
+        if n is TRUE:
+            continue
+        out.append(n)
+    if not out:
+        return TRUE
+    res = out[0]
+    for n in out[1:]:
+        res = A.Call("_&&_", (res, n))
+    return res
+
+
+def _not(n: Any) -> Any:
+    if n is TRUE:
+        return FALSE
+    if n is FALSE:
+        return TRUE
+    if isinstance(n, A.Call) and n.fn == "!_":
+        return n.args[0]
+    return A.Call("!_", (n,))
+
+
+class Planner:
+    def __init__(self, rule_table: RuleTable, schema_mgr: Any = None):
+        self.rt = rule_table
+        self.schema_mgr = schema_mgr
+
+    def plan(self, input: PlanInput, params: Optional[T.EvalParams] = None) -> PlanOutput:
+        params = params or T.EvalParams()
+        rt = self.rt
+
+        principal_scope = T.effective_scope(input.principal.scope, params)
+        principal_version = T.effective_version(input.principal.policy_version, params)
+        resource_scope = T.effective_scope(input.resource_scope, params)
+        resource_version = T.effective_version(input.resource_policy_version, params)
+
+        p_scopes, _, _ = rt.get_all_scopes(
+            KIND_PRINCIPAL, principal_scope, input.principal.id, principal_version, params.lenient_scope_search
+        )
+        r_scopes, _, r_fqn = rt.get_all_scopes(
+            KIND_RESOURCE, resource_scope, input.resource_kind, resource_version, params.lenient_scope_search
+        )
+
+        output = PlanOutput(
+            request_id=input.request_id,
+            actions=list(input.actions),
+            kind=KIND_ALWAYS_DENIED,
+            resource_kind=input.resource_kind,
+            policy_version=resource_version,
+            scope=resource_scope,
+            include_meta=input.include_meta,
+        )
+        if not p_scopes and not r_scopes:
+            return output
+
+        # schema validation of the principal (resource attrs are partial)
+        if self.schema_mgr is not None:
+            check_in = T.CheckInput(
+                principal=input.principal,
+                resource=T.Resource(kind=input.resource_kind, id="", attr=dict(input.resource_attr)),
+                actions=list(input.actions),
+                aux_data=input.aux_data,
+            )
+            errors, reject = self.schema_mgr.validate_check_input(
+                rt.get_schema(r_fqn), check_in, principal_only=True
+            )
+            output.validation_errors = errors
+            if reject:
+                return output
+
+        pe = self._partial_evaluator(input, params)
+        sanitized = namer.sanitize(input.resource_kind)
+
+        action_filters: list[Any] = []
+        for action in dict.fromkeys(input.actions):
+            node, matched_scope = self._plan_action(
+                pe, input, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes
+            )
+            action_filters.append(node)
+            output.matched_scopes[action] = matched_scope
+
+        final = _and(action_filters)  # multi-action: MergeWithAnd semantics
+        if final is TRUE:
+            output.kind = KIND_ALWAYS_ALLOWED
+        elif final is FALSE:
+            output.kind = KIND_ALWAYS_DENIED
+        else:
+            output.kind = KIND_CONDITIONAL
+            output.condition = ast_to_operand(final)
+        return output
+
+    def _partial_evaluator(self, input: PlanInput, params: T.EvalParams):
+        check_in = T.CheckInput(
+            principal=input.principal,
+            resource=T.Resource(kind=input.resource_kind, id="", attr=dict(input.resource_attr)),
+            actions=list(input.actions),
+            aux_data=input.aux_data,
+        )
+        request, principal, resource = build_request_messages(check_in)
+        ec = EvalContext(params, request, principal, resource)
+        act = ec.activation({}, {})
+
+        def make(known_attrs: dict[str, Any], var_defs: dict[str, A.Node], constants: dict[str, Any]):
+            consts_act = ec.activation(constants, {})
+            return PartialEvaluator(consts_act, known_attrs, var_defs)
+
+        return make
+
+    def _plan_action(
+        self, pe_factory, input: PlanInput, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes
+    ) -> tuple[Any, str]:
+        rt = self.rt
+        known = {str(k): v for k, v in input.resource_attr.items()}
+        matched_scope = ""
+
+        def eval_rows(pt: str, scopes: list[str], role: str, pid: str) -> tuple[list[Any], list[Any], str]:
+            allows: list[Any] = []
+            denies: list[Any] = []
+            first_scope = ""
+            parent_roles = rt.idx.add_parent_roles([resource_scope], [role])
+            for scope in scopes:
+                rows = rt.idx.query(resource_version, sanitized, scope, action, parent_roles, pt, pid)
+                for b in rows:
+                    var_defs = {}
+                    constants = {}
+                    if b.params is not None:
+                        var_defs = {v.name: v.expr.node for v in b.params.ordered_variables}
+                        constants = b.params.constants
+                    pe = pe_factory(known, var_defs, constants)
+                    node = self._cond_node(pe, b.derived_role_condition, b.derived_role_params, known, pe_factory)
+                    if node is FALSE:
+                        continue
+                    cond_node = self._cond_node(pe, b.condition, b.params, known, pe_factory)
+                    combined = _and([node, cond_node])
+                    if combined is FALSE:
+                        continue
+                    if not first_scope:
+                        first_scope = scope
+                    if b.effect == "EFFECT_ALLOW":
+                        allows.append(combined)
+                    elif b.effect == "EFFECT_DENY":
+                        denies.append(combined)
+            return allows, denies, first_scope
+
+        # principal pass (role-agnostic)
+        p_allows, p_denies, p_matched = eval_rows(KIND_PRINCIPAL, p_scopes, input.principal.roles[0] if input.principal.roles else "", input.principal.id)
+
+        # resource pass per role, combined with OR (role independence)
+        role_filters: list[Any] = []
+        r_matched = ""
+        for role in input.principal.roles:
+            allows, denies, first_scope = eval_rows(KIND_RESOURCE, r_scopes, role, "")
+            if not r_matched:
+                r_matched = first_scope
+            role_filters.append(_and([_or(allows), _not(_or(denies))]))
+        r_combined = _or(role_filters)
+
+        final = _and([_not(_or(p_denies)), _or([_or(p_allows), r_combined])])
+        matched_scope = p_matched or r_matched
+        return final, matched_scope
+
+    def _cond_node(self, pe: PartialEvaluator, cond, params_obj, known, pe_factory) -> Any:
+        """CompiledCondition → TRUE/FALSE/residual node via partial eval."""
+        if cond is None:
+            return TRUE
+        if cond.kind == "expr":
+            try:
+                r = pe.run(cond.expr.node)
+            except CelError:
+                return FALSE
+            if isinstance(r, Residual):
+                return r.node
+            return TRUE if r is True else FALSE
+        children = [self._cond_node(pe, c, params_obj, known, pe_factory) for c in cond.children]
+        if cond.kind == "all":
+            return _and(children)
+        if cond.kind == "any":
+            return _or(children)
+        if cond.kind == "none":
+            return _not(_or(children))
+        raise ValueError(f"unknown condition kind {cond.kind}")
+
+
+# ---------------------------------------------------------------------------
+# residual AST → filter expression tree
+
+_OP_NAMES = {
+    "_==_": "eq", "_!=_": "ne", "_<_": "lt", "_<=_": "le", "_>_": "gt", "_>=_": "ge",
+    "_&&_": "and", "_||_": "or", "!_": "not", "_in_": "in",
+    "_+_": "add", "_-_": "sub", "_*_": "mult", "_/_": "div", "_%_": "mod", "-_": "neg",
+    "_[_]": "index",
+}
+
+
+def _flatten(node: A.Node, op: str) -> list[A.Node]:
+    if isinstance(node, A.Call) and node.fn == op and node.target is None:
+        return _flatten(node.args[0], op) + _flatten(node.args[1], op)
+    return [node]
+
+
+def ast_to_operand(node: A.Node) -> Operand:
+    """Residual CEL AST → PlanResourcesFilter operand tree (the wire format
+    list endpoints consume)."""
+    if isinstance(node, A.Lit):
+        v = node.value
+        from ..util import normalize_attr
+
+        return Operand.val(normalize_attr(v))
+    if isinstance(node, (A.Select, A.Index, A.Ident, A.Present)):
+        var = _variable_name(node)
+        if var is not None:
+            return Operand.var(var)
+        if isinstance(node, A.Present):
+            return Operand.expr("has", ast_to_operand(A.Select(node.operand, node.field)))
+        if isinstance(node, A.Index):
+            return Operand.expr("index", ast_to_operand(node.operand), ast_to_operand(node.index))
+        raise ValueError(f"cannot convert {node} to filter operand")
+    if isinstance(node, A.ListLit):
+        return Operand.expr("list", *[ast_to_operand(x) for x in node.items])
+    if isinstance(node, A.MapLit):
+        ops = []
+        for k, v in node.entries:
+            ops.append(Operand.expr("map-entry", ast_to_operand(k), ast_to_operand(v)))
+        return Operand.expr("map", *ops)
+    if isinstance(node, A.Call):
+        if node.fn in ("_&&_", "_||_"):
+            parts = _flatten(node, node.fn)
+            return Operand.expr(_OP_NAMES[node.fn], *[ast_to_operand(p) for p in parts])
+        op = _OP_NAMES.get(node.fn, node.fn)
+        operands = []
+        if node.target is not None:
+            operands.append(ast_to_operand(node.target))
+        operands.extend(ast_to_operand(a) for a in node.args)
+        return Operand.expr(op, *operands)
+    raise ValueError(f"cannot convert {type(node).__name__} to filter operand")
+
+
+def _variable_name(node: A.Node) -> Optional[str]:
+    segs: list[str] = []
+    cur = node
+    while True:
+        if isinstance(cur, A.Select):
+            segs.append(cur.field)
+            cur = cur.operand
+        elif isinstance(cur, A.Index) and isinstance(cur.index, A.Lit) and isinstance(cur.index.value, str):
+            segs.append(cur.index.value)
+            cur = cur.operand
+        elif isinstance(cur, A.Ident):
+            root = cur.name
+            if root == "R":
+                return ".".join(["request", "resource"] + list(reversed(segs)))
+            if root == "P":
+                return ".".join(["request", "principal"] + list(reversed(segs)))
+            if root == "request":
+                return ".".join(["request"] + list(reversed(segs)))
+            return None
+        else:
+            return None

@@ -1,0 +1,116 @@
+"""PlanResources input/output types.
+
+Behavioral reference: api/public/cerbos/engine/v1/engine.proto
+(PlanResourcesInput/Filter/Output) and internal/ruletable/planner.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..engine import types as T
+
+KIND_ALWAYS_ALLOWED = "KIND_ALWAYS_ALLOWED"
+KIND_ALWAYS_DENIED = "KIND_ALWAYS_DENIED"
+KIND_CONDITIONAL = "KIND_CONDITIONAL"
+
+
+@dataclass
+class PlanInput:
+    request_id: str
+    actions: list[str]
+    principal: T.Principal
+    resource_kind: str
+    resource_attr: dict[str, Any] = field(default_factory=dict)
+    resource_policy_version: str = ""
+    resource_scope: str = ""
+    aux_data: Optional[T.AuxData] = None
+    include_meta: bool = False
+
+
+@dataclass
+class Expr:
+    """Filter expression node: operator over operands (value/variable/expr)."""
+
+    op: str
+    operands: list["Operand"] = field(default_factory=list)
+
+
+@dataclass
+class Operand:
+    value: Any = None
+    expression: Optional[Expr] = None
+    variable: Optional[str] = None
+
+    @classmethod
+    def val(cls, v: Any) -> "Operand":
+        return cls(value=v)
+
+    @classmethod
+    def var(cls, name: str) -> "Operand":
+        return cls(variable=name)
+
+    @classmethod
+    def expr(cls, op: str, *operands: "Operand") -> "Operand":
+        return cls(expression=Expr(op=op, operands=list(operands)))
+
+    def to_json(self) -> dict:
+        if self.expression is not None:
+            return {
+                "expression": {
+                    "operator": self.expression.op,
+                    "operands": [o.to_json() for o in self.expression.operands],
+                }
+            }
+        if self.variable is not None:
+            return {"variable": self.variable}
+        return {"value": self.value}
+
+    def debug_str(self) -> str:
+        if self.expression is not None:
+            inner = " ".join(o.debug_str() for o in self.expression.operands)
+            return f"({self.expression.op} {inner})"
+        if self.variable is not None:
+            return self.variable
+        import json
+
+        return json.dumps(self.value)
+
+
+@dataclass
+class PlanOutput:
+    request_id: str
+    actions: list[str]
+    kind: str
+    resource_kind: str
+    policy_version: str
+    scope: str
+    condition: Optional[Operand] = None
+    matched_scopes: dict[str, str] = field(default_factory=dict)
+    validation_errors: list[T.ValidationError] = field(default_factory=list)
+    include_meta: bool = False
+
+    def to_json(self, call_id: str = "") -> dict:
+        filter_j: dict[str, Any] = {"kind": self.kind}
+        if self.kind == KIND_CONDITIONAL and self.condition is not None:
+            filter_j["condition"] = self.condition.to_json()
+        out: dict[str, Any] = {
+            "requestId": self.request_id,
+            "actions": self.actions,
+            "resourceKind": self.resource_kind,
+            "policyVersion": self.policy_version,
+            "filter": filter_j,
+        }
+        if self.include_meta:
+            out["meta"] = {
+                "filterDebug": self.condition.debug_str() if self.condition is not None else self.kind,
+                "matchedScopes": self.matched_scopes,
+            }
+        if self.validation_errors:
+            out["validationErrors"] = [
+                {"path": v.path, "message": v.message, "source": v.source} for v in self.validation_errors
+            ]
+        if call_id:
+            out["cerbosCallId"] = call_id
+        return out

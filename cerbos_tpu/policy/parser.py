@@ -384,17 +384,22 @@ def parse_policy(doc: Any, source: str = "") -> model.Policy:
     return pol
 
 
+def _load_docs(stream, source: str) -> list:
+    try:
+        return [d for d in yaml.safe_load_all(stream) if d is not None]
+    except yaml.YAMLError as e:
+        raise ParseError(f"invalid YAML: {e}", source=source) from None
+
+
 def parse_policies(text: str, source: str = "") -> Iterator[model.Policy]:
     """Parse one or more YAML documents into policies."""
-    for doc in yaml.safe_load_all(io.StringIO(text)):
-        if doc is None:
-            continue
+    for doc in _load_docs(io.StringIO(text), source):
         yield parse_policy(doc, source=source)
 
 
 def parse_policy_file(path: str) -> model.Policy:
     with open(path, encoding="utf-8") as f:
-        docs = [d for d in yaml.safe_load_all(f) if d is not None]
+        docs = _load_docs(f, path)
     if len(docs) != 1:
         raise ParseError(f"expected exactly one policy document, found {len(docs)}", source=path)
     return parse_policy(docs[0], source=path)

@@ -1,0 +1,68 @@
+"""Interactive CEL condition REPL.
+
+Behavioral reference: cmd/cerbos/repl — evaluate CEL expressions with
+request variables, set P/R attributes with :let-style commands.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .cel import CelError, evaluate, parse
+from .cel.errors import CelParseError
+from .cel.interp import Activation, Message
+from .cel.values import Timestamp
+import datetime as _dt
+
+
+def run_repl() -> int:
+    principal: dict = {"id": "user", "roles": ["user"], "attr": {}, "policyVersion": "", "scope": ""}
+    resource: dict = {"kind": "resource", "id": "r1", "attr": {}, "policyVersion": "", "scope": ""}
+
+    print("cerbos-tpu REPL — CEL expressions over request/P/R.")
+    print("Commands: :P.attr <json> | :R.attr <json> | :roles a,b | :vars | :q")
+
+    def build_activation() -> Activation:
+        p = Message(dict(principal))
+        r = Message(dict(resource))
+        jwt = Message({"jwt": {}})
+        req = Message({"principal": p, "resource": r, "auxData": jwt, "aux_data": jwt})
+        return Activation(
+            {"request": req, "P": p, "R": r, "V": {}, "variables": {}, "C": {}, "constants": {}, "G": {}, "globals": {}},
+            now_fn=lambda: Timestamp.from_datetime(_dt.datetime.now(_dt.timezone.utc)),
+        )
+
+    while True:
+        try:
+            line = input("> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not line:
+            continue
+        if line in (":q", ":quit", ":exit"):
+            return 0
+        if line == ":vars":
+            print(json.dumps({"principal": principal, "resource": resource}, indent=2, default=str))
+            continue
+        if line.startswith(":P.attr "):
+            try:
+                principal["attr"] = json.loads(line[len(":P.attr "):])
+            except json.JSONDecodeError as e:
+                print(f"invalid JSON: {e}")
+            continue
+        if line.startswith(":R.attr "):
+            try:
+                resource["attr"] = json.loads(line[len(":R.attr "):])
+            except json.JSONDecodeError as e:
+                print(f"invalid JSON: {e}")
+            continue
+        if line.startswith(":roles "):
+            principal["roles"] = [r.strip() for r in line[len(":roles "):].split(",") if r.strip()]
+            continue
+        try:
+            result = evaluate(parse(line), build_activation())
+            print(repr(result))
+        except (CelError, CelParseError) as e:
+            print(f"error: {e}")
+    return 0

@@ -1,0 +1,66 @@
+"""Rule-table manager: storage events → recompile → re-lower device tables.
+
+Behavioral reference: internal/ruletable/manager.go — RELOAD rebuilds the
+whole table; ADD/DELETE recompile the affected policy and its dependents
+atomically under a write lock; failures keep the last valid state
+(manager.go:74-84,108-111). The TPU twist (SURVEY.md §3.4): after a
+successful swap, the lowered device tables are refreshed.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+from ..compile import CompileError, compile_policy_set
+from ..storage.store import Event, Store
+from .table import RuleTable, build_rule_table
+
+log = logging.getLogger("cerbos_tpu.ruletable")
+
+
+class RuleTableManager:
+    def __init__(self, store: Store, on_swap: Optional[Callable[[RuleTable], None]] = None):
+        self.store = store
+        self.on_swap = on_swap
+        self._lock = threading.RLock()
+        self.rule_table = self._build()
+        store.subscribe(self.on_storage_event)
+
+    def _build(self) -> RuleTable:
+        policies = self.store.get_all()
+        return build_rule_table(compile_policy_set(policies))
+
+    def on_storage_event(self, events: list[Event]) -> None:
+        """Rebuild into a fresh table and swap the pointer atomically, so
+        in-flight checks keep reading a consistent table and failures keep
+        the last valid state (ref: manager.go:74-84,108-111). Incremental
+        delete/ingest on the live table stays available to the Admin API via
+        RuleTable directly; the event path always swaps whole tables, which
+        doubles as the device-table double-buffering (SURVEY.md §7.8)."""
+        with self._lock:
+            try:
+                new_table = self._build()
+            except CompileError as e:
+                log.error("policy reload failed; keeping last valid state: %s", e)
+                return
+            except Exception:  # noqa: BLE001
+                log.exception("policy reload failed; keeping last valid state")
+                return
+            self.rule_table = new_table
+        if self.on_swap is not None:
+            self.on_swap(self.rule_table)
+
+    def evaluator_refresh_hook(self, evaluator: Any) -> None:
+        """Wire a TpuEvaluator so reloads re-lower the device tables."""
+        original = self.on_swap
+
+        def hook(rt: RuleTable) -> None:
+            evaluator.rule_table = rt
+            evaluator.lowered.table = rt
+            evaluator.refresh()
+            if original is not None:
+                original(rt)
+
+        self.on_swap = hook

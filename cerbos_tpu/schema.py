@@ -1,0 +1,85 @@
+"""JSON-schema validation of principals/resources.
+
+Behavioral reference: internal/schema/schema.go — enforcement levels
+none/warn/reject (schema.go:31-35), schemas referenced from resource
+policies as ``cerbos:///<id>``, ignoreWhen action globs, validation errors
+attributed to SOURCE_PRINCIPAL / SOURCE_RESOURCE, cache invalidated on store
+events (schema.go:129-151).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jsonschema
+
+from . import globs
+from .engine import types as T
+from .policy import model
+from .storage.store import Event, Store
+
+ENFORCEMENT_NONE = "none"
+ENFORCEMENT_WARN = "warn"
+ENFORCEMENT_REJECT = "reject"
+
+_URL_PREFIX = "cerbos:///"
+
+
+class SchemaManager:
+    def __init__(self, store: Store, enforcement: str = ENFORCEMENT_NONE):
+        self.store = store
+        self.enforcement = enforcement
+        self._cache: dict[str, Any] = {}
+        store.subscribe(self._on_event)
+
+    def _on_event(self, events: list[Event]) -> None:
+        self._cache.clear()
+
+    def _validator(self, ref: str) -> Optional[Any]:
+        if ref in self._cache:
+            return self._cache[ref]
+        schema_id = ref[len(_URL_PREFIX):] if ref.startswith(_URL_PREFIX) else ref
+        raw = self.store.get_schema(schema_id)
+        validator = None
+        if raw is not None:
+            import json
+
+            try:
+                validator = jsonschema.Draft202012Validator(json.loads(raw))
+            except Exception:  # noqa: BLE001 — invalid schema acts as missing
+                validator = None
+        self._cache[ref] = validator
+        return validator
+
+    def _validate(self, ref: str, attrs: dict[str, Any], source: str, errors: list[T.ValidationError]) -> None:
+        validator = self._validator(ref)
+        if validator is None:
+            errors.append(T.ValidationError(path="", message=f"failed to load schema {ref}", source=source))
+            return
+        for err in validator.iter_errors(attrs):
+            path = "/" + "/".join(str(p) for p in err.absolute_path)
+            errors.append(T.ValidationError(path=path, message=err.message, source=source))
+
+    def validate_check_input(
+        self, schemas: Optional[model.Schemas], input: T.CheckInput, principal_only: bool = False
+    ) -> tuple[list[T.ValidationError], bool]:
+        """→ (errors, reject). Ref: schema.go ValidateCheckInput."""
+        if self.enforcement == ENFORCEMENT_NONE or schemas is None:
+            return [], False
+        errors: list[T.ValidationError] = []
+        if schemas.principal_schema is not None and schemas.principal_schema.ref:
+            if not self._ignored(schemas.principal_schema, input.actions):
+                self._validate(schemas.principal_schema.ref, input.principal.attr, "SOURCE_PRINCIPAL", errors)
+        if not principal_only and schemas.resource_schema is not None and schemas.resource_schema.ref:
+            if not self._ignored(schemas.resource_schema, input.actions):
+                self._validate(schemas.resource_schema.ref, input.resource.attr, "SOURCE_RESOURCE", errors)
+        reject = bool(errors) and self.enforcement == ENFORCEMENT_REJECT
+        return errors, reject
+
+    def _ignored(self, schema_ref: model.SchemaRef, actions: list[str]) -> bool:
+        """ignoreWhen: skip validation when every action matches a glob."""
+        if not schema_ref.ignore_when_actions:
+            return False
+        return all(
+            any(globs.matches_glob(pat, a) for pat in schema_ref.ignore_when_actions) for a in actions
+        )

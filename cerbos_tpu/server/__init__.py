@@ -1,0 +1,2 @@
+from .service import CerbosService  # noqa: F401
+from .server import Server, ServerConfig  # noqa: F401

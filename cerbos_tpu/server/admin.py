@@ -1,0 +1,212 @@
+"""Admin API: policy/schema CRUD, store reload, audit queries.
+
+Behavioral reference: internal/svc/admin_svc.go — basic-auth protected
+policy add/update/list/get/delete/enable/disable, schema CRUD, store reload,
+audit log queries. Served over the HTTP listener (mirroring the
+grpc-gateway admin routes: /admin/policy, /admin/schema, /admin/store/reload,
+/admin/auditlog/list/{kind}).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import secrets
+from typing import Any, Optional
+
+from aiohttp import web
+
+
+class AdminService:
+    def __init__(self, core: Any, username: str = "cerbos", password_hash: str = "", password: str = "cerbosAdmin"):
+        self.core = core
+        self.username = username
+        self.password_hash = password_hash  # base64(bcrypt) unsupported; sha256 hex accepted
+        self.password = password
+
+    # -- auth --------------------------------------------------------------
+
+    def _authorized(self, request: web.Request) -> bool:
+        header = request.headers.get("Authorization", "")
+        if not header.startswith("Basic "):
+            return False
+        try:
+            user, _, pw = base64.b64decode(header[6:]).decode("utf-8").partition(":")
+        except Exception:  # noqa: BLE001
+            return False
+        if not secrets.compare_digest(user, self.username):
+            return False
+        if self.password_hash:
+            return secrets.compare_digest(hashlib.sha256(pw.encode()).hexdigest(), self.password_hash)
+        return secrets.compare_digest(pw, self.password)
+
+    def _guard(self, request: web.Request) -> Optional[web.Response]:
+        if not self._authorized(request):
+            return web.json_response({"code": 16, "message": "unauthenticated"}, status=401)
+        return None
+
+    # -- routes ------------------------------------------------------------
+
+    def add_http_routes(self, app: web.Application) -> None:
+        app.router.add_post("/admin/policy", self._h_add_policies)
+        app.router.add_get("/admin/policies", self._h_list_policies)
+        app.router.add_get("/admin/policy", self._h_get_policy)
+        app.router.add_delete("/admin/policy", self._h_delete_policy)
+        app.router.add_post("/admin/policy/enable", self._h_enable_policy)
+        app.router.add_post("/admin/policy/disable", self._h_disable_policy)
+        app.router.add_post("/admin/schema", self._h_add_schema)
+        app.router.add_get("/admin/schemas", self._h_list_schemas)
+        app.router.add_get("/admin/schema", self._h_get_schema)
+        app.router.add_delete("/admin/schema", self._h_delete_schema)
+        app.router.add_get("/admin/store/reload", self._h_reload_store)
+        app.router.add_get("/admin/auditlog/list/{kind}", self._h_audit_list)
+
+    def grpc_handler(self):
+        return None  # gRPC admin surface lands with the full admin proto set
+
+    def _mutable_store(self):
+        store = self.core.store
+        if not hasattr(store, "add_or_update"):
+            return None
+        return store
+
+    async def _h_add_policies(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        store = self._mutable_store()
+        if store is None:
+            return web.json_response({"code": 9, "message": "store is not mutable"}, status=400)
+        body = await request.json()
+        import yaml as _yaml
+
+        docs = [_yaml.safe_dump(p) for p in body.get("policies", [])]
+        try:
+            fqns = store.add_or_update(docs)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"code": 3, "message": str(e)}, status=400)
+        return web.json_response({"success": {}, "fqns": fqns})
+
+    async def _h_list_policies(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        store = self._mutable_store()
+        if store is not None:
+            ids = store.list_policy_ids(include_disabled=request.query.get("includeDisabled") == "true")
+        else:
+            ids = sorted(p.fqn() for p in self.core.store.get_all())
+        from .. import namer
+
+        return web.json_response({"policyIds": [namer.policy_key_from_fqn(i) for i in ids]})
+
+    async def _h_get_policy(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        from .. import namer
+
+        ids = request.query.getall("id", [])
+        store = self._mutable_store()
+        out = []
+        for pid in ids:
+            fqn = namer.fqn_from_policy_key(pid)
+            raw = store.get_raw(fqn) if store is not None else None
+            if raw is not None:
+                import yaml as _yaml
+
+                out.append(_yaml.safe_load(raw))
+        return web.json_response({"policies": out})
+
+    async def _h_delete_policy(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        store = self._mutable_store()
+        if store is None:
+            return web.json_response({"code": 9, "message": "store is not mutable"}, status=400)
+        from .. import namer
+
+        ids = [namer.fqn_from_policy_key(i) for i in request.query.getall("id", [])]
+        n = store.delete(ids)
+        return web.json_response({"deletedPolicies": n})
+
+    async def _h_enable_policy(self, request: web.Request) -> web.Response:
+        return await self._set_disabled(request, disabled=False, key="enabledPolicies")
+
+    async def _h_disable_policy(self, request: web.Request) -> web.Response:
+        return await self._set_disabled(request, disabled=True, key="disabledPolicies")
+
+    async def _set_disabled(self, request: web.Request, disabled: bool, key: str) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        store = self._mutable_store()
+        if store is None:
+            return web.json_response({"code": 9, "message": "store is not mutable"}, status=400)
+        from .. import namer
+
+        ids = [namer.fqn_from_policy_key(i) for i in request.query.getall("id", [])]
+        n = store.set_disabled(ids, disabled)
+        return web.json_response({key: n})
+
+    async def _h_add_schema(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        store = self._mutable_store()
+        if store is None or not hasattr(store, "add_schema"):
+            return web.json_response({"code": 9, "message": "store is not mutable"}, status=400)
+        body = await request.json()
+        import base64 as _b64
+        import json as _json
+
+        for schema in body.get("schemas", []):
+            definition = schema.get("definition", "")
+            if isinstance(definition, str):
+                raw = _b64.b64decode(definition)
+            else:
+                raw = _json.dumps(definition).encode()
+            store.add_schema(schema.get("id", ""), raw)
+        return web.json_response({})
+
+    async def _h_list_schemas(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        return web.json_response({"schemaIds": self.core.store.list_schema_ids()})
+
+    async def _h_get_schema(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        import json as _json
+
+        out = []
+        for sid in request.query.getall("id", []):
+            raw = self.core.store.get_schema(sid)
+            if raw is not None:
+                out.append({"id": sid, "definition": _json.loads(raw)})
+        return web.json_response({"schemas": out})
+
+    async def _h_delete_schema(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        store = self._mutable_store()
+        if store is None or not hasattr(store, "delete_schema"):
+            return web.json_response({"code": 9, "message": "store is not mutable"}, status=400)
+        n = 0
+        for sid in request.query.getall("id", []):
+            if store.delete_schema(sid):
+                n += 1
+        return web.json_response({"deletedSchemas": n})
+
+    async def _h_reload_store(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        self.core.store.reload()
+        return web.json_response({})
+
+    async def _h_audit_list(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        kind = request.match_info["kind"]
+        audit_log = self.core.audit_log
+        backend = getattr(audit_log, "backend", None) if audit_log else None
+        if backend is None or not hasattr(backend, "query"):
+            return web.json_response({"code": 9, "message": "audit log backend is not queryable"}, status=400)
+        kind_name = {"access_logs": "access", "decision_logs": "decision"}.get(kind, kind)
+        entries = backend.query(kind=kind_name, limit=int(request.query.get("tail", "100")))
+        return web.json_response({"entries": entries})

@@ -1,0 +1,203 @@
+"""Conversions: protobuf / JSON ⇄ engine types.
+
+JSON follows the grpc-gateway JSON mapping the reference serves over HTTP
+(camelCase field names, effects as enum strings), so existing Cerbos HTTP
+clients work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from google.protobuf import struct_pb2
+
+from ..api.cerbos.effect.v1 import effect_pb2
+from ..api.cerbos.engine.v1 import engine_pb2
+from ..api.cerbos.request.v1 import request_pb2
+from ..api.cerbos.response.v1 import response_pb2
+from ..api.cerbos.schema.v1 import schema_pb2
+from ..engine import types as T
+
+_EFFECT_TO_ENUM = {
+    T.EFFECT_ALLOW: effect_pb2.EFFECT_ALLOW,
+    T.EFFECT_DENY: effect_pb2.EFFECT_DENY,
+    T.EFFECT_NO_MATCH: effect_pb2.EFFECT_NO_MATCH,
+}
+
+_SOURCE_TO_ENUM = {
+    "SOURCE_PRINCIPAL": schema_pb2.ValidationError.SOURCE_PRINCIPAL,
+    "SOURCE_RESOURCE": schema_pb2.ValidationError.SOURCE_RESOURCE,
+}
+
+
+def value_to_py(v: struct_pb2.Value) -> Any:
+    kind = v.WhichOneof("kind")
+    if kind == "struct_value":
+        return {k: value_to_py(x) for k, x in v.struct_value.fields.items()}
+    if kind == "list_value":
+        return [value_to_py(x) for x in v.list_value.values]
+    if kind == "number_value":
+        return v.number_value
+    if kind == "string_value":
+        return v.string_value
+    if kind == "bool_value":
+        return v.bool_value
+    return None
+
+
+def py_to_value(v: Any) -> struct_pb2.Value:
+    out = struct_pb2.Value()
+    if v is None:
+        out.null_value = 0
+    elif isinstance(v, bool):
+        out.bool_value = v
+    elif isinstance(v, (int, float)):
+        out.number_value = float(v)
+    elif isinstance(v, str):
+        out.string_value = v
+    elif isinstance(v, (list, tuple)):
+        out.list_value.values.extend(py_to_value(x) for x in v)
+    elif isinstance(v, dict):
+        for k, x in v.items():
+            out.struct_value.fields[str(k)].CopyFrom(py_to_value(x))
+    else:
+        out.string_value = str(v)
+    return out
+
+
+def principal_from_proto(p: engine_pb2.Principal) -> T.Principal:
+    return T.Principal(
+        id=p.id,
+        roles=list(p.roles),
+        attr={k: value_to_py(v) for k, v in p.attr.items()},
+        policy_version=p.policy_version,
+        scope=p.scope,
+    )
+
+
+def resource_from_proto(r) -> T.Resource:
+    return T.Resource(
+        kind=r.kind,
+        id=getattr(r, "id", ""),
+        attr={k: value_to_py(v) for k, v in r.attr.items()},
+        policy_version=r.policy_version,
+        scope=r.scope,
+    )
+
+
+def check_resources_request_to_inputs(
+    req: request_pb2.CheckResourcesRequest, aux_data: T.AuxData | None
+) -> list[T.CheckInput]:
+    principal = principal_from_proto(req.principal)
+    inputs = []
+    for entry in req.resources:
+        inputs.append(
+            T.CheckInput(
+                request_id=req.request_id,
+                principal=principal,
+                resource=resource_from_proto(entry.resource),
+                actions=list(entry.actions),
+                aux_data=aux_data,
+            )
+        )
+    return inputs
+
+
+def outputs_to_check_resources_response(
+    req: request_pb2.CheckResourcesRequest,
+    outputs: list[T.CheckOutput],
+    call_id: str = "",
+) -> response_pb2.CheckResourcesResponse:
+    resp = response_pb2.CheckResourcesResponse(request_id=req.request_id, cerbos_call_id=call_id)
+    for entry, out in zip(req.resources, outputs):
+        re = resp.results.add()
+        re.resource.id = entry.resource.id
+        re.resource.kind = entry.resource.kind
+        re.resource.policy_version = entry.resource.policy_version
+        re.resource.scope = entry.resource.scope
+        for action, ae in out.actions.items():
+            re.actions[action] = _EFFECT_TO_ENUM.get(ae.effect, effect_pb2.EFFECT_DENY)
+        for ve in out.validation_errors:
+            re.validation_errors.add(path=ve.path, message=ve.message, source=_SOURCE_TO_ENUM.get(ve.source, 0))
+        for oe in out.outputs:
+            o = re.outputs.add(src=oe.src, action=oe.action, error=oe.error)
+            if oe.error == "":
+                o.val.CopyFrom(py_to_value(oe.val))
+        if req.include_meta:
+            for action, ae in out.actions.items():
+                re.meta.actions[action].matched_policy = ae.policy
+                re.meta.actions[action].matched_scope = ae.scope
+            re.meta.effective_derived_roles.extend(out.effective_derived_roles)
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# JSON (grpc-gateway mapping)
+
+
+def json_to_check_inputs(body: dict, aux_data: T.AuxData | None) -> tuple[list[T.CheckInput], str, bool]:
+    principal_j = body.get("principal") or {}
+    principal = T.Principal(
+        id=principal_j.get("id", ""),
+        roles=list(principal_j.get("roles", [])),
+        attr=principal_j.get("attr", {}) or {},
+        policy_version=principal_j.get("policyVersion", ""),
+        scope=principal_j.get("scope", ""),
+    )
+    request_id = body.get("requestId", "")
+    include_meta = bool(body.get("includeMeta", False))
+    inputs = []
+    for entry in body.get("resources", []):
+        rj = entry.get("resource") or {}
+        inputs.append(
+            T.CheckInput(
+                request_id=request_id,
+                principal=principal,
+                resource=T.Resource(
+                    kind=rj.get("kind", ""),
+                    id=rj.get("id", ""),
+                    attr=rj.get("attr", {}) or {},
+                    policy_version=rj.get("policyVersion", ""),
+                    scope=rj.get("scope", ""),
+                ),
+                actions=list(entry.get("actions", [])),
+                aux_data=aux_data,
+            )
+        )
+    return inputs, request_id, include_meta
+
+
+def outputs_to_json(
+    body: dict, outputs: list[T.CheckOutput], request_id: str, include_meta: bool, call_id: str = ""
+) -> dict:
+    results = []
+    for entry, out in zip(body.get("resources", []), outputs):
+        rj = entry.get("resource") or {}
+        result: dict[str, Any] = {
+            "resource": {
+                "id": rj.get("id", ""),
+                "kind": rj.get("kind", ""),
+                "policyVersion": rj.get("policyVersion", ""),
+                "scope": rj.get("scope", ""),
+            },
+            "actions": {a: ae.effect for a, ae in out.actions.items()},
+        }
+        if out.validation_errors:
+            result["validationErrors"] = [
+                {"path": ve.path, "message": ve.message, "source": ve.source} for ve in out.validation_errors
+            ]
+        if out.outputs:
+            result["outputs"] = [
+                {"src": oe.src, "action": oe.action, **({"val": oe.val} if not oe.error else {"error": oe.error})}
+                for oe in out.outputs
+            ]
+        if include_meta:
+            result["meta"] = {
+                "actions": {a: {"matchedPolicy": ae.policy, "matchedScope": ae.scope} for a, ae in out.actions.items()},
+                "effectiveDerivedRoles": out.effective_derived_roles,
+            }
+        results.append(result)
+    resp = {"requestId": request_id, "results": results}
+    if call_id:
+        resp["cerbosCallId"] = call_id
+    return resp

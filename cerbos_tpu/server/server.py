@@ -1,0 +1,293 @@
+"""gRPC + HTTP API server.
+
+Behavioral reference: internal/server/server.go — two listeners (gRPC on
+3593, HTTP on 3592), the HTTP surface mirroring the grpc-gateway routes
+(/api/check/resources, /api/plan/resources), health at /_cerbos/health,
+Prometheus metrics at /_cerbos/metrics. The gRPC service registers under the
+reference's full method names so existing Cerbos gRPC clients connect
+unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import grpc
+from aiohttp import web
+
+from ..engine import types as T
+from . import convert
+from .service import CerbosService, RequestLimitExceeded
+
+
+@dataclass
+class ServerConfig:
+    """Ref: internal/server/conf.go (default ports 3592/3593)."""
+
+    http_listen_addr: str = "0.0.0.0:3592"
+    grpc_listen_addr: str = "0.0.0.0:3593"
+    max_workers: int = 16
+
+
+def _grpc_handlers(svc: CerbosService):
+    from ..api.cerbos.request.v1 import request_pb2
+    from ..api.cerbos.response.v1 import response_pb2
+
+    def check_resources(req: request_pb2.CheckResourcesRequest, ctx: grpc.ServicerContext):
+        try:
+            aux = None
+            if req.HasField("aux_data") and req.aux_data.jwt.token:
+                aux = svc._extract_aux_data(req.aux_data.jwt.token, req.aux_data.jwt.key_set_id)
+            inputs = convert.check_resources_request_to_inputs(req, aux)
+            outputs, call_id = svc.check_resources(inputs)
+            return convert.outputs_to_check_resources_response(req, outputs, call_id)
+        except RequestLimitExceeded as e:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001
+            ctx.abort(grpc.StatusCode.INTERNAL, f"check failed: {e}")
+
+    def plan_resources(req: request_pb2.PlanResourcesRequest, ctx: grpc.ServicerContext):
+        try:
+            aux = None
+            if req.HasField("aux_data") and req.aux_data.jwt.token:
+                aux = svc._extract_aux_data(req.aux_data.jwt.token, req.aux_data.jwt.key_set_id)
+            body = {
+                "requestId": req.request_id,
+                "action": req.action,
+                "actions": list(req.actions),
+                "principal": {
+                    "id": req.principal.id,
+                    "roles": list(req.principal.roles),
+                    "attr": {k: convert.value_to_py(v) for k, v in req.principal.attr.items()},
+                    "policyVersion": req.principal.policy_version,
+                    "scope": req.principal.scope,
+                },
+                "resource": {
+                    "kind": req.resource.kind,
+                    "attr": {k: convert.value_to_py(v) for k, v in req.resource.attr.items()},
+                    "policyVersion": req.resource.policy_version,
+                    "scope": req.resource.scope,
+                },
+                "includeMeta": req.include_meta,
+            }
+            resp_json, call_id = _plan_from_json(svc, body, aux)
+            return _plan_json_to_proto(resp_json, response_pb2)
+        except NotImplementedError as e:
+            ctx.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
+        except RequestLimitExceeded as e:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001
+            ctx.abort(grpc.StatusCode.INTERNAL, f"plan failed: {e}")
+
+    def server_info(req, ctx):
+        info = svc.server_info()
+        return response_pb2.ServerInfoResponse(version=info["version"], commit=info["commit"], build_date=info["buildDate"])
+
+    rpcs = {
+        "CheckResources": grpc.unary_unary_rpc_method_handler(
+            check_resources,
+            request_deserializer=request_pb2.CheckResourcesRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        ),
+        "PlanResources": grpc.unary_unary_rpc_method_handler(
+            plan_resources,
+            request_deserializer=request_pb2.PlanResourcesRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        ),
+        "ServerInfo": grpc.unary_unary_rpc_method_handler(
+            server_info,
+            request_deserializer=request_pb2.ServerInfoRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        ),
+    }
+    return grpc.method_handlers_generic_handler("cerbos.svc.v1.CerbosService", rpcs)
+
+
+def _plan_from_json(svc: CerbosService, body: dict, aux: Optional[T.AuxData]) -> tuple[dict, str]:
+    from ..plan.types import PlanInput
+
+    pj = body.get("principal") or {}
+    rj = body.get("resource") or {}
+    actions = list(body.get("actions") or ([] if not body.get("action") else [body["action"]]))
+    plan_input = PlanInput(
+        request_id=body.get("requestId", ""),
+        actions=actions,
+        principal=T.Principal(
+            id=pj.get("id", ""),
+            roles=list(pj.get("roles", [])),
+            attr=pj.get("attr", {}) or {},
+            policy_version=pj.get("policyVersion", ""),
+            scope=pj.get("scope", ""),
+        ),
+        resource_kind=rj.get("kind", ""),
+        resource_attr=rj.get("attr", {}) or {},
+        resource_policy_version=rj.get("policyVersion", ""),
+        resource_scope=rj.get("scope", ""),
+        aux_data=aux,
+        include_meta=bool(body.get("includeMeta", False)),
+    )
+    output, call_id = svc.plan_resources(plan_input)
+    return output.to_json(call_id), call_id
+
+
+def _plan_json_to_proto(j: dict, response_pb2):
+    from google.protobuf import json_format
+
+    return json_format.ParseDict(j, response_pb2.PlanResourcesResponse(), ignore_unknown_fields=True)
+
+
+class Server:
+    """Serves the Cerbos API over gRPC and HTTP concurrently."""
+
+    def __init__(self, service: CerbosService, config: Optional[ServerConfig] = None, admin_service: Any = None):
+        self.svc = service
+        self.config = config or ServerConfig()
+        self.admin_service = admin_service
+        self._grpc_server: Optional[grpc.Server] = None
+        self._http_runner: Optional[web.AppRunner] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self.http_port: int = 0
+        self.grpc_port: int = 0
+
+    # -- gRPC --------------------------------------------------------------
+
+    def _start_grpc(self) -> None:
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=self.config.max_workers))
+        server.add_generic_rpc_handlers((_grpc_handlers(self.svc),))
+        if self.admin_service is not None:
+            handler = self.admin_service.grpc_handler()
+            if handler is not None:
+                server.add_generic_rpc_handlers((handler,))
+        port = server.add_insecure_port(self.config.grpc_listen_addr)
+        self.grpc_port = port
+        server.start()
+        self._grpc_server = server
+
+    # -- HTTP --------------------------------------------------------------
+
+    def _http_app(self) -> web.Application:
+        app = web.Application(client_max_size=16 * 1024 * 1024)
+        app.router.add_post("/api/check/resources", self._h_check_resources)
+        app.router.add_post("/api/plan/resources", self._h_plan_resources)
+        app.router.add_get("/_cerbos/health", self._h_health)
+        app.router.add_get("/_cerbos/metrics", self._h_metrics)
+        app.router.add_get("/api/server_info", self._h_server_info)
+        if self.admin_service is not None:
+            self.admin_service.add_http_routes(app)
+        return app
+
+    async def _h_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "SERVING"})
+
+    async def _h_server_info(self, request: web.Request) -> web.Response:
+        return web.json_response(self.svc.server_info())
+
+    async def _h_metrics(self, request: web.Request) -> web.Response:
+        m = self.svc.metrics
+        lat = sorted(m.check_latency_ms)
+
+        def pct(p: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        lines = [
+            "# TYPE cerbos_dev_engine_check_count counter",
+            f"cerbos_dev_engine_check_count {m.check_count}",
+            "# TYPE cerbos_dev_engine_plan_count counter",
+            f"cerbos_dev_engine_plan_count {m.plan_count}",
+            "# TYPE cerbos_dev_engine_check_latency_ms summary",
+            f'cerbos_dev_engine_check_latency_ms{{quantile="0.5"}} {pct(0.5):.3f}',
+            f'cerbos_dev_engine_check_latency_ms{{quantile="0.95"}} {pct(0.95):.3f}',
+            f'cerbos_dev_engine_check_latency_ms{{quantile="0.99"}} {pct(0.99):.3f}',
+            "# TYPE cerbos_dev_engine_check_batch_size_total counter",
+            f"cerbos_dev_engine_check_batch_size_total {sum(m.batch_sizes)}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def _h_check_resources(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        try:
+            aux = None
+            aux_j = (body.get("auxData") or {}).get("jwt") or {}
+            if aux_j.get("token"):
+                aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
+            inputs, request_id, include_meta = convert.json_to_check_inputs(body, aux)
+            outputs, call_id = self.svc.check_resources(inputs)
+            return web.json_response(convert.outputs_to_json(body, outputs, request_id, include_meta, call_id))
+        except RequestLimitExceeded as e:
+            return web.json_response({"code": 3, "message": str(e)}, status=400)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"code": 13, "message": f"check failed: {e}"}, status=500)
+
+    async def _h_plan_resources(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        try:
+            aux = None
+            aux_j = (body.get("auxData") or {}).get("jwt") or {}
+            if aux_j.get("token"):
+                aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
+            resp, _call_id = _plan_from_json(self.svc, body, aux)
+            return web.json_response(resp)
+        except NotImplementedError as e:
+            return web.json_response({"code": 12, "message": str(e)}, status=501)
+        except RequestLimitExceeded as e:
+            return web.json_response({"code": 3, "message": str(e)}, status=400)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"code": 13, "message": f"plan failed: {e}"}, status=500)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._start_grpc()
+        started = threading.Event()
+
+        def run_http() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            runner = web.AppRunner(self._http_app())
+            loop.run_until_complete(runner.setup())
+            host, _, port = self.config.http_listen_addr.rpartition(":")
+            site = web.TCPSite(runner, host or "0.0.0.0", int(port))
+            loop.run_until_complete(site.start())
+            for s in runner.sites:
+                self.http_port = s._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+            self._http_runner = runner
+            started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run_http, daemon=True, name="http-server")
+        self._thread.start()
+        started.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1).wait()
+        if self._loop is not None:
+            loop = self._loop
+
+            async def shutdown() -> None:
+                if self._http_runner is not None:
+                    await self._http_runner.cleanup()
+                loop.stop()
+
+            asyncio.run_coroutine_threadsafe(shutdown(), loop)
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+
+    def wait(self) -> None:
+        if self._grpc_server is not None:
+            self._grpc_server.wait_for_termination()

@@ -1,0 +1,106 @@
+"""CerbosService: the request-handling core shared by gRPC and HTTP.
+
+Behavioral reference: internal/svc/cerbos_svc.go (CheckResources,
+PlanResources, ServerInfo; request limits cerbos_svc.go:346-362).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .. import __version__
+from ..engine import types as T
+from ..engine.engine import Engine
+
+
+class RequestLimitExceeded(ValueError):
+    pass
+
+
+@dataclass
+class ServiceLimits:
+    """Ref: internal/server/conf.go:34-35 (defaults 50x50)."""
+
+    max_actions_per_resource: int = 50
+    max_resources_per_request: int = 50
+
+
+@dataclass
+class ServiceMetrics:
+    check_count: int = 0
+    plan_count: int = 0
+    check_latency_ms: list = field(default_factory=list)
+    batch_sizes: list = field(default_factory=list)
+
+    def record_check(self, latency_ms: float, batch: int) -> None:
+        self.check_count += 1
+        self.check_latency_ms.append(latency_ms)
+        self.batch_sizes.append(batch)
+        if len(self.check_latency_ms) > 10000:
+            del self.check_latency_ms[:5000]
+            del self.batch_sizes[:5000]
+
+
+class CerbosService:
+    def __init__(
+        self,
+        engine: Engine,
+        aux_data_mgr: Any = None,
+        limits: Optional[ServiceLimits] = None,
+        audit_log: Any = None,
+        planner: Any = None,
+    ):
+        self.engine = engine
+        self.aux_data_mgr = aux_data_mgr
+        self.limits = limits or ServiceLimits()
+        self.audit_log = audit_log
+        self.planner = planner
+        self.metrics = ServiceMetrics()
+
+    def _extract_aux_data(self, jwt_token: str, key_set_id: str) -> Optional[T.AuxData]:
+        if not jwt_token:
+            return None
+        if self.aux_data_mgr is None:
+            return None
+        return self.aux_data_mgr.extract(jwt_token, key_set_id)
+
+    def check_resources(
+        self,
+        inputs: list[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+    ) -> tuple[list[T.CheckOutput], str]:
+        if len(inputs) > self.limits.max_resources_per_request:
+            raise RequestLimitExceeded(
+                f"number of resources exceeds the limit of {self.limits.max_resources_per_request}"
+            )
+        for i in inputs:
+            if len(i.actions) > self.limits.max_actions_per_resource:
+                raise RequestLimitExceeded(
+                    f"number of actions exceeds the limit of {self.limits.max_actions_per_resource}"
+                )
+            if not i.actions:
+                raise RequestLimitExceeded("at least one action must be specified")
+        call_id = uuid.uuid4().hex
+        t0 = time.perf_counter()
+        outputs = self.engine.check(inputs, params=params)
+        self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
+        if self.audit_log is not None:
+            self.audit_log.write_decision(call_id, inputs, outputs)
+        return outputs, call_id
+
+    def plan_resources(self, input: Any, params: Optional[T.EvalParams] = None) -> tuple[Any, str]:
+        if self.planner is None:
+            raise NotImplementedError("PlanResources is not configured")
+        call_id = uuid.uuid4().hex
+        t0 = time.perf_counter()
+        output = self.planner.plan(input, params=params)
+        self.metrics.plan_count += 1
+        if self.audit_log is not None:
+            self.audit_log.write_plan(call_id, input, output)
+        return output, call_id
+
+    def server_info(self) -> dict[str, str]:
+        return {"version": f"cerbos-tpu {__version__}", "commit": "", "buildDate": ""}

@@ -1,0 +1,5 @@
+from .store import Event, EVENT_ADD_UPDATE, EVENT_DELETE, EVENT_RELOAD, Store, SubscriptionManager, new_store  # noqa: F401
+from .disk import DiskStore  # noqa: F401
+from .sqlite import SqliteStore  # noqa: F401
+from .git import GitStore  # noqa: F401
+from .overlay import OverlayStore  # noqa: F401

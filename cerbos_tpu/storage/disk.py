@@ -1,0 +1,178 @@
+"""Disk store: policy directory + optional change watching.
+
+Behavioral reference: internal/storage/disk (+ internal/storage/index dir
+indexing: hidden files and `testdata` directories skipped, `_schemas` dir for
+JSON schemas, targeted events per changed policy). Watching uses mtime
+polling (debounced), which behaves like the reference's fsnotify+debounce
+without OS-specific watchers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..policy import model
+from ..policy.parser import ParseError, parse_policy_file
+from .store import EVENT_ADD_UPDATE, EVENT_DELETE, Event, Store, register_driver
+
+POLICY_EXTS = (".yaml", ".yml", ".json")
+SCHEMAS_DIR = "_schemas"
+
+
+def _is_hidden(name: str) -> bool:
+    return name.startswith(".")
+
+
+class BuildError(ValueError):
+    def __init__(self, errors: list[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors))
+
+
+class DiskStore(Store):
+    driver = "disk"
+
+    def __init__(self, directory: str, watch_for_changes: bool = False, poll_interval: float = 1.0):
+        super().__init__()
+        self.directory = os.path.abspath(directory)
+        self._lock = threading.Lock()
+        self._policies: dict[str, model.Policy] = {}  # fqn -> policy
+        self._files: dict[str, tuple[str, float]] = {}  # path -> (fqn, mtime)
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._load_all(strict=True)
+        if watch_for_changes:
+            self._watcher = threading.Thread(
+                target=self._watch_loop, args=(poll_interval,), daemon=True, name="disk-store-watch"
+            )
+            self._watcher.start()
+
+    def _iter_policy_files(self):
+        for root, dirs, files in os.walk(self.directory):
+            dirs[:] = [d for d in dirs if not _is_hidden(d) and d not in ("testdata", SCHEMAS_DIR)]
+            for f in files:
+                if _is_hidden(f) or not f.endswith(POLICY_EXTS):
+                    continue
+                if f.endswith("_test.yaml") or f.endswith("_test.yml") or f.endswith("_test.json"):
+                    continue
+                yield os.path.join(root, f)
+
+    def _load_all(self, strict: bool = False) -> None:
+        policies: dict[str, model.Policy] = {}
+        files: dict[str, tuple[str, float]] = {}
+        errors: list[str] = []
+        for path in self._iter_policy_files():
+            try:
+                pol = parse_policy_file(path)
+            except (ParseError, OSError) as e:
+                errors.append(str(e))
+                continue
+            fqn = pol.fqn()
+            if fqn in policies:
+                errors.append(f"duplicate policy definition {fqn} in {path}")
+                continue
+            policies[fqn] = pol
+            files[path] = (fqn, os.path.getmtime(path))
+        if errors and strict:
+            raise BuildError(errors)
+        with self._lock:
+            self._policies = policies
+            self._files = files
+
+    def get_all(self) -> list[model.Policy]:
+        with self._lock:
+            return list(self._policies.values())
+
+    def get(self, fqn: str) -> Optional[model.Policy]:
+        with self._lock:
+            return self._policies.get(fqn)
+
+    # -- schemas -----------------------------------------------------------
+
+    def _schema_path(self, schema_id: str) -> str:
+        return os.path.join(self.directory, SCHEMAS_DIR, schema_id)
+
+    def get_schema(self, schema_id: str) -> Optional[bytes]:
+        path = self._schema_path(schema_id)
+        if not os.path.realpath(path).startswith(os.path.realpath(os.path.join(self.directory, SCHEMAS_DIR))):
+            return None  # path traversal guard
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def list_schema_ids(self) -> list[str]:
+        base = os.path.join(self.directory, SCHEMAS_DIR)
+        out = []
+        for root, _dirs, files in os.walk(base):
+            for f in files:
+                if f.endswith(".json"):
+                    out.append(os.path.relpath(os.path.join(root, f), base))
+        return sorted(out)
+
+    # -- watching ----------------------------------------------------------
+
+    def _watch_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.check_for_changes()
+            except Exception:  # noqa: BLE001
+                import logging
+
+                logging.getLogger("cerbos_tpu.storage.disk").exception("watch cycle failed")
+
+    def check_for_changes(self) -> list[Event]:
+        """Diff the directory against the last snapshot; emit targeted events."""
+        with self._lock:
+            old_files = dict(self._files)
+            old_policies = dict(self._policies)
+        events: list[Event] = []
+        new_policies: dict[str, model.Policy] = {}
+        new_files: dict[str, tuple[str, float]] = {}
+        seen_fqns: set[str] = set()
+        for path in self._iter_policy_files():
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            prev = old_files.get(path)
+            if prev is not None and prev[1] == mtime:
+                fqn = prev[0]
+                new_files[path] = prev
+                new_policies[fqn] = old_policies[fqn]
+                seen_fqns.add(fqn)
+                continue
+            try:
+                pol = parse_policy_file(path)
+            except (ParseError, OSError):
+                continue  # keep last valid state (ref: manager.go:74-84)
+            fqn = pol.fqn()
+            new_files[path] = (fqn, mtime)
+            new_policies[fqn] = pol
+            seen_fqns.add(fqn)
+            events.append(Event(EVENT_ADD_UPDATE, policy_fqn=fqn))
+        for fqn in old_policies:
+            if fqn not in seen_fqns:
+                events.append(Event(EVENT_DELETE, policy_fqn=fqn))
+        if events:
+            with self._lock:
+                self._policies = new_policies
+                self._files = new_files
+            self.subscriptions.notify(events)
+        return events
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=2)
+
+
+register_driver("disk", lambda conf: DiskStore(
+    directory=conf.get("directory", "."),
+    watch_for_changes=bool(conf.get("watchForChanges", False)),
+    poll_interval=float(conf.get("pollInterval", 1.0)),
+))

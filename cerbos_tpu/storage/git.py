@@ -1,0 +1,117 @@
+"""Git store: clone/pull a repo on an interval, serve a subdirectory.
+
+Behavioral reference: internal/storage/git/store.go (go-git clone/pull with
+targeted diff events). Uses the system git binary via subprocess; each poll
+diffs the working tree state through the underlying disk snapshot.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from ..policy import model
+from .disk import DiskStore
+from .store import Event, Store, register_driver
+
+
+class GitStore(Store):
+    driver = "git"
+
+    def __init__(
+        self,
+        repo_url: str,
+        checkout_dir: str,
+        branch: str = "main",
+        subdir: str = "",
+        update_poll_interval: float = 60.0,
+    ):
+        super().__init__()
+        self.repo_url = repo_url
+        self.checkout_dir = os.path.abspath(checkout_dir)
+        self.branch = branch
+        self.subdir = subdir
+        self._stop = threading.Event()
+        self._clone_or_open()
+        policy_dir = os.path.join(self.checkout_dir, subdir) if subdir else self.checkout_dir
+        self._disk = DiskStore(policy_dir, watch_for_changes=False)
+        # re-export inner events through this store's subscription manager
+        self._disk.subscribe(self.subscriptions.notify)
+        self._poller: Optional[threading.Thread] = None
+        if update_poll_interval > 0:
+            self._poller = threading.Thread(
+                target=self._poll_loop, args=(update_poll_interval,), daemon=True, name="git-store-poll"
+            )
+            self._poller.start()
+
+    def _git(self, *args: str, cwd: Optional[str] = None) -> str:
+        result = subprocess.run(
+            ["git", *args],
+            cwd=cwd or self.checkout_dir,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        if result.returncode != 0:
+            raise RuntimeError(f"git {' '.join(args)} failed: {result.stderr.strip()}")
+        return result.stdout
+
+    def _clone_or_open(self) -> None:
+        if os.path.isdir(os.path.join(self.checkout_dir, ".git")):
+            return
+        os.makedirs(os.path.dirname(self.checkout_dir) or ".", exist_ok=True)
+        result = subprocess.run(
+            ["git", "clone", "--branch", self.branch, "--single-branch", self.repo_url, self.checkout_dir],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        if result.returncode != 0:
+            raise RuntimeError(f"git clone failed: {result.stderr.strip()}")
+
+    def _poll_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.pull_and_compare()
+            except Exception:  # noqa: BLE001 — keep serving the last good checkout
+                import logging
+
+                logging.getLogger("cerbos_tpu.storage.git").exception("git poll failed")
+
+    def pull_and_compare(self) -> list[Event]:
+        before = self._git("rev-parse", "HEAD").strip()
+        self._git("fetch", "origin", self.branch)
+        self._git("reset", "--hard", f"origin/{self.branch}")
+        after = self._git("rev-parse", "HEAD").strip()
+        if before == after:
+            return []
+        return self._disk.check_for_changes()
+
+    def get_all(self) -> list[model.Policy]:
+        return self._disk.get_all()
+
+    def get(self, fqn: str):
+        return self._disk.get(fqn)
+
+    def get_schema(self, schema_id: str):
+        return self._disk.get_schema(schema_id)
+
+    def list_schema_ids(self) -> list[str]:
+        return self._disk.list_schema_ids()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2)
+        self._disk.close()
+
+
+register_driver("git", lambda conf: GitStore(
+    repo_url=conf.get("protocol", "file") and conf.get("url", conf.get("repo", "")),
+    checkout_dir=conf.get("checkoutDir", "/tmp/cerbos-tpu-git"),
+    branch=conf.get("branch", "main"),
+    subdir=conf.get("subDir", ""),
+    update_poll_interval=float(conf.get("updatePollInterval", 60.0)),
+))

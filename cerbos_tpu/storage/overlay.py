@@ -1,0 +1,83 @@
+"""Overlay store: base + fallback with a circuit breaker.
+
+Behavioral reference: internal/storage/overlay (base store with failover to
+a fallback store after consecutive errors; the breaker half-opens after a
+cool-down).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..policy import model
+from .store import Store, register_driver, new_store
+
+
+class OverlayStore(Store):
+    driver = "overlay"
+
+    def __init__(self, base: Store, fallback: Store, failure_threshold: int = 5, cooldown_s: float = 30.0):
+        super().__init__()
+        self.base = base
+        self.fallback = fallback
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        base.subscribe(self.subscriptions.notify)
+        fallback.subscribe(self.subscriptions.notify)
+
+    def _active(self) -> Store:
+        if self._opened_at is not None:
+            if time.monotonic() - self._opened_at >= self.cooldown_s:
+                # half-open: try base again
+                self._opened_at = None
+                self._failures = 0
+            else:
+                return self.fallback
+        return self.base
+
+    def _call(self, method: str, *args):
+        store = self._active()
+        try:
+            result = getattr(store, method)(*args)
+            if store is self.base:
+                self._failures = 0
+            return result
+        except Exception:
+            if store is self.base:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    self._opened_at = time.monotonic()
+                return getattr(self.fallback, method)(*args)
+            raise
+
+    def get_all(self) -> list[model.Policy]:
+        return self._call("get_all")
+
+    def get(self, fqn: str):
+        return self._call("get", fqn)
+
+    def get_schema(self, schema_id: str):
+        return self._call("get_schema", schema_id)
+
+    def list_schema_ids(self) -> list[str]:
+        return self._call("list_schema_ids")
+
+    def close(self) -> None:
+        self.base.close()
+        self.fallback.close()
+
+
+def _overlay_factory(conf: dict) -> OverlayStore:
+    base_conf = {"driver": conf.get("baseDriver", "disk"), **conf}
+    fallback_conf = {"driver": conf.get("fallbackDriver", "disk"), **conf}
+    return OverlayStore(
+        base=new_store(base_conf),
+        fallback=new_store(fallback_conf),
+        failure_threshold=int(conf.get("fallbackErrorThreshold", 5)),
+    )
+
+
+register_driver("overlay", _overlay_factory)

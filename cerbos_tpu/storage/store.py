@@ -1,0 +1,96 @@
+"""Policy store contract + driver registry + event fan-out.
+
+Behavioral reference: internal/storage/store.go (Store/SourceStore/
+MutableStore interfaces, driver registry store.go:71-116, SubscriptionManager
+store.go:204-237). Stores surface policies as parsed IR; events notify the
+rule-table manager to recompile affected policies and re-lower device tables.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from ..policy import model
+
+EVENT_RELOAD = "RELOAD"
+EVENT_ADD_UPDATE = "ADD_OR_UPDATE"
+EVENT_DELETE = "DELETE"
+
+
+@dataclass
+class Event:
+    kind: str
+    policy_fqn: str = ""
+    schema_id: str = ""
+
+
+class SubscriptionManager:
+    def __init__(self) -> None:
+        self._subs: list[Callable[[list[Event]], None]] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, fn: Callable[[list[Event]], None]) -> None:
+        with self._lock:
+            self._subs.append(fn)
+
+    def notify(self, events: list[Event]) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for fn in subs:
+            try:
+                fn(events)
+            except Exception:  # noqa: BLE001 — one bad subscriber must not break others
+                import logging
+
+                logging.getLogger("cerbos_tpu.storage").exception("subscriber failed")
+
+
+class Store:
+    """Base store: read-only policy source."""
+
+    driver = "base"
+
+    def __init__(self) -> None:
+        self.subscriptions = SubscriptionManager()
+
+    def subscribe(self, fn: Callable[[list[Event]], None]) -> None:
+        self.subscriptions.subscribe(fn)
+
+    # SourceStore surface
+    def get_all(self) -> list[model.Policy]:
+        raise NotImplementedError
+
+    def get(self, fqn: str) -> Optional[model.Policy]:
+        for p in self.get_all():
+            if p.fqn() == fqn:
+                return p
+        return None
+
+    def get_schema(self, schema_id: str) -> Optional[bytes]:
+        return None
+
+    def list_schema_ids(self) -> list[str]:
+        return []
+
+    def reload(self) -> None:
+        self.subscriptions.notify([Event(EVENT_RELOAD)])
+
+    def close(self) -> None:
+        pass
+
+
+_REGISTRY: dict[str, Callable[[dict], Store]] = {}
+
+
+def register_driver(name: str, factory: Callable[[dict], Store]) -> None:
+    _REGISTRY[name] = factory
+
+
+def new_store(conf: dict) -> Store:
+    driver = conf.get("driver", "disk")
+    factory = _REGISTRY.get(driver)
+    if factory is None:
+        raise ValueError(f"unknown storage driver {driver!r} (known: {sorted(_REGISTRY)})")
+    return factory(conf.get(driver, {}))

@@ -1,0 +1,1 @@
+from .runner import SuiteResults, discover_and_run, run_suite  # noqa: F401

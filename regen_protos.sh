@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate cerbos_tpu/api from api/*.proto (protoc has no package-prefix
+# option, so absolute generated imports are rewritten to live under
+# cerbos_tpu.api).
+set -e
+protoc -I api --python_out=cerbos_tpu/api api/cerbos/*/v1/*.proto
+find cerbos_tpu/api -type d -exec touch {}/__init__.py \;
+sed -i 's/^from cerbos\./from cerbos_tpu.api.cerbos./' cerbos_tpu/api/cerbos/*/v1/*_pb2.py

@@ -1,0 +1,229 @@
+"""Full-server integration tests: real gRPC + HTTP against an in-process
+server with a disk store (modeled on internal/server/tests.go)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from cerbos_tpu.bootstrap import initialize
+from cerbos_tpu.config import Config
+from cerbos_tpu.server.server import Server, ServerConfig
+from cerbos_tpu.server.admin import AdminService
+
+POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: album
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: request.resource.attr.owner == request.principal.id || request.resource.attr.public == true
+    - actions: ["*"]
+      effect: EFFECT_ALLOW
+      roles: [admin]
+"""
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    policy_dir = tmp_path_factory.mktemp("policies")
+    (policy_dir / "album.yaml").write_text(POLICY)
+    config = Config.load(
+        overrides=[
+            f"storage.disk.directory={policy_dir}",
+            "server.httpListenAddr=127.0.0.1:0",
+            "server.grpcListenAddr=127.0.0.1:0",
+            "server.adminAPI.enabled=true",
+            "audit.enabled=true",
+            "audit.backend=local",
+            # the CPU oracle path keeps server tests independent of jax
+            "engine.tpu.enabled=false",
+        ]
+    )
+    core = initialize(config, use_tpu=False)
+    admin = AdminService(core, username="cerbos", password="cerbosAdmin")
+    srv = Server(
+        core.service,
+        ServerConfig(http_listen_addr="127.0.0.1:0", grpc_listen_addr="127.0.0.1:0"),
+        admin_service=admin,
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+    core.close()
+
+
+def http_post(server, path, body, auth=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.http_port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(auth or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def http_get(server, path, auth=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{server.http_port}{path}", headers=auth or {})
+    with urllib.request.urlopen(req) as resp:
+        return resp.read()
+
+
+CHECK_BODY = {
+    "requestId": "test-1",
+    "includeMeta": True,
+    "principal": {"id": "alice", "roles": ["user"], "attr": {"dept": "eng"}},
+    "resources": [
+        {"actions": ["view", "delete"], "resource": {"kind": "album", "id": "a1", "attr": {"owner": "alice"}}},
+        {"actions": ["view"], "resource": {"kind": "album", "id": "a2", "attr": {"owner": "bob", "public": False}}},
+    ],
+}
+
+
+class TestHTTP:
+    def test_check_resources(self, server):
+        resp = http_post(server, "/api/check/resources", CHECK_BODY)
+        assert resp["requestId"] == "test-1"
+        r1, r2 = resp["results"]
+        assert r1["actions"] == {"view": "EFFECT_ALLOW", "delete": "EFFECT_DENY"}
+        assert r1["meta"]["actions"]["view"]["matchedPolicy"] == "resource.album.vdefault"
+        assert r2["actions"] == {"view": "EFFECT_DENY"}
+        assert resp.get("cerbosCallId")
+
+    def test_health(self, server):
+        assert json.loads(http_get(server, "/_cerbos/health")) == {"status": "SERVING"}
+
+    def test_metrics(self, server):
+        text = http_get(server, "/_cerbos/metrics").decode()
+        assert "cerbos_dev_engine_check_count" in text
+
+    def test_invalid_json(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.http_port}/api/check/resources",
+            data=b"{not json", headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+
+    def test_limits(self, server):
+        body = dict(CHECK_BODY)
+        body["resources"] = [CHECK_BODY["resources"][0]] * 51
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.http_port}/api/check/resources",
+            data=json.dumps(body).encode(), headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+
+    def test_plan_resources(self, server):
+        resp = http_post(server, "/api/plan/resources", {
+            "requestId": "plan-1",
+            "actions": ["view"],
+            "principal": {"id": "alice", "roles": ["user"]},
+            "resource": {"kind": "album"},
+            "includeMeta": True,
+        })
+        assert resp["filter"]["kind"] == "KIND_CONDITIONAL"
+        cond = resp["filter"]["condition"]["expression"]
+        assert cond["operator"] == "or"
+        debug = resp["meta"]["filterDebug"]
+        assert "request.resource.attr.owner" in debug
+
+    def test_plan_always_allowed(self, server):
+        resp = http_post(server, "/api/plan/resources", {
+            "requestId": "plan-2",
+            "actions": ["delete"],
+            "principal": {"id": "root", "roles": ["admin"]},
+            "resource": {"kind": "album"},
+        })
+        assert resp["filter"]["kind"] == "KIND_ALWAYS_ALLOWED"
+
+    def test_plan_always_denied(self, server):
+        resp = http_post(server, "/api/plan/resources", {
+            "requestId": "plan-3",
+            "actions": ["delete"],
+            "principal": {"id": "alice", "roles": ["user"]},
+            "resource": {"kind": "album"},
+        })
+        assert resp["filter"]["kind"] == "KIND_ALWAYS_DENIED"
+
+
+class TestGRPC:
+    def test_check_resources_grpc(self, server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+        from cerbos_tpu.server.convert import py_to_value
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}")
+        stub = channel.unary_unary(
+            "/cerbos.svc.v1.CerbosService/CheckResources",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_pb2.CheckResourcesResponse.FromString,
+        )
+        req = request_pb2.CheckResourcesRequest(request_id="grpc-1")
+        req.principal.id = "alice"
+        req.principal.roles.append("user")
+        entry = req.resources.add()
+        entry.actions.append("view")
+        entry.resource.kind = "album"
+        entry.resource.id = "a1"
+        entry.resource.attr["owner"].CopyFrom(py_to_value("alice"))
+        resp = stub(req, timeout=10)
+        assert resp.request_id == "grpc-1"
+        assert resp.results[0].actions["view"] == 1  # EFFECT_ALLOW
+        channel.close()
+
+    def test_server_info_grpc(self, server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}")
+        stub = channel.unary_unary(
+            "/cerbos.svc.v1.CerbosService/ServerInfo",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_pb2.ServerInfoResponse.FromString,
+        )
+        resp = stub(request_pb2.ServerInfoRequest(), timeout=10)
+        assert "cerbos-tpu" in resp.version
+        channel.close()
+
+
+class TestAdmin:
+    AUTH = {"Authorization": "Basic " + __import__("base64").b64encode(b"cerbos:cerbosAdmin").decode()}
+
+    def test_unauthenticated(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http_get(server, "/admin/policies")
+        assert e.value.code == 401
+
+    def test_list_policies(self, server):
+        resp = json.loads(http_get(server, "/admin/policies", auth=self.AUTH))
+        assert "resource.album.vdefault" in resp["policyIds"]
+
+    def test_reload_store(self, server):
+        assert json.loads(http_get(server, "/admin/store/reload", auth=self.AUTH)) == {}
+
+    def test_audit_log(self, server):
+        # ensure at least one decision exists, then wait for the async writer
+        http_post(server, "/api/check/resources", CHECK_BODY)
+        deadline = time.time() + 5
+        entries = []
+        while time.time() < deadline:
+            resp = json.loads(http_get(server, "/admin/auditlog/list/decision_logs", auth=self.AUTH))
+            entries = resp["entries"]
+            if entries:
+                break
+            time.sleep(0.1)
+        assert entries, "no decision log entries recorded"
+        assert entries[0]["kind"] == "decision"

@@ -1,0 +1,260 @@
+"""Storage drivers, reload pipeline, schema validation, auxdata, verify framework."""
+
+import base64
+import json
+import os
+import time
+
+import pytest
+import yaml
+
+from cerbos_tpu.auxdata import AuxDataManager, JWTError, KeySet
+from cerbos_tpu.engine import CheckInput, Engine, Principal, Resource
+from cerbos_tpu.ruletable.manager import RuleTableManager
+from cerbos_tpu.schema import SchemaManager
+from cerbos_tpu.storage import DiskStore, OverlayStore, SqliteStore
+from cerbos_tpu.verify.runner import discover_and_run
+
+POLICY_A = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+"""
+
+POLICY_B = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  rules:
+    - actions: ["view", "edit"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+"""
+
+
+def write(p, name, content):
+    (p / name).write_text(content)
+
+
+class TestDiskStore:
+    def test_load_and_events(self, tmp_path):
+        write(tmp_path, "a.yaml", POLICY_A)
+        store = DiskStore(str(tmp_path))
+        assert len(store.get_all()) == 1
+
+        events = []
+        store.subscribe(lambda evs: events.extend(evs))
+        time.sleep(0.02)
+        write(tmp_path, "a.yaml", POLICY_B)
+        os.utime(tmp_path / "a.yaml", (time.time() + 5, time.time() + 5))
+        store.check_for_changes()
+        assert events and events[0].kind == "ADD_OR_UPDATE"
+
+        (tmp_path / "a.yaml").unlink()
+        store.check_for_changes()
+        assert events[-1].kind == "DELETE"
+        store.close()
+
+    def test_reload_pipeline(self, tmp_path):
+        write(tmp_path, "a.yaml", POLICY_A)
+        store = DiskStore(str(tmp_path))
+        mgr = RuleTableManager(store)
+        eng = Engine(mgr.rule_table)
+
+        def check():
+            return eng.check([CheckInput(principal=Principal(id="u", roles=["user"]), resource=Resource(kind="doc", id="d"), actions=["edit"])])[0]
+
+        # manager swaps tables; engine follows via on_swap
+        mgr.on_swap = lambda rt: setattr(eng, "rule_table", rt)
+        assert check().actions["edit"].effect == "EFFECT_DENY"
+        write(tmp_path, "a.yaml", POLICY_B)
+        os.utime(tmp_path / "a.yaml", (time.time() + 5, time.time() + 5))
+        store.check_for_changes()
+        assert check().actions["edit"].effect == "EFFECT_ALLOW"
+        store.close()
+
+    def test_bad_policy_keeps_last_state(self, tmp_path):
+        write(tmp_path, "a.yaml", POLICY_A)
+        store = DiskStore(str(tmp_path))
+        mgr = RuleTableManager(store)
+        before = mgr.rule_table
+        write(tmp_path, "b.yaml", "apiVersion: api.cerbos.dev/v1\nresourcePolicy:\n  resource: [broken\n")
+        os.utime(tmp_path / "b.yaml", (time.time() + 5, time.time() + 5))
+        store.check_for_changes()
+        # invalid file is ignored; table still serves
+        assert mgr.rule_table is not None
+        store.close()
+
+
+class TestSqliteStore:
+    def test_crud_and_events(self):
+        store = SqliteStore(":memory:")
+        events = []
+        store.subscribe(lambda evs: events.extend(evs))
+        fqns = store.add_or_update([POLICY_A])
+        assert fqns == ["cerbos.resource.doc.vdefault"]
+        assert len(store.get_all()) == 1
+        assert store.get_raw(fqns[0]) is not None
+
+        store.set_disabled(fqns, True)
+        assert store.get_all() == []
+        store.set_disabled(fqns, False)
+        assert len(store.get_all()) == 1
+
+        store.add_schema("doc.json", b'{"type": "object"}')
+        assert store.get_schema("doc.json") == b'{"type": "object"}'
+        assert store.list_schema_ids() == ["doc.json"]
+        assert store.delete_schema("doc.json")
+
+        store.delete(fqns)
+        assert store.get_all() == []
+        assert any(e.kind == "DELETE" for e in events)
+        store.close()
+
+
+class TestOverlay:
+    def test_failover(self, tmp_path):
+        base_dir, fb_dir = tmp_path / "base", tmp_path / "fb"
+        base_dir.mkdir(), fb_dir.mkdir()
+        write(base_dir, "a.yaml", POLICY_A)
+        write(fb_dir, "a.yaml", POLICY_B)
+        base, fb = DiskStore(str(base_dir)), DiskStore(str(fb_dir))
+        ov = OverlayStore(base, fb, failure_threshold=1, cooldown_s=60)
+        assert len(ov.get_all()) == 1
+
+        def boom():
+            raise RuntimeError("base down")
+
+        base.get_all = boom  # type: ignore[assignment]
+        # first failure trips the breaker and falls back
+        pols = ov.get_all()
+        assert pols[0].resource_policy.rules[0].actions == ["view", "edit"]
+        ov.close()
+
+
+class TestSchemaValidation:
+    SCHEMA = {"type": "object", "properties": {"owner": {"type": "string"}}, "required": ["owner"]}
+
+    def make(self, tmp_path, enforcement):
+        write(tmp_path, "doc.yaml", """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  schemas:
+    resourceSchema:
+      ref: cerbos:///doc.json
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+""")
+        schemas_dir = tmp_path / "_schemas"
+        schemas_dir.mkdir()
+        (schemas_dir / "doc.json").write_text(json.dumps(self.SCHEMA))
+        store = DiskStore(str(tmp_path))
+        mgr = RuleTableManager(store)
+        schema_mgr = SchemaManager(store, enforcement=enforcement)
+        return Engine(mgr.rule_table, schema_mgr=schema_mgr), store
+
+    def test_warn_allows_with_errors(self, tmp_path):
+        eng, store = self.make(tmp_path, "warn")
+        out = eng.check([CheckInput(principal=Principal(id="u", roles=["user"]), resource=Resource(kind="doc", id="d", attr={}), actions=["view"])])[0]
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        assert out.validation_errors and out.validation_errors[0].source == "SOURCE_RESOURCE"
+        store.close()
+
+    def test_reject_denies(self, tmp_path):
+        eng, store = self.make(tmp_path, "reject")
+        out = eng.check([CheckInput(principal=Principal(id="u", roles=["user"]), resource=Resource(kind="doc", id="d", attr={}), actions=["view"])])[0]
+        assert out.actions["view"].effect == "EFFECT_DENY"
+        ok = eng.check([CheckInput(principal=Principal(id="u", roles=["user"]), resource=Resource(kind="doc", id="d", attr={"owner": "u"}), actions=["view"])])[0]
+        assert ok.actions["view"].effect == "EFFECT_ALLOW"
+        store.close()
+
+
+class TestAuxData:
+    def test_hmac_jwt_roundtrip(self):
+        import hashlib
+        import hmac as hmac_mod
+
+        secret = b"supersecretkey"
+        header = base64.urlsafe_b64encode(json.dumps({"alg": "HS256", "typ": "JWT"}).encode()).rstrip(b"=")
+        payload = base64.urlsafe_b64encode(
+            json.dumps({"iss": "test", "aud": ["cerbos-jwt-tests"], "exp": time.time() + 3600}).encode()
+        ).rstrip(b"=")
+        sig = base64.urlsafe_b64encode(
+            hmac_mod.new(secret, header + b"." + payload, hashlib.sha256).digest()
+        ).rstrip(b"=")
+        token = b".".join([header, payload, sig]).decode()
+
+        mgr = AuxDataManager([KeySet(id="default", keys=[("hmac", secret)])])
+        aux = mgr.extract(token)
+        assert aux.jwt["iss"] == "test"
+
+        with pytest.raises(JWTError):
+            mgr.extract(token[:-2] + "xx")
+
+    def test_expired_jwt(self):
+        secret = b"k"
+        import hashlib
+        import hmac as hmac_mod
+
+        header = base64.urlsafe_b64encode(json.dumps({"alg": "HS256"}).encode()).rstrip(b"=")
+        payload = base64.urlsafe_b64encode(json.dumps({"exp": time.time() - 10}).encode()).rstrip(b"=")
+        sig = base64.urlsafe_b64encode(hmac_mod.new(secret, header + b"." + payload, hashlib.sha256).digest()).rstrip(b"=")
+        token = b".".join([header, payload, sig]).decode()
+        mgr = AuxDataManager([KeySet(id="default", keys=[("hmac", secret)])])
+        with pytest.raises(JWTError):
+            mgr.extract(token)
+
+
+class TestVerifyFramework:
+    def test_suite_run(self, tmp_path):
+        write(tmp_path, "doc.yaml", POLICY_B)
+        testdata = tmp_path / "testdata"
+        testdata.mkdir()
+        (testdata / "principals.yaml").write_text(yaml.safe_dump({
+            "principals": {"u1": {"id": "u1", "roles": ["user"]}, "ghost": {"id": "g", "roles": ["nobody"]}}
+        }))
+        (testdata / "resources.yaml").write_text(yaml.safe_dump({
+            "resources": {"d1": {"kind": "doc", "id": "d1"}}
+        }))
+        write(tmp_path, "doc_test.yaml", yaml.safe_dump({
+            "name": "DocSuite",
+            "tests": [{
+                "name": "user access",
+                "input": {"principals": ["u1", "ghost"], "resources": ["d1"], "actions": ["view", "edit", "delete"]},
+                "expected": [
+                    {"principal": "u1", "resource": "d1",
+                     "actions": {"view": "EFFECT_ALLOW", "edit": "EFFECT_ALLOW", "delete": "EFFECT_DENY"}},
+                ],
+            }],
+        }))
+        results = discover_and_run(str(tmp_path))
+        assert results is not None
+        assert not results.failed
+        assert len(results.results) == 2  # 2 principals x 1 resource
+
+    def test_failing_expectation(self, tmp_path):
+        write(tmp_path, "doc.yaml", POLICY_A)
+        write(tmp_path, "doc_test.yaml", yaml.safe_dump({
+            "name": "Failing",
+            "tests": [{
+                "name": "wrong expectation",
+                "input": {"principals": ["u1"], "resources": ["d1"], "actions": ["view"]},
+                "expected": [{"principal": "u1", "resource": "d1", "actions": {"view": "EFFECT_DENY"}}],
+            }],
+            "principals": {"u1": {"id": "u1", "roles": ["user"]}},
+            "resources": {"d1": {"kind": "doc", "id": "d1"}},
+        }))
+        results = discover_and_run(str(tmp_path))
+        assert results.failed
+        assert "expected EFFECT_DENY, got EFFECT_ALLOW" in results.results[0].failures[0]
+        assert "<testsuites>" in results.to_junit() or "testsuite" in results.to_junit()
