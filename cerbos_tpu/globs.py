@@ -107,14 +107,37 @@ def compile_glob(pat: str) -> re.Pattern | None:
     if pat == "*":
         pat = "**"
     try:
-        return re.compile("(?s)^" + _translate(pat) + "$")
+        # \Z (not $): '$' would also match before a trailing newline,
+        # diverging from exact-match glob semantics
+        return re.compile("(?s)^" + _translate(pat) + r"\Z")
     except re.error:
         return None
 
 
-def matches_glob(pat: str, val: str) -> bool:
+def _py_matches_glob(pat: str, val: str) -> bool:
     rx = compile_glob(pat)
     return bool(rx and rx.match(val))
+
+
+def _native_matcher():
+    from . import native
+
+    mod = native.get()
+    return mod.glob_match if mod is not None else None
+
+
+_match_impl = None
+
+
+def matches_glob(pat: str, val: str) -> bool:
+    global _match_impl
+    if _match_impl is None:
+        _match_impl = _native_matcher() or _py_matches_glob
+    if _match_impl is not _py_matches_glob and not (pat.isascii() and val.isascii()):
+        # the native matcher is byte-oriented; '?' and classes must consume
+        # one *character*, so non-ASCII inputs take the Python path
+        return _py_matches_glob(pat, val)
+    return _match_impl(pat, val)
 
 
 def is_glob(pat: str) -> bool:

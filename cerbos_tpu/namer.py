@@ -30,6 +30,10 @@ _OLD_NAME_PATTERN = re.compile(r"^[A-Za-z][\w@.\-/]*(:[A-Za-z][\w@.\-/]*)*$")
 _INVALID_IDENT_CHARS = re.compile(r"[^\w.]+")
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=16384)
 def sanitize(v: str) -> str:
     if _OLD_NAME_PATTERN.match(v):
         return _INVALID_IDENT_CHARS.sub("_", v)

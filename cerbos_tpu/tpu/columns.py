@@ -24,17 +24,29 @@ TAG_OTHER = 5
 
 
 def double_key(v: float) -> int:
-    """Map a double to a uint64 preserving total order (NaN excluded)."""
-    (bits,) = struct.unpack("<Q", struct.pack("<d", float(v)))
+    """Map a double to a uint64 preserving order (NaN excluded).
+
+    -0.0 normalizes to 0.0 first: CEL compares them equal, so they must
+    encode to the same key.
+    """
+    v = float(v)
+    if v == 0.0:
+        v = 0.0
+    (bits,) = struct.unpack("<Q", struct.pack("<d", v))
     if bits & (1 << 63):
         return (~bits) & ((1 << 64) - 1)
     return bits | (1 << 63)
 
 
 def split_key(key: int) -> tuple[int, int]:
-    """uint64 sortable key → (hi, lo) int32 pair (two's complement)."""
-    hi = (key >> 32) & 0xFFFFFFFF
-    lo = key & 0xFFFFFFFF
+    """uint64 sortable key → sign-biased (hi, lo) int32 pair.
+
+    Each 32-bit word is XORed with 0x80000000 before reinterpreting as
+    signed, so plain *signed* int32 comparison on device preserves the
+    unsigned key order (device kernels compare hi then lo as signed ints).
+    """
+    hi = ((key >> 32) & 0xFFFFFFFF) ^ 0x80000000
+    lo = (key & 0xFFFFFFFF) ^ 0x80000000
     if hi >= 1 << 31:
         hi -= 1 << 32
     if lo >= 1 << 31:

@@ -274,12 +274,16 @@ class TpuEvaluator:
         self.use_jax = use_jax
         self.stats = {"device_inputs": 0, "oracle_inputs": 0, "trivial_inputs": 0}
         self._jit_cache: dict = {}
+        self._dr_table_cache: dict = {}
+        self._roles_cache: dict = {}
 
     def refresh(self) -> None:
         """Re-lower after a policy reload (storage event hook)."""
         self.lowered.refresh()
         self.packer.invalidate()
         self._jit_cache.clear()
+        self._dr_table_cache.clear()
+        self._roles_cache.clear()
 
     def check(self, inputs: list[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
         params = params or T.EvalParams()
@@ -382,6 +386,7 @@ class TpuEvaluator:
             ks = list(range(min(len(plan.roles), batch.K)))
             passes.append((PT_RESOURCE, ks))
 
+        emit_outputs = self.lowered.has_outputs
         for pt, ks in passes:
             chain = plan.principal_scopes if pt == PT_PRINCIPAL else plan.resource_scopes
             for k in ks:
@@ -391,6 +396,10 @@ class TpuEvaluator:
                 if pt == PT_RESOURCE:
                     for d in range(0, max_depth + 1):
                         processed_scopes.add(d)
+                if not emit_outputs:
+                    if code == CODE_ALLOW:
+                        break
+                    continue
                 # outputs from visited candidates
                 entries = batch.cand_entries[ci][k] if k < len(batch.cand_entries[ci]) else []
                 wj = int(win_j[ci, k, pt]) if code == CODE_DENY else -1
@@ -438,36 +447,50 @@ class TpuEvaluator:
             fqn = namer.role_policy_fqn(meta.name, meta.version, b.scope)
         return f"{namer.policy_key_from_fqn(fqn)}#{b.name}"
 
+    def _dr_table(self, kind: str, version: str, scope: str):
+        """Cached per-(kind, version, scope): [(name, parent_roles, cond_id, dr)]."""
+        key = (kind, version, scope)
+        hit = self._dr_table_cache.get(key)
+        if hit is None:
+            drs = self.rule_table.get_derived_roles(namer.resource_policy_fqn(kind, version, scope))
+            hit = []
+            if drs:
+                for name, dr in drs.items():
+                    cid = self.lowered.dr_cond_ids.get(id(dr), -1)
+                    device_ok = cid >= 0 and self.lowered.compiler.kernels[cid].emit is not None
+                    hit.append((name, dr.parent_roles, cid if device_ok else -1, dr))
+            self._dr_table_cache[key] = hit
+        return hit
+
     def _effective_derived_roles(self, plan, bi, depths, params, eval_ctx, sat_cond) -> list[str]:
         inp = plan.input
         resource_version = T.effective_version(inp.resource.policy_version, params)
         rt = self.rule_table
-        all_roles = set(rt.idx.add_parent_roles(
-            [T.effective_scope(inp.resource.scope, params)], list(inp.principal.roles)
-        ))
+        roles_key = (T.effective_scope(inp.resource.scope, params), tuple(inp.principal.roles))
+        all_roles = self._roles_cache.get(roles_key)
+        if all_roles is None:
+            all_roles = set(rt.idx.add_parent_roles([roles_key[0]], list(inp.principal.roles)))
+            if len(self._roles_cache) > 65536:
+                self._roles_cache.clear()
+            self._roles_cache[roles_key] = all_roles
         edr: set[str] = set()
         sat_b = sat_cond[bi]
         for d in depths:
             if d >= len(plan.resource_scopes):
                 continue
-            scope = plan.resource_scopes[d]
-            drs = rt.get_derived_roles(namer.resource_policy_fqn(inp.resource.kind, resource_version, scope))
-            if not drs:
-                continue
-            for name, dr in drs.items():
-                if name in edr or not (dr.parent_roles & all_roles):
+            table = self._dr_table(inp.resource.kind, resource_version, plan.resource_scopes[d])
+            for name, parent_roles, cid, dr in table:
+                if name in edr or not (parent_roles & all_roles):
                     continue
                 if dr.condition is None:
                     edr.add(name)
-                    continue
-                cid = self.lowered.dr_cond_ids.get(id(dr), -1)
-                if cid >= 0 and self.lowered.compiler.kernels[cid].emit is not None:
+                elif cid >= 0:
                     if bool(sat_b[cid]):
                         edr.add(name)
-                    continue
-                # condition outside device coverage: host-evaluate
-                ec = eval_ctx()
-                variables = ec.evaluate_variables(dr.params.constants, dr.params.ordered_variables)
-                if ec.satisfies_condition(dr.condition, dr.params.constants, variables):
-                    edr.add(name)
+                else:
+                    # condition outside device coverage: host-evaluate
+                    ec = eval_ctx()
+                    variables = ec.evaluate_variables(dr.params.constants, dr.params.ordered_variables)
+                    if ec.satisfies_condition(dr.condition, dr.params.constants, variables):
+                        edr.add(name)
         return sorted(edr)

@@ -62,6 +62,7 @@ class LoweredTable:
     paths: set[tuple[str, ...]] = field(default_factory=set)
     fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
     dr_cond_ids: dict[int, int] = field(default_factory=dict)  # id(CompiledDerivedRole) -> cond id
+    has_outputs: bool = False
 
     def refresh(self) -> None:
         """(Re)lower all rows currently in the index. Called at build and on
@@ -69,6 +70,7 @@ class LoweredTable:
         self.rows.clear()
         for row in self.table.idx.get_all_rows():
             self.rows[row.id] = self._lower_row(row)
+        self.has_outputs = any(lr.row.emit_output is not None for lr in self.rows.values())
         # derived-role conditions get kernels too, so effectiveDerivedRoles
         # can be read off the device sat matrix instead of host CEL re-eval
         self.dr_cond_ids = {}

@@ -106,6 +106,8 @@ class Packer:
         self._scope_cache: dict[tuple, tuple] = {}
         self._exists_cache: dict[tuple, bool] = {}
         self._cell_cache: dict[tuple, Optional[tuple]] = {}
+        self._accessors: dict[tuple, Any] = {}
+        self._encode_cache: dict[Any, tuple] = {}
 
     def invalidate(self) -> None:
         self._cand_cache.clear()
@@ -113,6 +115,8 @@ class Packer:
         self._scope_cache.clear()
         self._exists_cache.clear()
         self._cell_cache.clear()
+        self._accessors.clear()
+        self._encode_cache.clear()
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
         key = (kind, scope, name, version, lenient)
@@ -356,29 +360,64 @@ class Packer:
         BA, D = len(ba_input), self.D
         K = min(_pow2(K_max), self.K)
         J = min(_pow2(J_max), self.J)
-        cand_cond = np.full((BA, K, J), -1, dtype=np.int32)
-        cand_drcond = np.full((BA, K, J), -1, dtype=np.int32)
-        cand_effect = np.zeros((BA, K, J), dtype=np.int8)
-        cand_pt = np.zeros((BA, K, J), dtype=np.int8)
-        cand_depth = np.full((BA, K, J), -1, dtype=np.int8)
-        cand_valid = np.zeros((BA, K, J), dtype=bool)
+        # cells repeat a small number of distinct blocks, so pad each unique
+        # block to (K, J) once and assemble the batch with one fancy-index
+        # gather instead of per-cell copies
+        unique_padded: dict[int, int] = {}
+        padded_arrays: list[tuple] = []
+        block_ids = np.empty(BA, dtype=np.int32)
         cand_entries: list[list[list[Optional[CandEntry]]]] = []
         for ci, blk in enumerate(blocks):
-            kk, jj = blk[0].shape
-            cand_cond[ci, :kk, :jj] = blk[0]
-            cand_drcond[ci, :kk, :jj] = blk[1]
-            cand_effect[ci, :kk, :jj] = blk[2]
-            cand_pt[ci, :kk, :jj] = blk[3]
-            cand_depth[ci, :kk, :jj] = blk[4]
-            cand_valid[ci, :kk, :jj] = blk[5]
-            cand_entries.append(blk[6])
+            key = id(blk)
+            uid = unique_padded.get(key)
+            if uid is None:
+                uid = len(padded_arrays)
+                unique_padded[key] = uid
+                kk, jj = blk[0].shape
+                pc = np.full((K, J), -1, dtype=np.int32)
+                pd = np.full((K, J), -1, dtype=np.int32)
+                pe = np.zeros((K, J), dtype=np.int8)
+                pp = np.zeros((K, J), dtype=np.int8)
+                pdep = np.full((K, J), -1, dtype=np.int8)
+                pv = np.zeros((K, J), dtype=bool)
+                pc[:kk, :jj] = blk[0]
+                pd[:kk, :jj] = blk[1]
+                pe[:kk, :jj] = blk[2]
+                pp[:kk, :jj] = blk[3]
+                pdep[:kk, :jj] = blk[4]
+                pv[:kk, :jj] = blk[5]
+                padded_arrays.append((pc, pd, pe, pp, pdep, pv))
+            block_ids[ci] = uid
+            cand_entries.append(blocks[ci][6])
+        if padded_arrays:
+            stacked = [np.stack([p[i] for p in padded_arrays]) for i in range(6)]
+            cand_cond = stacked[0][block_ids]
+            cand_drcond = stacked[1][block_ids]
+            cand_effect = stacked[2][block_ids]
+            cand_pt = stacked[3][block_ids]
+            cand_depth = stacked[4][block_ids]
+            cand_valid = stacked[5][block_ids]
+        else:
+            cand_cond = np.full((0, K, J), -1, dtype=np.int32)
+            cand_drcond = np.full((0, K, J), -1, dtype=np.int32)
+            cand_effect = np.zeros((0, K, J), dtype=np.int8)
+            cand_pt = np.zeros((0, K, J), dtype=np.int8)
+            cand_depth = np.full((0, K, J), -1, dtype=np.int8)
+            cand_valid = np.zeros((0, K, J), dtype=bool)
 
-        # scope permissions per input [B, 2, D]
+        # scope permissions per input [B, 2, D] (cached per chain pair)
         scope_sp = np.zeros((len(plans), 2, D), dtype=np.int8)
+        sp_cache: dict[tuple, np.ndarray] = {}
         for bi, plan in enumerate(plans):
-            for pi, chain in ((PT_PRINCIPAL, plan.principal_scopes), (PT_RESOURCE, plan.resource_scopes)):
-                for d, scope in enumerate(chain[:D]):
-                    scope_sp[bi, pi, d] = sp_code(rt.get_scope_scope_permissions(scope))
+            key = (tuple(plan.principal_scopes), tuple(plan.resource_scopes))
+            row = sp_cache.get(key)
+            if row is None:
+                row = np.zeros((2, D), dtype=np.int8)
+                for pi, chain in ((PT_PRINCIPAL, plan.principal_scopes), (PT_RESOURCE, plan.resource_scopes)):
+                    for d, scope in enumerate(chain[:D]):
+                        row[pi, d] = sp_code(rt.get_scope_scope_permissions(scope))
+                sp_cache[key] = row
+            scope_sp[bi] = row
 
         columns = self._encode_columns(plans, params)
         return PackedBatch(
@@ -423,36 +462,86 @@ class Packer:
             "auxData": jwt,
         }
 
+    def _path_accessor(self, path: tuple[str, ...]):
+        """Compile a fast value resolver for a column path. The overwhelmingly
+        common shapes (principal/resource attr leaves and top-level fields)
+        skip the generic dict walk."""
+        fn = self._accessors.get(path)
+        if fn is not None:
+            return fn
+        _MISSING = _MISSING_SENTINEL
+        if len(path) == 3 and path[0] in ("principal", "resource") and path[1] == "attr":
+            root, leaf = path[0], path[2]
+
+            def fn(inp, root=root, leaf=leaf):  # type: ignore[misc]
+                return getattr(inp, root).attr.get(leaf, _MISSING)
+
+        elif len(path) == 2 and path[0] in ("principal", "resource"):
+            root, leaf = path[0], path[1]
+            if leaf == "scope":
+                scope_value = namer.scope_value
+
+                def fn(inp, root=root, scope_value=scope_value):  # type: ignore[misc]
+                    return scope_value(getattr(inp, root).scope)
+
+            else:
+                attr_name = {"policyVersion": "policy_version"}.get(leaf, leaf)
+
+                def fn(inp, root=root, attr_name=attr_name):  # type: ignore[misc]
+                    return getattr(getattr(inp, root), attr_name, _MISSING)
+
+        else:
+
+            def fn(inp):  # type: ignore[misc]
+                view = self._input_view(inp)
+                return _walk_view(view, path)
+
+        self._accessors[path] = fn
+        return fn
+
     def _encode_columns(self, plans: list[InputPlan], params: T.EvalParams) -> ColumnBatch:
+        from .condcompile import TAG_ERR
+
         B = len(plans)
         cb = ColumnBatch(size=B)
         interner = self.lt.interner
         paths = sorted(self.lt.paths)
-        arrays = {
-            p: (
-                np.zeros(B, dtype=np.int8),
-                np.zeros(B, dtype=np.int32),
-                np.zeros(B, dtype=np.int32),
-                np.zeros(B, dtype=np.int32),
-                np.zeros(B, dtype=bool),
-            )
-            for p in paths
-        }
-        from .condcompile import TAG_ERR
-
-        for bi, plan in enumerate(plans):
-            if plan.trivial or plan.oracle:
-                continue
-            view = self._input_view(plan.input)
-            for p in paths:
-                tag, hi, lo, sid, is_nan = self._encode_path(view, p, interner)
-                t, h, l, s, nn = arrays[p]
+        encode_cache = self._encode_cache
+        for p in paths:
+            t = np.zeros(B, dtype=np.int8)
+            h = np.zeros(B, dtype=np.int32)
+            l = np.zeros(B, dtype=np.int32)
+            s = np.zeros(B, dtype=np.int32)
+            nn = np.zeros(B, dtype=bool)
+            accessor = self._path_accessor(p)
+            trig = self.lt.fallback_tags.get(p)
+            for bi, plan in enumerate(plans):
+                if plan.trivial or plan.oracle:
+                    continue
+                v = accessor(plan.input)
+                if v is _MISSING_SENTINEL:
+                    continue  # TAG_MISSING zeros already in place
+                if v is _ERR_SENTINEL:
+                    t[bi] = TAG_ERR
+                    continue
+                # cache encodings per concrete value; key includes the type so
+                # True / 1.0 / 1 don't collide as dict keys
+                try:
+                    ck = (type(v), v)
+                    enc = encode_cache.get(ck)
+                except TypeError:
+                    tag, hi, lo, sid, is_nan = encode_value(v, True, interner)
+                else:
+                    if enc is None:
+                        tag, hi, lo, sid, is_nan = encode_value(v, True, interner)
+                        if len(encode_cache) > 65536:
+                            encode_cache.clear()
+                        encode_cache[ck] = (tag, hi, lo, sid, is_nan)
+                    else:
+                        tag, hi, lo, sid, is_nan = enc
                 t[bi], h[bi], l[bi], s[bi], nn[bi] = tag, hi, lo, sid, is_nan
-                trig = self.lt.fallback_tags.get(p)
                 if trig and tag in trig:
                     plan.oracle = True
-        for p in paths:
-            t, h, l, s, nn = arrays[p]
             cb.tags[p], cb.his[p], cb.los[p], cb.sids[p], cb.nans[p] = t, h, l, s, nn
 
         # predicate columns
@@ -471,21 +560,6 @@ class Packer:
                 cb.pred_errs[spec.pred_id] = errs
         return cb
 
-    def _encode_path(self, view: dict, path: tuple[str, ...], interner):
-        from .condcompile import TAG_ERR
-
-        cur: Any = view
-        for i, seg in enumerate(path):
-            if isinstance(cur, dict):
-                if seg not in cur:
-                    # leaf missing vs intermediate missing (has() semantics)
-                    if i == len(path) - 1:
-                        return (0, 0, 0, 0, False)  # TAG_MISSING
-                    return (TAG_ERR, 0, 0, 0, False)
-                cur = cur[seg]
-            else:
-                return (TAG_ERR, 0, 0, 0, False)
-        return encode_value(cur, True, interner)
 
     def _eval_pred(self, spec, plan: InputPlan, params: T.EvalParams) -> tuple[bool, bool]:
         view = self._input_view(plan.input)
@@ -511,6 +585,31 @@ class Packer:
         if cache_key is not None:
             self._pred_cache[cache_key] = result
         return result
+
+
+class _Sentinel:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+_MISSING_SENTINEL = _Sentinel("missing")
+_ERR_SENTINEL = _Sentinel("err")
+
+
+def _walk_view(view: dict, path: tuple[str, ...]):
+    """Generic path walk distinguishing leaf-missing from intermediate
+    failures (has() semantics — see condcompile TAG_ERR)."""
+    cur: Any = view
+    for i, seg in enumerate(path):
+        if isinstance(cur, dict):
+            if seg not in cur:
+                return _MISSING_SENTINEL if i == len(path) - 1 else _ERR_SENTINEL
+            cur = cur[seg]
+        else:
+            return _ERR_SENTINEL
+    return cur
 
 
 def _pow2(n: int) -> int:

@@ -1,0 +1,283 @@
+// cerbos_native: CPython extension for host-side hot paths.
+//
+// Native runtime pieces around the JAX/XLA compute path (the reference has no
+// native code to mirror — SURVEY.md notes the obligation attaches to the new
+// evaluator; these are the host analogues of internal/util/globs_common.go and
+// the index's per-dimension matchers):
+//
+//   glob_match(pattern, value)        gobwas-style glob with ':' separator
+//   glob_match_many(patterns, value)  indices of matching patterns
+//   encode_double_keys(float64 buf)   order-preserving (hi, lo) int32 pairs
+//
+// Built with plain g++ (no pybind11 in the image); loaded by
+// cerbos_tpu/native.py with a pure-Python fallback.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kSeparator = ':';
+
+// Recursive glob matcher. Pattern syntax (gobwas/glob with separators={':'}):
+//   *      any run of non-separator chars
+//   **     any run of any chars
+//   ?      one non-separator char
+//   [ab] / [!ab] / [a-z]   char class (single char, separator-agnostic)
+//   {a,b}  alternates (may contain nested patterns)
+//   \x     literal x
+// A bare "*" pattern is promoted to "**" by the caller (fixGlob behavior).
+bool MatchGlob(const char* p, size_t plen, const char* v, size_t vlen, int depth);
+
+// Matches a brace alternate set starting at p (just past '{'). Returns the
+// offset of the char after the closing '}' via out_end, and fills alts with
+// (start, len) pairs of each alternative.
+bool SplitAlternates(const char* p, size_t plen, size_t* out_end,
+                     std::vector<std::pair<size_t, size_t>>* alts) {
+  size_t depth = 1;
+  bool in_class = false;  // commas inside [...] are not separators
+  size_t start = 0;
+  for (size_t i = 0; i < plen; i++) {
+    char c = p[i];
+    if (c == '\\' && i + 1 < plen) {
+      i++;
+      continue;
+    }
+    if (in_class) {
+      if (c == ']') in_class = false;
+      continue;
+    }
+    if (c == '[') {
+      in_class = true;
+    } else if (c == '{') {
+      depth++;
+    } else if (c == '}') {
+      depth--;
+      if (depth == 0) {
+        alts->emplace_back(start, i - start);
+        *out_end = i + 1;
+        return true;
+      }
+    } else if (c == ',' && depth == 1) {
+      alts->emplace_back(start, i - start);
+      start = i + 1;
+    }
+  }
+  return false;  // unterminated
+}
+
+bool MatchClass(const char* body, size_t blen, bool negate, char c) {
+  bool hit = false;
+  for (size_t i = 0; i < blen; i++) {
+    if (i + 2 < blen && body[i + 1] == '-') {
+      if (c >= body[i] && c <= body[i + 2]) hit = true;
+      i += 2;
+    } else if (body[i] == c) {
+      hit = true;
+    }
+  }
+  return negate ? !hit : hit;
+}
+
+bool MatchGlob(const char* p, size_t plen, const char* v, size_t vlen, int depth) {
+  if (depth > 64) return false;  // pathological nesting guard
+  size_t pi = 0, vi = 0;
+  while (pi < plen) {
+    char pc = p[pi];
+    if (pc == '*') {
+      bool super = (pi + 1 < plen && p[pi + 1] == '*');
+      size_t rest = pi + (super ? 2 : 1);
+      // try all split points (greedy backtracking)
+      for (size_t skip = 0; vi + skip <= vlen; skip++) {
+        if (!super && skip > 0 && v[vi + skip - 1] == kSeparator) break;
+        if (MatchGlob(p + rest, plen - rest, v + vi + skip, vlen - vi - skip, depth + 1)) {
+          return true;
+        }
+      }
+      return false;
+    }
+    if (pc == '{') {
+      std::vector<std::pair<size_t, size_t>> alts;
+      size_t end = 0;
+      if (!SplitAlternates(p + pi + 1, plen - pi - 1, &end, &alts)) {
+        // unterminated: literal '{'
+        if (vi >= vlen || v[vi] != '{') return false;
+        pi++;
+        vi++;
+        continue;
+      }
+      size_t after = pi + 1 + end;
+      for (const auto& alt : alts) {
+        // splice alternative + rest of pattern
+        std::string combined(p + pi + 1 + alt.first, alt.second);
+        combined.append(p + after, plen - after);
+        if (MatchGlob(combined.data(), combined.size(), v + vi, vlen - vi, depth + 1)) {
+          return true;
+        }
+      }
+      return false;
+    }
+    if (vi >= vlen) return false;
+    if (pc == '?') {
+      if (v[vi] == kSeparator) return false;
+      pi++;
+      vi++;
+      continue;
+    }
+    if (pc == '[') {
+      size_t j = pi + 1;
+      bool negate = (j < plen && p[j] == '!');
+      if (negate) j++;
+      size_t k = j;
+      if (k < plen && p[k] == ']') k++;  // literal ']' first member
+      while (k < plen && p[k] != ']') k++;
+      if (k >= plen) {  // unterminated: literal '['
+        if (v[vi] != '[') return false;
+        pi++;
+        vi++;
+        continue;
+      }
+      if (!MatchClass(p + j, k - j, negate, v[vi])) return false;
+      pi = k + 1;
+      vi++;
+      continue;
+    }
+    if (pc == '\\' && pi + 1 < plen) {
+      if (v[vi] != p[pi + 1]) return false;
+      pi += 2;
+      vi++;
+      continue;
+    }
+    if (v[vi] != pc) return false;
+    pi++;
+    vi++;
+  }
+  return vi == vlen;
+}
+
+bool MatchTop(const char* p, Py_ssize_t plen, const char* v, Py_ssize_t vlen) {
+  // fixGlob: bare "*" means "**"
+  if (plen == 1 && p[0] == '*') return true;
+  return MatchGlob(p, static_cast<size_t>(plen), v, static_cast<size_t>(vlen), 0);
+}
+
+PyObject* PyGlobMatch(PyObject*, PyObject* args) {
+  const char* pattern;
+  Py_ssize_t plen;
+  const char* value;
+  Py_ssize_t vlen;
+  if (!PyArg_ParseTuple(args, "s#s#", &pattern, &plen, &value, &vlen)) return nullptr;
+  if (MatchTop(pattern, plen, value, vlen)) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+PyObject* PyGlobMatchMany(PyObject*, PyObject* args) {
+  PyObject* patterns;
+  const char* value;
+  Py_ssize_t vlen;
+  if (!PyArg_ParseTuple(args, "Os#", &patterns, &value, &vlen)) return nullptr;
+  PyObject* seq = PySequence_Fast(patterns, "patterns must be a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject* out = PyList_New(0);
+  if (out == nullptr) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+    Py_ssize_t plen;
+    const char* pattern = PyUnicode_AsUTF8AndSize(item, &plen);
+    if (pattern == nullptr) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    if (MatchTop(pattern, plen, value, vlen)) {
+      PyObject* idx = PyLong_FromSsize_t(i);
+      PyList_Append(out, idx);
+      Py_DECREF(idx);
+    }
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
+// encode_double_keys(input_buffer_f64) -> (bytes_hi_i32, bytes_lo_i32, bytes_nan_u8)
+PyObject* PyEncodeDoubleKeys(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  if (buf.len % 8 != 0) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "buffer length must be a multiple of 8");
+    return nullptr;
+  }
+  Py_ssize_t n = buf.len / 8;
+  PyObject* hi_b = PyBytes_FromStringAndSize(nullptr, n * 4);
+  PyObject* lo_b = PyBytes_FromStringAndSize(nullptr, n * 4);
+  PyObject* nan_b = PyBytes_FromStringAndSize(nullptr, n);
+  if (!hi_b || !lo_b || !nan_b) {
+    Py_XDECREF(hi_b);
+    Py_XDECREF(lo_b);
+    Py_XDECREF(nan_b);
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  const uint64_t* in = static_cast<const uint64_t*>(buf.buf);
+  int32_t* hi = reinterpret_cast<int32_t*>(PyBytes_AS_STRING(hi_b));
+  int32_t* lo = reinterpret_cast<int32_t*>(PyBytes_AS_STRING(lo_b));
+  uint8_t* nan = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(nan_b));
+  for (Py_ssize_t i = 0; i < n; i++) {
+    uint64_t bits = in[i];
+    double d;
+    std::memcpy(&d, &bits, 8);
+    bool is_nan = d != d;
+    if (d == 0.0) {  // -0.0 == 0.0 in CEL: same key
+      d = 0.0;
+      std::memcpy(&bits, &d, 8);
+    }
+    uint64_t key;
+    if (bits & (1ULL << 63)) {
+      key = ~bits;
+    } else {
+      key = bits | (1ULL << 63);
+    }
+    // sign-bias each word so signed int32 comparison preserves key order
+    uint32_t h = static_cast<uint32_t>(key >> 32) ^ 0x80000000u;
+    uint32_t l = static_cast<uint32_t>(key & 0xFFFFFFFFULL) ^ 0x80000000u;
+    hi[i] = static_cast<int32_t>(h);
+    lo[i] = static_cast<int32_t>(l);
+    nan[i] = is_nan ? 1 : 0;
+  }
+  PyBuffer_Release(&buf);
+  PyObject* result = PyTuple_Pack(3, hi_b, lo_b, nan_b);
+  Py_DECREF(hi_b);
+  Py_DECREF(lo_b);
+  Py_DECREF(nan_b);
+  return result;
+}
+
+PyMethodDef kMethods[] = {
+    {"glob_match", PyGlobMatch, METH_VARARGS,
+     "glob_match(pattern, value) -> bool — gobwas-style glob with ':' separator"},
+    {"glob_match_many", PyGlobMatchMany, METH_VARARGS,
+     "glob_match_many(patterns, value) -> list[int] of matching indices"},
+    {"encode_double_keys", PyEncodeDoubleKeys, METH_VARARGS,
+     "encode_double_keys(f64 buffer) -> (hi_i32_bytes, lo_i32_bytes, nan_u8_bytes)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "cerbos_native",
+    "Native host-path helpers for cerbos_tpu", -1, kMethods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_cerbos_native(void) { return PyModule_Create(&kModule); }

@@ -1,0 +1,118 @@
+"""Native extension: build, load, differential vs pure Python."""
+
+import random
+import string
+import struct
+
+import pytest
+
+from cerbos_tpu import native
+from cerbos_tpu.globs import _py_matches_glob
+from cerbos_tpu.tpu.columns import double_key, split_key
+
+
+@pytest.fixture(scope="module")
+def mod():
+    m = native.get()
+    if m is None:
+        pytest.skip("native extension unavailable (no g++?)")
+    return m
+
+
+PATTERNS = [
+    "*", "**", "view", "view:*", "view:**", "*:public", "a?c", "[vV]iew",
+    "[!v]iew", "{view,edit}", "{view,edit}:*", "v[a-z]ew", "a\\*b", "",
+    "view:*:deep", "**:end", "{a,{b,c}}x", "[0-9]*",
+]
+VALUES = [
+    "view", "view:public", "view:public:extra", "edit:doc", "abc", "a:c",
+    "View", "a*b", "", "view:x:deep", "anything:at:end", "bx", "cx", "ax",
+    "9abc", "view:",
+]
+
+
+class TestGlobDifferential:
+    def test_matrix(self, mod):
+        for pat in PATTERNS:
+            for val in VALUES:
+                want = _py_matches_glob(pat, val)
+                got = mod.glob_match(pat, val)
+                assert got == want, f"pattern={pat!r} value={val!r}: native={got} python={want}"
+
+    def test_random_fuzz(self, mod):
+        rng = random.Random(99)
+        alphabet = "ab:*?[]{}\\-!" + string.ascii_lowercase[:4]
+        for _ in range(3000):
+            pat = "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 8)))
+            val = "".join(rng.choice("ab:cd") for _ in range(rng.randint(0, 8)))
+            want = _py_matches_glob(pat, val)
+            got = mod.glob_match(pat, val)
+            assert got == want, f"pattern={pat!r} value={val!r}: native={got} python={want}"
+
+    def test_match_many(self, mod):
+        idx = mod.glob_match_many(PATTERNS, "view:public")
+        want = [i for i, p in enumerate(PATTERNS) if _py_matches_glob(p, "view:public")]
+        assert idx == want
+
+
+class TestEncodeDoubleKeys:
+    def test_negative_zero_equals_zero(self, mod):
+        buf = struct.pack("<2d", 0.0, -0.0)
+        hi_b, lo_b, _ = mod.encode_double_keys(buf)
+        his = struct.unpack("<2i", hi_b)
+        los = struct.unpack("<2i", lo_b)
+        assert (his[0], los[0]) == (his[1], los[1])
+        assert split_key(double_key(0.0)) == split_key(double_key(-0.0))
+
+    def test_matches_python_encoding(self, mod):
+        values = [0.0, -0.0, 1.0, -1.0, 3.14, -2.5e300, 2.5e-300, float("inf"), float("-inf"), float("nan"), 42.0]
+        buf = struct.pack(f"<{len(values)}d", *values)
+        hi_b, lo_b, nan_b = mod.encode_double_keys(buf)
+        his = struct.unpack(f"<{len(values)}i", hi_b)
+        los = struct.unpack(f"<{len(values)}i", lo_b)
+        nans = list(nan_b)
+        for i, v in enumerate(values):
+            if v != v:
+                assert nans[i] == 1
+                continue
+            want_hi, want_lo = split_key(double_key(v))
+            assert (his[i], los[i]) == (want_hi, want_lo), f"value {v}"
+
+    def test_order_preserved_signed_compare(self, mod):
+        # the device compares (hi, lo) as SIGNED int32 pairs; the sign-biased
+        # encoding must make that ordering equal the double ordering
+        rng = random.Random(5)
+        values = sorted(
+            [rng.uniform(-1e6, 1e6) for _ in range(100)]
+            + [0.0, -0.0, 1e-308, -1e-308, 1e308, -1e308, 0.5, -0.5]
+        )
+        buf = struct.pack(f"<{len(values)}d", *values)
+        hi_b, lo_b, _ = mod.encode_double_keys(buf)
+        his = struct.unpack(f"<{len(values)}i", hi_b)
+        los = struct.unpack(f"<{len(values)}i", lo_b)
+        keys = list(zip(his, los))  # plain signed tuple comparison
+        assert keys == sorted(keys)
+        # and the python encoder agrees
+        for v, k in zip(values, keys):
+            assert split_key(double_key(v)) == k
+
+
+class TestReviewRegressions:
+    def test_comma_inside_class_in_alternates(self, mod):
+        # commas inside [...] are not alternate separators
+        assert mod.glob_match("{[a,b]x,c}", "ax") == _py_matches_glob("{[a,b]x,c}", "ax")
+        assert mod.glob_match("{[a,b]x,c}", "c") is True
+        assert mod.glob_match("{[a,b]x,c}", ",x") == _py_matches_glob("{[a,b]x,c}", ",x")
+
+    def test_non_ascii_routes_to_python(self):
+        from cerbos_tpu.globs import matches_glob
+
+        # '?' must consume one character, not one UTF-8 byte
+        assert matches_glob("u?x", "uéx") is True
+        assert matches_glob("é*", "était") is True
+
+    def test_trailing_newline_exact_match(self):
+        from cerbos_tpu.globs import matches_glob
+
+        assert not _py_matches_glob("a", "a\n")
+        assert not matches_glob("a", "a\n")

@@ -204,3 +204,39 @@ def test_fuzz_parity(use_jax):
     ev = assert_parity(rt, inputs, use_jax=use_jax)
     # most inputs should take the device path
     assert ev.stats["device_inputs"] >= 150, ev.stats
+
+
+NEGATIVE_NUM_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: ledger
+  version: default
+  rules:
+    - actions: ["post"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.balance > -100.5
+    - actions: ["audit"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.balance <= 0
+"""
+
+
+@pytest.mark.parametrize("use_jax", [False], ids=["numpy"])
+def test_negative_number_ordering_parity(use_jax):
+    # regression: sign-biased (hi, lo) key encoding — comparisons must be
+    # correct across the positive/negative double boundary
+    rt = table_for(NEGATIVE_NUM_POLICIES)
+    inputs = []
+    for i, bal in enumerate([-1e9, -101.0, -100.5, -100.49, -1.0, -0.0, 0.0, 0.5, 99.0, 1e9, -1e-300, 1e-300]):
+        inputs.append(CheckInput(
+            principal=Principal(id=f"u{i}", roles=["user"], attr={}),
+            resource=Resource(kind="ledger", id=f"l{i}", attr={"balance": bal}),
+            actions=["post", "audit"],
+        ))
+    assert_parity(rt, inputs, use_jax=use_jax)
