@@ -1,0 +1,164 @@
+"""Policy bundles: the pre-compiled rule-table artifact.
+
+Behavioral reference: the reference's compile store / rule-table bundle
+pipeline — `cerbos compilestore` serializes the built rule table + index
+(internal/ruletable/index/marshal.go) and PDPs load it directly
+(ruletable.RuleTableStore, internal/storage/hub/ruletable_bundle.go). The
+rebuild's equivalent artifact (SURVEY.md §5 checkpoint/resume): the parsed
+policy set + raw schemas, versioned and checksummed, so sidecar restart is
+unpack → compile → lower without touching the original store. Payload is a
+zstd/gzip tar of policy documents — policies are data; compiled tables
+rebuild deterministically from them.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import yaml
+
+from .policy import model
+from .policy.parser import parse_policies
+from .storage.store import Store, register_driver
+
+BUNDLE_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+@dataclass
+class BundleManifest:
+    version: int
+    created_at: str
+    policy_count: int
+    schema_count: int
+    checksum: str  # sha256 over sorted entry digests
+
+
+def build_bundle(store: Store, out_path: str) -> BundleManifest:
+    """Serialize a store's policies + schemas into a bundle file."""
+    policies = store.get_all()
+    schema_ids = store.list_schema_ids()
+
+    entries: list[tuple[str, bytes]] = []
+    for pol in policies:
+        raw = getattr(store, "get_raw", lambda _fqn: None)(pol.fqn())
+        if raw is None:
+            raw = yaml.safe_dump(_policy_to_dict(pol), sort_keys=False)
+        entries.append((f"policies/{hashlib.sha256(pol.fqn().encode()).hexdigest()[:16]}.yaml", raw.encode()))
+    for sid in schema_ids:
+        data = store.get_schema(sid)
+        if data is not None:
+            entries.append((f"_schemas/{sid}", data))
+
+    digest = hashlib.sha256()
+    for name, data in sorted(entries):
+        digest.update(name.encode())
+        digest.update(hashlib.sha256(data).digest())
+
+    manifest = BundleManifest(
+        version=BUNDLE_VERSION,
+        created_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        policy_count=len(policies),
+        schema_count=len(schema_ids),
+        checksum=digest.hexdigest(),
+    )
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        mdata = json.dumps(manifest.__dict__).encode()
+        info = tarfile.TarInfo(MANIFEST_NAME)
+        info.size = len(mdata)
+        tar.addfile(info, io.BytesIO(mdata))
+        for name, data in entries:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+
+    with gzip.open(out_path, "wb") as f:
+        f.write(buf.getvalue())
+    return manifest
+
+
+class BundleError(ValueError):
+    pass
+
+
+def _policy_to_dict(pol: model.Policy) -> dict:
+    raise BundleError(
+        f"policy {pol.fqn()} has no raw document (store does not retain source "
+        "text); bundle from a disk or sqlite store"
+    )
+
+
+class BundleStore(Store):
+    """Read-only store backed by a bundle file (the BinaryStore analogue)."""
+
+    driver = "bundle"
+
+    def __init__(self, path: str, verify_checksum: bool = True):
+        super().__init__()
+        self.path = path
+        self._policies: dict[str, model.Policy] = {}
+        self._schemas: dict[str, bytes] = {}
+        self.manifest: Optional[BundleManifest] = None
+        self._load(verify_checksum)
+
+    def _load(self, verify_checksum: bool) -> None:
+        with gzip.open(self.path, "rb") as f:
+            data = f.read()
+        entries: list[tuple[str, bytes]] = []
+        with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+            for member in tar.getmembers():
+                fh = tar.extractfile(member)
+                if fh is None:
+                    continue
+                content = fh.read()
+                if member.name == MANIFEST_NAME:
+                    self.manifest = BundleManifest(**json.loads(content))
+                else:
+                    entries.append((member.name, content))
+        if self.manifest is None:
+            raise ValueError(f"bundle {self.path} has no manifest")
+        if self.manifest.version > BUNDLE_VERSION:
+            raise ValueError(
+                f"bundle {self.path} was created by a newer compiler (v{self.manifest.version})"
+            )
+        if verify_checksum:
+            digest = hashlib.sha256()
+            for name, content in sorted(entries):
+                digest.update(name.encode())
+                digest.update(hashlib.sha256(content).digest())
+            if digest.hexdigest() != self.manifest.checksum:
+                raise ValueError(f"bundle {self.path} checksum mismatch (corrupted artifact)")
+        for name, content in entries:
+            if name.startswith("policies/"):
+                for pol in parse_policies(content.decode("utf-8"), source=name):
+                    self._policies[pol.fqn()] = pol
+            elif name.startswith("_schemas/"):
+                self._schemas[name[len("_schemas/"):]] = content
+
+    def get_all(self) -> list[model.Policy]:
+        return [p for p in self._policies.values() if not p.disabled]
+
+    def get(self, fqn: str) -> Optional[model.Policy]:
+        return self._policies.get(fqn)
+
+    def get_schema(self, schema_id: str) -> Optional[bytes]:
+        return self._schemas.get(schema_id)
+
+    def list_schema_ids(self) -> list[str]:
+        return sorted(self._schemas)
+
+
+register_driver("bundle", lambda conf: BundleStore(
+    path=conf.get("path", "bundle.crbp"),
+    verify_checksum=bool(conf.get("verifyChecksum", True)),
+))

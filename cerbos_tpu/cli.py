@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -19,6 +20,16 @@ def cmd_server(args: argparse.Namespace) -> int:
     config = Config.load(args.config, overrides=args.set or [])
     core = initialize(config)
     server_conf = config.section("server")
+
+    extra = []
+    from .server.authzen import AuthZenService
+
+    extra.append(AuthZenService(core.service))
+    if server_conf.get("playgroundEnabled", False):
+        from .server.playground import PlaygroundService
+
+        extra.append(PlaygroundService())
+
     server = Server(
         core.service,
         ServerConfig(
@@ -26,6 +37,7 @@ def cmd_server(args: argparse.Namespace) -> int:
             grpc_listen_addr=server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
         ),
         admin_service=_admin(core, server_conf),
+        extra_services=extra,
     )
     server.start()
     print(f"cerbos-tpu serving: http={server.http_port} grpc={server.grpc_port}", flush=True)
@@ -88,6 +100,77 @@ def cmd_compile(args: argparse.Namespace) -> int:
     return 4 if results.failed else 0
 
 
+def cmd_compilestore(args: argparse.Namespace) -> int:
+    """Build a pre-compiled policy bundle (ref: cerbos compilestore)."""
+    from .bundle import BundleError, build_bundle
+    from .compile import CompileError, compile_policy_set
+    from .storage.disk import BuildError, DiskStore
+
+    try:
+        store = DiskStore(args.dir)
+        compile_policy_set(store.get_all())  # lint before bundling
+        manifest = build_bundle(store, args.output)
+    except (BuildError, CompileError, BundleError) as e:
+        for err in getattr(e, "errors", [str(e)]):
+            print(f"ERROR: {err}", file=sys.stderr)
+        return 3
+    print(
+        f"wrote {args.output}: {manifest.policy_count} policies, "
+        f"{manifest.schema_count} schemas, checksum {manifest.checksum[:16]}…",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_healthcheck(args: argparse.Namespace) -> int:
+    """Probe a running PDP (ref: cerbos healthcheck, used in containers)."""
+    import urllib.request
+
+    url = f"http://{args.host_port}/_cerbos/health"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            body = json.loads(resp.read())
+        if body.get("status") == "SERVING":
+            return 0
+        print(f"unhealthy: {body}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001
+        print(f"unreachable: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    """Start the PDP, then run a child command with CERBOS_* env injected
+    (ref: cerbos run)."""
+    import subprocess
+
+    from .bootstrap import initialize
+    from .config import Config
+    from .server.server import Server, ServerConfig
+
+    config = Config.load(args.config, overrides=(args.set or []) + [
+        "server.httpListenAddr=127.0.0.1:0",
+        "server.grpcListenAddr=127.0.0.1:0",
+    ])
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("error: no command given (usage: cerbos-tpu run -- <command> [args...])", file=sys.stderr)
+        return 2
+    core = initialize(config)
+    server = Server(core.service, ServerConfig(http_listen_addr="127.0.0.1:0", grpc_listen_addr="127.0.0.1:0"))
+    server.start()
+    env = dict(os.environ)
+    env["CERBOS_HTTP"] = f"127.0.0.1:{server.http_port}"
+    env["CERBOS_GRPC"] = f"127.0.0.1:{server.grpc_port}"
+    try:
+        return subprocess.call(cmd, env=env)
+    finally:
+        server.stop()
+        core.close()
+
+
 def cmd_repl(args: argparse.Namespace) -> int:
     from .repl import run_repl
 
@@ -109,6 +192,22 @@ def main(argv: list[str] | None = None) -> int:
     p_compile.add_argument("--run", help="run only tests matching this regex", default="")
     p_compile.add_argument("--skip-tests", action="store_true")
     p_compile.set_defaults(fn=cmd_compile)
+
+    p_cs = sub.add_parser("compilestore", help="build a pre-compiled policy bundle")
+    p_cs.add_argument("dir", help="policy directory")
+    p_cs.add_argument("--output", "-o", default="bundle.crbp")
+    p_cs.set_defaults(fn=cmd_compilestore)
+
+    p_hc = sub.add_parser("healthcheck", help="probe a running PDP")
+    p_hc.add_argument("--host-port", default="127.0.0.1:3592")
+    p_hc.add_argument("--timeout", type=float, default=3.0)
+    p_hc.set_defaults(fn=cmd_healthcheck)
+
+    p_run = sub.add_parser("run", help="start a PDP and run a command against it")
+    p_run.add_argument("--config", help="path to config YAML")
+    p_run.add_argument("--set", action="append", help="config overrides")
+    p_run.add_argument("cmd", nargs=argparse.REMAINDER, help="command to run")
+    p_run.set_defaults(fn=cmd_run)
 
     p_repl = sub.add_parser("repl", help="interactive CEL condition REPL")
     p_repl.set_defaults(fn=cmd_repl)

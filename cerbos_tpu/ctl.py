@@ -1,0 +1,137 @@
+"""cerbos-tpuctl: remote admin client.
+
+Behavioral reference: cmd/cerbosctl — get/put/delete/enable/disable policies
+and schemas, store reload, audit log browsing, all against a running PDP's
+admin API.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import yaml
+
+
+class Client:
+    def __init__(self, server: str, username: str, password: str):
+        self.base = server if server.startswith("http") else f"http://{server}"
+        token = base64.b64encode(f"{username}:{password}".encode()).decode()
+        self.headers = {"Authorization": f"Basic {token}", "Content-Type": "application/json"}
+
+    def call(self, method: str, path: str, body: dict | None = None, params: dict | None = None):
+        url = self.base + path
+        if params:
+            pairs = []
+            for k, v in params.items():
+                if isinstance(v, list):
+                    pairs.extend((k, x) for x in v)
+                else:
+                    pairs.append((k, v))
+            url += "?" + urllib.parse.urlencode(pairs)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, headers=self.headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise SystemExit(f"error: {e.code} {detail}") from None
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="cerbos-tpuctl", description="Admin client for cerbos-tpu PDPs")
+    parser.add_argument("--server", default="127.0.0.1:3592")
+    parser.add_argument("--username", default="cerbos")
+    parser.add_argument("--password", default="cerbosAdmin")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_get = sub.add_parser("get", help="list or fetch policies/schemas")
+    p_get.add_argument("kind", choices=["policies", "policy", "schemas", "schema"])
+    p_get.add_argument("ids", nargs="*")
+    p_get.add_argument("--include-disabled", action="store_true")
+
+    p_put = sub.add_parser("put", help="upload policies or schemas from files")
+    p_put.add_argument("kind", choices=["policy", "schema"])
+    p_put.add_argument("files", nargs="+")
+
+    p_del = sub.add_parser("delete", help="delete policies or schemas")
+    p_del.add_argument("kind", choices=["policy", "schema"])
+    p_del.add_argument("ids", nargs="+")
+
+    for name in ("enable", "disable"):
+        p = sub.add_parser(name, help=f"{name} policies")
+        p.add_argument("kind", choices=["policy"])
+        p.add_argument("ids", nargs="+")
+
+    p_store = sub.add_parser("store", help="store operations")
+    p_store.add_argument("op", choices=["reload"])
+
+    p_audit = sub.add_parser("audit", help="browse audit log entries")
+    p_audit.add_argument("--kind", choices=["access", "decision"], default="decision")
+    p_audit.add_argument("--tail", type=int, default=20)
+
+    args = parser.parse_args(argv)
+    client = Client(args.server, args.username, args.password)
+
+    if args.command == "get":
+        if args.kind == "policies" or (args.kind == "policy" and not args.ids):
+            resp = client.call("GET", "/admin/policies", params={"includeDisabled": str(args.include_disabled).lower()})
+            for pid in resp.get("policyIds", []):
+                print(pid)
+        elif args.kind == "policy":
+            resp = client.call("GET", "/admin/policy", params={"id": args.ids})
+            print(yaml.safe_dump_all(resp.get("policies", []), sort_keys=False))
+        elif args.kind == "schemas" or (args.kind == "schema" and not args.ids):
+            resp = client.call("GET", "/admin/schemas")
+            for sid in resp.get("schemaIds", []):
+                print(sid)
+        else:
+            resp = client.call("GET", "/admin/schema", params={"id": args.ids})
+            print(json.dumps(resp.get("schemas", []), indent=2))
+    elif args.command == "put":
+        if args.kind == "policy":
+            policies = []
+            for path in args.files:
+                with open(path, encoding="utf-8") as f:
+                    policies.extend(d for d in yaml.safe_load_all(f) if d)
+            resp = client.call("POST", "/admin/policy", body={"policies": policies})
+            print(f"uploaded {len(policies)} policies")
+        else:
+            schemas = []
+            for path in args.files:
+                with open(path, encoding="utf-8") as f:
+                    definition = json.load(f)
+                sid = path.rsplit("/", 1)[-1]
+                schemas.append({"id": sid, "definition": definition})
+            client.call("POST", "/admin/schema", body={"schemas": schemas})
+            print(f"uploaded {len(schemas)} schemas")
+    elif args.command == "delete":
+        if args.kind == "policy":
+            resp = client.call("DELETE", "/admin/policy", params={"id": args.ids})
+            print(f"deleted {resp.get('deletedPolicies', 0)}")
+        else:
+            resp = client.call("DELETE", "/admin/schema", params={"id": args.ids})
+            print(f"deleted {resp.get('deletedSchemas', 0)}")
+    elif args.command in ("enable", "disable"):
+        resp = client.call("POST", f"/admin/policy/{args.command}", params={"id": args.ids})
+        key = "enabledPolicies" if args.command == "enable" else "disabledPolicies"
+        print(f"{args.command}d {resp.get(key, 0)}")
+    elif args.command == "store":
+        client.call("GET", "/admin/store/reload")
+        print("store reload triggered")
+    elif args.command == "audit":
+        kind = {"access": "access_logs", "decision": "decision_logs"}[args.kind]
+        resp = client.call("GET", f"/admin/auditlog/list/{kind}", params={"tail": str(args.tail)})
+        for entry in resp.get("entries", []):
+            print(json.dumps(entry))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

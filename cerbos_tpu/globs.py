@@ -46,28 +46,39 @@ def _translate(pat: str) -> str:
                 out.append(re.escape(c))
                 i += 1
                 continue
-            body = pat[j:k].replace("\\", "\\\\").replace("^", "\\^")
+            body = pat[j:k].replace("\\", "\\\\").replace("^", "\\^").replace("[", "\\[")
             out.append(f"[{'^' if neg else ''}{body}]")
             i = k + 1
         elif c == "{":
-            # find matching close brace (no nesting of braces inside alternates
-            # beyond simple patterns; gobwas allows nested sub-patterns)
+            # find matching close brace; braces inside [...] classes are
+            # literal (must agree with the native matcher)
             depth, k = 1, i + 1
+            in_cls = False
             while k < n and depth:
-                if pat[k] == "{":
+                ch = pat[k]
+                if ch == "\\":
+                    k += 2
+                    continue
+                if in_cls:
+                    if ch == "]":
+                        in_cls = False
+                elif ch == "[":
+                    in_cls = True
+                elif ch == "{":
                     depth += 1
-                elif pat[k] == "}":
+                elif ch == "}":
                     depth -= 1
-                elif pat[k] == "\\":
-                    k += 1
                 k += 1
             if depth:  # unterminated: literal
                 out.append(re.escape(c))
                 i += 1
                 continue
             inner = pat[i + 1 : k - 1]
-            # split on top-level commas
+            # split on top-level commas; commas inside nested {...} or a
+            # [...] class are literal (must agree with the native matcher's
+            # SplitAlternates)
             alts, buf, d = [], [], 0
+            in_class = False
             m = 0
             while m < len(inner):
                 ch = inner[m]
@@ -75,9 +86,17 @@ def _translate(pat: str) -> str:
                     buf.append(inner[m : m + 2])
                     m += 2
                     continue
-                if ch in "{[":
+                if in_class:
+                    if ch == "]":
+                        in_class = False
+                    buf.append(ch)
+                    m += 1
+                    continue
+                if ch == "[":
+                    in_class = True
+                elif ch == "{":
                     d += 1
-                elif ch in "}]":
+                elif ch == "}":
                     d -= 1
                 if ch == "," and d == 0:
                     alts.append("".join(buf))

@@ -1,0 +1,126 @@
+"""Observability: structured logging, spans, runtime level switching.
+
+Behavioral reference: internal/observability — zap structured logging with
+named loggers and SIGUSR1/SIGUSR2 runtime level toggling
+(logging/signal.go), span instrumentation at every layer (tracing.StartSpan),
+OTLP export configured from OTEL_* env vars. Without egress, spans export to
+the structured log (an OTLP exporter slots into SpanExporter when the
+collector is reachable); metrics are served by the HTTP listener at
+/_cerbos/metrics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import signal
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S%z"),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        extra = getattr(record, "fields", None)
+        if extra:
+            out.update(extra)
+        return json.dumps(out, default=str)
+
+
+def init_logging(level: str = "info", fmt: str = "json") -> None:
+    root = logging.getLogger("cerbos_tpu")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    handler = logging.StreamHandler(sys.stderr)
+    if fmt == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s"))
+    root.handlers[:] = [handler]
+
+    # SIGUSR1 raises verbosity, SIGUSR2 restores it (ref: logging/signal.go)
+    if hasattr(signal, "SIGUSR1"):
+        base_level = root.level
+
+        def to_debug(_sig, _frm):
+            root.setLevel(logging.DEBUG)
+
+        def restore(_sig, _frm):
+            root.setLevel(base_level)
+
+        with contextlib.suppress(ValueError):  # non-main threads can't set handlers
+            signal.signal(signal.SIGUSR1, to_debug)
+            signal.signal(signal.SIGUSR2, restore)
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+    parent_id: str = ""
+    start: float = field(default_factory=time.perf_counter)
+    attributes: dict[str, Any] = field(default_factory=dict)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+
+class SpanExporter:
+    """Export finished spans; the default sink is the debug log. An OTLP
+    exporter implements the same single-method interface."""
+
+    def export(self, span: Span, duration_ms: float) -> None:
+        logging.getLogger("cerbos_tpu.tracing").debug(
+            "span %s", span.name,
+            extra={"fields": {"traceId": span.trace_id, "spanId": span.span_id,
+                              "parentId": span.parent_id, "durationMs": round(duration_ms, 3),
+                              **span.attributes}},
+        )
+
+
+_exporter: SpanExporter = SpanExporter()
+_current: dict[int, Span] = {}  # thread id -> active span
+
+
+def set_exporter(exporter: SpanExporter) -> None:
+    global _exporter
+    _exporter = exporter
+
+
+@contextlib.contextmanager
+def start_span(name: str, **attributes: Any) -> Iterator[Span]:
+    import threading
+
+    tid = threading.get_ident()
+    parent = _current.get(tid)
+    span = Span(
+        name=name,
+        trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+        parent_id=parent.span_id if parent else "",
+        attributes=dict(attributes),
+    )
+    _current[tid] = span
+    try:
+        yield span
+    finally:
+        if parent is None:
+            _current.pop(tid, None)
+        else:
+            _current[tid] = parent
+        _exporter.export(span, (time.perf_counter() - span.start) * 1000)

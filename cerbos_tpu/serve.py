@@ -1,0 +1,73 @@
+"""Embedding SDK: run a PDP inside a host application.
+
+Behavioral reference: pkg/cerbos/serve.go (cerbos.Serve with config
+file/overrides). ``serve()`` starts the full server and returns a handle;
+``embedded()`` returns just the engine-backed service for in-process checks
+without any listeners (the ePDP pattern).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .bootstrap import Core, initialize
+from .config import Config
+from .server.server import Server, ServerConfig
+
+
+@dataclass
+class Handle:
+    core: Core
+    server: Optional[Server] = None
+
+    @property
+    def http_addr(self) -> str:
+        return f"127.0.0.1:{self.server.http_port}" if self.server else ""
+
+    @property
+    def grpc_addr(self) -> str:
+        return f"127.0.0.1:{self.server.grpc_port}" if self.server else ""
+
+    def check(self, inputs, params=None):
+        return self.core.engine.check(inputs, params=params)
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+        self.core.close()
+
+
+def serve(
+    config_file: Optional[str] = None,
+    overrides: Optional[list[str]] = None,
+    use_tpu: Optional[bool] = None,
+) -> Handle:
+    """Start a full PDP (gRPC + HTTP) and return a handle."""
+    config = Config.load(config_file, overrides=overrides or [])
+    core = initialize(config, use_tpu=use_tpu)
+    server_conf = config.section("server")
+    server = Server(
+        core.service,
+        ServerConfig(
+            http_listen_addr=server_conf.get("httpListenAddr", "127.0.0.1:0"),
+            grpc_listen_addr=server_conf.get("grpcListenAddr", "127.0.0.1:0"),
+        ),
+    )
+    server.start()
+    return Handle(core=core, server=server)
+
+
+def embedded(
+    policy_dir: Optional[str] = None,
+    config_file: Optional[str] = None,
+    overrides: Optional[list[str]] = None,
+    use_tpu: Optional[bool] = None,
+) -> Handle:
+    """An in-process PDP with no listeners (embedded/ePDP usage)."""
+    ov = list(overrides or [])
+    if policy_dir is not None:
+        ov.append(f"storage.disk.directory={policy_dir}")
+    config = Config.load(config_file, overrides=ov)
+    core = initialize(config, use_tpu=use_tpu)
+    return Handle(core=core)

@@ -1,0 +1,91 @@
+"""OpenID AuthZen interop: the AuthZen evaluation API mapped onto the engine.
+
+Behavioral reference: internal/svc/authzen_svc.go + the
+``/.well-known/authzen-configuration`` discovery route (server.go:88-89).
+AuthZen subject/resource/action map onto principal/resource/action;
+``context`` merges into resource attributes the way the reference adapts it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from aiohttp import web
+
+from ..engine import types as T
+
+
+class AuthZenService:
+    def __init__(self, service: Any):
+        self.svc = service
+
+    def add_http_routes(self, app: web.Application) -> None:
+        app.router.add_get("/.well-known/authzen-configuration", self._h_config)
+        app.router.add_post("/access/v1/evaluation", self._h_evaluation)
+        app.router.add_post("/access/v1/evaluations", self._h_evaluations)
+
+    async def _h_config(self, request: web.Request) -> web.Response:
+        base = f"{request.scheme}://{request.host}"
+        return web.json_response(
+            {
+                "policy_decision_point": base,
+                "access_evaluation_endpoint": f"{base}/access/v1/evaluation",
+                "access_evaluations_endpoint": f"{base}/access/v1/evaluations",
+            }
+        )
+
+    def _to_input(self, body: dict) -> T.CheckInput:
+        subject = body.get("subject") or {}
+        resource = body.get("resource") or {}
+        action = body.get("action") or {}
+        context = body.get("context") or {}
+        subj_props = dict(subject.get("properties") or {})
+        roles = subj_props.pop("roles", None) or [subject.get("type", "user")]
+        res_props = dict(resource.get("properties") or {})
+        if context:
+            res_props.setdefault("context", context)
+        return T.CheckInput(
+            principal=T.Principal(
+                id=str(subject.get("id", "")),
+                roles=[str(r) for r in roles] if isinstance(roles, list) else [str(roles)],
+                attr=subj_props,
+            ),
+            resource=T.Resource(
+                kind=str(resource.get("type", "")),
+                id=str(resource.get("id", "")),
+                attr=res_props,
+            ),
+            actions=[str(action.get("name", ""))],
+        )
+
+    async def _h_evaluation(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        try:
+            check_input = self._to_input(body)
+            outputs, _ = self.svc.check_resources([check_input])
+            action = check_input.actions[0]
+            decision = outputs[0].actions[action].effect == T.EFFECT_ALLOW
+            return web.json_response({"decision": decision})
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def _h_evaluations(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        defaults = {k: body.get(k) for k in ("subject", "resource", "action", "context") if body.get(k)}
+        results = []
+        try:
+            for item in body.get("evaluations", []):
+                merged = {**defaults, **item}
+                check_input = self._to_input(merged)
+                outputs, _ = self.svc.check_resources([check_input])
+                action = check_input.actions[0]
+                results.append({"decision": outputs[0].actions[action].effect == T.EFFECT_ALLOW})
+            return web.json_response({"evaluations": results})
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)

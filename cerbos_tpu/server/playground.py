@@ -1,0 +1,131 @@
+"""Playground: ad-hoc multi-policy validate / test / evaluate.
+
+Behavioral reference: internal/svc/playground_svc.go — requests carry an
+inline policy file set; validate compiles them, evaluate runs a check against
+a throwaway engine, test runs the policy test suites included in the files.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any
+
+from aiohttp import web
+
+from ..compile import CompileError, compile_policy_set
+from ..engine import types as T
+from ..engine.engine import Engine
+from ..policy.parser import ParseError, parse_policies
+
+
+def _build_engine(files: list[dict]) -> tuple[Any, list[str]]:
+    policies = []
+    errors = []
+    for f in files:
+        name = f.get("fileName", "policy.yaml")
+        if name.endswith(("_test.yaml", "_test.yml")) or "testdata/" in name:
+            continue
+        contents = f.get("contents", "")
+        if isinstance(contents, bytes):
+            contents = contents.decode("utf-8")
+        try:
+            policies.extend(parse_policies(contents, source=name))
+        except ParseError as e:
+            errors.append(str(e))
+    if errors:
+        return None, errors
+    try:
+        compiled = compile_policy_set(policies)
+    except CompileError as e:
+        return None, list(e.errors)
+    return Engine.from_policies(compiled), []
+
+
+class PlaygroundService:
+    def __init__(self) -> None:
+        pass
+
+    def add_http_routes(self, app: web.Application) -> None:
+        app.router.add_post("/api/playground/validate", self._h_validate)
+        app.router.add_post("/api/playground/evaluate", self._h_evaluate)
+        app.router.add_post("/api/playground/test", self._h_test)
+
+    async def _h_validate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        _, errors = _build_engine(body.get("files", []))
+        pid = body.get("playgroundId", "")
+        if errors:
+            return web.json_response(
+                {"playgroundId": pid, "failure": {"errors": [{"file": "", "error": e} for e in errors]}}
+            )
+        return web.json_response({"playgroundId": pid, "success": {}})
+
+    async def _h_evaluate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        pid = body.get("playgroundId", "")
+        engine, errors = _build_engine(body.get("files", []))
+        if errors:
+            return web.json_response(
+                {"playgroundId": pid, "failure": {"errors": [{"file": "", "error": e} for e in errors]}}
+            )
+        pj = body.get("principal") or {}
+        rj = body.get("resource") or {}
+        check_input = T.CheckInput(
+            principal=T.Principal(
+                id=pj.get("id", ""), roles=list(pj.get("roles", [])), attr=pj.get("attr", {}) or {},
+                policy_version=pj.get("policyVersion", ""), scope=pj.get("scope", ""),
+            ),
+            resource=T.Resource(
+                kind=rj.get("kind", ""), id=rj.get("id", ""), attr=rj.get("attr", {}) or {},
+                policy_version=rj.get("policyVersion", ""), scope=rj.get("scope", ""),
+            ),
+            actions=list(body.get("actions", [])),
+        )
+        out = engine.check([check_input])[0]
+        return web.json_response(
+            {
+                "playgroundId": pid,
+                "success": {
+                    "results": [
+                        {"action": a, "effect": e.effect, "policy": e.policy} for a, e in out.actions.items()
+                    ],
+                    "effectiveDerivedRoles": out.effective_derived_roles,
+                    "validationErrors": [
+                        {"path": v.path, "message": v.message, "source": v.source} for v in out.validation_errors
+                    ],
+                    "outputs": [
+                        {"src": o.src, "action": o.action, "val": o.val, "error": o.error} for o in out.outputs
+                    ],
+                },
+            }
+        )
+
+    async def _h_test(self, request: web.Request) -> web.Response:
+        from ..verify.runner import discover_and_run
+
+        body = await request.json()
+        pid = body.get("playgroundId", "")
+        files = body.get("files", [])
+        with tempfile.TemporaryDirectory(prefix="cerbos-playground-") as tmp:
+            for f in files:
+                name = os.path.normpath(f.get("fileName", "policy.yaml"))
+                if name.startswith(("..", "/")):
+                    continue
+                path = os.path.join(tmp, name)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                contents = f.get("contents", "")
+                if isinstance(contents, bytes):
+                    contents = contents.decode("utf-8")
+                with open(path, "w", encoding="utf-8") as fh:
+                    fh.write(contents)
+            try:
+                results = discover_and_run(tmp)
+            except (ParseError, CompileError) as e:
+                errors = getattr(e, "errors", [str(e)])
+                return web.json_response(
+                    {"playgroundId": pid, "failure": {"errors": [{"file": "", "error": str(x)} for x in errors]}}
+                )
+        if results is None:
+            return web.json_response({"playgroundId": pid, "success": {"results": []}})
+        return web.json_response({"playgroundId": pid, "success": results.to_json()})

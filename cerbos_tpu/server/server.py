@@ -144,10 +144,17 @@ def _plan_json_to_proto(j: dict, response_pb2):
 class Server:
     """Serves the Cerbos API over gRPC and HTTP concurrently."""
 
-    def __init__(self, service: CerbosService, config: Optional[ServerConfig] = None, admin_service: Any = None):
+    def __init__(
+        self,
+        service: CerbosService,
+        config: Optional[ServerConfig] = None,
+        admin_service: Any = None,
+        extra_services: Optional[list[Any]] = None,
+    ):
         self.svc = service
         self.config = config or ServerConfig()
         self.admin_service = admin_service
+        self.extra_services = extra_services or []
         self._grpc_server: Optional[grpc.Server] = None
         self._http_runner: Optional[web.AppRunner] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -180,6 +187,8 @@ class Server:
         app.router.add_get("/api/server_info", self._h_server_info)
         if self.admin_service is not None:
             self.admin_service.add_http_routes(app)
+        for svc in self.extra_services:
+            svc.add_http_routes(app)
         return app
 
     async def _h_health(self, request: web.Request) -> web.Response:

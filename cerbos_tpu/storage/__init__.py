@@ -3,3 +3,6 @@ from .disk import DiskStore  # noqa: F401
 from .sqlite import SqliteStore  # noqa: F401
 from .git import GitStore  # noqa: F401
 from .overlay import OverlayStore  # noqa: F401
+from .blob import BlobStore  # noqa: F401
+# the "bundle" driver registers lazily via store._LAZY_DRIVERS (importing
+# cerbos_tpu.bundle here would be circular: bundle imports storage.store)

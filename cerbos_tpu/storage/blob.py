@@ -1,0 +1,109 @@
+"""Blob store: poll an object-store prefix, serve a local clone.
+
+Behavioral reference: internal/storage/blob (S3/GCS/MinIO via gocloud with
+a local clone + poll — blob/cloner.go). This environment has no egress, so
+transports are pluggable: ``file://`` (local directory treated as a bucket,
+matching the reference's e2e fixture pattern) works out of the box; s3/gcs
+transports require the corresponding SDKs and raise a clear error when
+missing.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Optional
+
+from ..policy import model
+from .disk import DiskStore
+from .store import Event, Store, register_driver
+
+
+class BlobStore(Store):
+    driver = "blob"
+
+    def __init__(self, bucket_url: str, work_dir: str, update_poll_interval: float = 60.0):
+        super().__init__()
+        self.bucket_url = bucket_url
+        self.work_dir = os.path.abspath(work_dir)
+        self._stop = threading.Event()
+        self._sync()
+        self._disk = DiskStore(self.work_dir, watch_for_changes=False)
+        self._disk.subscribe(self.subscriptions.notify)
+        self._poller: Optional[threading.Thread] = None
+        if update_poll_interval > 0:
+            self._poller = threading.Thread(
+                target=self._poll_loop, args=(update_poll_interval,), daemon=True, name="blob-store-poll"
+            )
+            self._poller.start()
+
+    def _sync(self) -> None:
+        if self.bucket_url.startswith("file://"):
+            src = self.bucket_url[len("file://"):]
+            os.makedirs(self.work_dir, exist_ok=True)
+            # clone: copy changed files, drop removed ones
+            seen = set()
+            for root, dirs, files in os.walk(src):
+                # never recurse into our own clone if it lives inside the bucket
+                dirs[:] = [d for d in dirs if os.path.abspath(os.path.join(root, d)) != self.work_dir]
+                rel = os.path.relpath(root, src)
+                for f in files:
+                    rel_path = os.path.normpath(os.path.join(rel, f))
+                    seen.add(rel_path)
+                    s = os.path.join(root, f)
+                    d = os.path.join(self.work_dir, rel_path)
+                    os.makedirs(os.path.dirname(d), exist_ok=True)
+                    if not os.path.exists(d) or os.path.getmtime(s) > os.path.getmtime(d):
+                        shutil.copy2(s, d)
+            for root, dirs, files in os.walk(self.work_dir):
+                rel = os.path.relpath(root, self.work_dir)
+                for f in files:
+                    rel_path = os.path.normpath(os.path.join(rel, f))
+                    if rel_path not in seen:
+                        os.unlink(os.path.join(root, f))
+        elif self.bucket_url.startswith(("s3://", "gs://", "azblob://")):
+            raise RuntimeError(
+                f"blob transport for {self.bucket_url!r} requires the cloud SDK, "
+                "which is not available in this environment; use file:// or the git/disk drivers"
+            )
+        else:
+            raise ValueError(f"unsupported bucket URL {self.bucket_url!r}")
+
+    def _poll_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.sync_and_compare()
+            except Exception:  # noqa: BLE001 — keep serving the local clone
+                import logging
+
+                logging.getLogger("cerbos_tpu.storage.blob").exception("blob poll failed")
+
+    def sync_and_compare(self) -> list[Event]:
+        self._sync()
+        return self._disk.check_for_changes()
+
+    def get_all(self) -> list[model.Policy]:
+        return self._disk.get_all()
+
+    def get(self, fqn: str):
+        return self._disk.get(fqn)
+
+    def get_schema(self, schema_id: str):
+        return self._disk.get_schema(schema_id)
+
+    def list_schema_ids(self) -> list[str]:
+        return self._disk.list_schema_ids()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2)
+        self._disk.close()
+
+
+register_driver("blob", lambda conf: BlobStore(
+    bucket_url=conf.get("bucket", ""),
+    work_dir=conf.get("workDir", "/tmp/cerbos-tpu-blob"),
+    update_poll_interval=float(conf.get("updatePollInterval", 60.0)),
+))

@@ -90,6 +90,18 @@ class DiskStore(Store):
         with self._lock:
             return self._policies.get(fqn)
 
+    def get_raw(self, fqn: str) -> Optional[str]:
+        """The raw policy document (used by bundling and the Admin API)."""
+        with self._lock:
+            for path, (f, _mtime) in self._files.items():
+                if f == fqn:
+                    try:
+                        with open(path, encoding="utf-8") as fh:
+                            return fh.read()
+                    except OSError:
+                        return None
+        return None
+
     # -- schemas -----------------------------------------------------------
 
     def _schema_path(self, schema_id: str) -> str:

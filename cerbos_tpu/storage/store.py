@@ -88,9 +88,18 @@ def register_driver(name: str, factory: Callable[[dict], Store]) -> None:
     _REGISTRY[name] = factory
 
 
+# drivers living outside this package register on first use
+_LAZY_DRIVERS = {"bundle": "cerbos_tpu.bundle"}
+
+
 def new_store(conf: dict) -> Store:
     driver = conf.get("driver", "disk")
     factory = _REGISTRY.get(driver)
+    if factory is None and driver in _LAZY_DRIVERS:
+        import importlib
+
+        importlib.import_module(_LAZY_DRIVERS[driver])
+        factory = _REGISTRY.get(driver)
     if factory is None:
         raise ValueError(f"unknown storage driver {driver!r} (known: {sorted(_REGISTRY)})")
     return factory(conf.get(driver, {}))

@@ -1,0 +1,61 @@
+"""Product telemetry (disabled-by-default, opt-out respected).
+
+Behavioral reference: internal/telemetry/telemetry.go — anonymous usage
+events with documented opt-outs (DO_NOT_TRACK / CERBOS_NO_TELEMETRY,
+telemetry.go:34-36) and a persisted state file. This environment has no
+egress, so events are buffered locally and dropped on close; the interface
+and opt-out behavior match so downstream wiring is identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Optional
+
+_OPT_OUT_VARS = ("DO_NOT_TRACK", "CERBOS_NO_TELEMETRY", "CERBOS_TPU_NO_TELEMETRY")
+
+
+def telemetry_enabled(conf: dict) -> bool:
+    if conf.get("disabled", True):
+        return False
+    for var in _OPT_OUT_VARS:
+        v = os.environ.get(var, "").lower()
+        if v in ("1", "true", "yes", "on"):
+            return False
+    return True
+
+
+class Telemetry:
+    def __init__(self, conf: dict, state_dir: Optional[str] = None):
+        self.enabled = telemetry_enabled(conf)
+        self.state_dir = state_dir or os.path.join(os.path.expanduser("~"), ".cache", "cerbos-tpu")
+        self._events: list[dict] = []
+        self.instance_id = self._load_instance_id() if self.enabled else ""
+
+    def _load_instance_id(self) -> str:
+        path = os.path.join(self.state_dir, "telemetry.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)["instanceId"]
+        except (OSError, KeyError, json.JSONDecodeError):
+            iid = uuid.uuid4().hex
+            try:
+                os.makedirs(self.state_dir, exist_ok=True)
+                with open(path, "w", encoding="utf-8") as f:
+                    json.dump({"instanceId": iid}, f)
+            except OSError:
+                pass
+            return iid
+
+    def record(self, event: str, **props: Any) -> None:
+        if not self.enabled:
+            return
+        self._events.append({"event": event, "ts": time.time(), "instanceId": self.instance_id, **props})
+        if len(self._events) > 1000:
+            del self._events[:500]
+
+    def close(self) -> None:
+        self._events.clear()

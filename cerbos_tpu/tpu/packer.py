@@ -476,7 +476,14 @@ class Packer:
             def fn(inp, root=root, leaf=leaf):  # type: ignore[misc]
                 return getattr(inp, root).attr.get(leaf, _MISSING)
 
-        elif len(path) == 2 and path[0] in ("principal", "resource"):
+        elif (
+            len(path) == 2
+            and path[0] in ("principal", "resource")
+            # only the wire-format field names; anything else (e.g. a
+            # snake_case dataclass attribute) must behave as missing, like
+            # the generic view walk does
+            and path[1] in ("id", "kind", "roles", "attr", "policyVersion", "scope")
+        ):
             root, leaf = path[0], path[1]
             if leaf == "scope":
                 scope_value = namer.scope_value
@@ -502,11 +509,15 @@ class Packer:
     def _encode_columns(self, plans: list[InputPlan], params: T.EvalParams) -> ColumnBatch:
         from .condcompile import TAG_ERR
 
+        from .. import native as native_mod
+        from .columns import TAG_NUM
+
         B = len(plans)
         cb = ColumnBatch(size=B)
         interner = self.lt.interner
         paths = sorted(self.lt.paths)
         encode_cache = self._encode_cache
+        native = native_mod.get()
         for p in paths:
             t = np.zeros(B, dtype=np.int8)
             h = np.zeros(B, dtype=np.int32)
@@ -515,6 +526,9 @@ class Packer:
             nn = np.zeros(B, dtype=bool)
             accessor = self._path_accessor(p)
             trig = self.lt.fallback_tags.get(p)
+            # float values batch through the native key encoder
+            num_idx: list[int] = []
+            num_vals: list[float] = []
             for bi, plan in enumerate(plans):
                 if plan.trivial or plan.oracle:
                     continue
@@ -523,6 +537,11 @@ class Packer:
                     continue  # TAG_MISSING zeros already in place
                 if v is _ERR_SENTINEL:
                     t[bi] = TAG_ERR
+                    continue
+                if native is not None and type(v) is float:
+                    t[bi] = TAG_NUM
+                    num_idx.append(bi)
+                    num_vals.append(v)
                     continue
                 # cache encodings per concrete value; key includes the type so
                 # True / 1.0 / 1 don't collide as dict keys
@@ -542,6 +561,13 @@ class Packer:
                 t[bi], h[bi], l[bi], s[bi], nn[bi] = tag, hi, lo, sid, is_nan
                 if trig and tag in trig:
                     plan.oracle = True
+            if num_idx:
+                arr = np.asarray(num_vals, dtype=np.float64)
+                hi_b, lo_b, nan_b = native.encode_double_keys(arr.tobytes())
+                idx = np.asarray(num_idx, dtype=np.int64)
+                h[idx] = np.frombuffer(hi_b, dtype=np.int32)
+                l[idx] = np.frombuffer(lo_b, dtype=np.int32)
+                nn[idx] = np.frombuffer(nan_b, dtype=np.uint8).astype(bool)
             cb.tags[p], cb.his[p], cb.los[p], cb.sids[p], cb.nans[p] = t, h, l, s, nn
 
         # predicate columns

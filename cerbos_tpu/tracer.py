@@ -1,0 +1,123 @@
+"""Policy-evaluation tracer: the domain-level trace tree.
+
+Behavioral reference: internal/engine/tracer/{context,sink}.go — a tree of
+policy → action → scope → rule → condition events with results, sent to
+pluggable sinks; surfaced in playground/verify --verbose. This
+implementation wraps the CPU oracle: a TraceRecorder collects events during
+a check and renders them as the wire-format trace list."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .engine import types as T
+from .ruletable import check as rt_check
+from .ruletable.table import RuleTable
+
+
+@dataclass
+class TraceEvent:
+    components: list[dict]  # [{kind: "policy"|"action"|"scope"|"rule"|..., id: str}]
+    activated: Optional[bool] = None
+    effect: Optional[str] = None
+    message: str = ""
+    result: Any = None
+
+    def to_json(self) -> dict:
+        out: dict[str, Any] = {"components": self.components}
+        event: dict[str, Any] = {}
+        if self.activated is not None:
+            event["status"] = "ACTIVATED" if self.activated else "SKIPPED"
+        if self.effect:
+            event["effect"] = self.effect
+        if self.message:
+            event["message"] = self.message
+        if self.result is not None:
+            event["result"] = self.result
+        if event:
+            out["event"] = event
+        return out
+
+
+class TraceRecorder:
+    """Collects trace events; handed to check via EvalParams-adjacent hook."""
+
+    def __init__(self) -> None:
+        self.events: list[TraceEvent] = []
+
+    def add(self, components: list[dict], **kwargs: Any) -> None:
+        self.events.append(TraceEvent(components=components, **kwargs))
+
+    def to_json(self) -> list[dict]:
+        return [e.to_json() for e in self.events]
+
+
+def traced_check(
+    rt: RuleTable,
+    input: T.CheckInput,
+    params: Optional[T.EvalParams] = None,
+    schema_mgr: Any = None,
+) -> tuple[T.CheckOutput, TraceRecorder]:
+    """Run the oracle check while recording a per-action trace.
+
+    The trace is reconstructed from the same data the oracle uses: for each
+    action we re-query candidate bindings and record rule activations.
+    """
+    params = params or T.EvalParams()
+    recorder = TraceRecorder()
+    output = rt_check.check_input(rt, input, params, schema_mgr)
+
+    principal_scope = T.effective_scope(input.principal.scope, params)
+    resource_scope = T.effective_scope(input.resource.scope, params)
+    resource_version = T.effective_version(input.resource.policy_version, params)
+    from . import namer
+
+    _, _, resource_fqn = rt.get_all_scopes(
+        "RESOURCE", resource_scope, input.resource.kind, resource_version, params.lenient_scope_search
+    )
+    r_scopes, _, _ = rt.get_all_scopes(
+        "RESOURCE", resource_scope, input.resource.kind, resource_version, params.lenient_scope_search
+    )
+
+    request, principal, resource = rt_check.build_request_messages(input)
+    ec = rt_check.EvalContext(params, request, principal, resource)
+
+    for action in input.actions:
+        ae = output.actions.get(action)
+        base = [{"kind": "action", "id": action}]
+        parent_roles = rt.idx.add_parent_roles([resource_scope], list(input.principal.roles))
+        for scope in r_scopes:
+            rows = rt.idx.query(
+                resource_version, namer.sanitize(input.resource.kind), scope, action,
+                parent_roles, "RESOURCE", "",
+            )
+            for b in rows:
+                comps = base + [
+                    {"kind": "policy", "id": namer.policy_key_from_fqn(b.origin_fqn)},
+                    {"kind": "scope", "id": scope},
+                    {"kind": "rule", "id": b.name or "rule"},
+                ]
+                constants = b.params.constants if b.params else {}
+                variables = ec.evaluate_variables(constants, b.params.ordered_variables) if b.params else {}
+                try:
+                    sat = ec.satisfies_condition(b.condition, constants, variables)
+                    if b.derived_role_condition is not None:
+                        dr_consts = b.derived_role_params.constants if b.derived_role_params else {}
+                        dr_vars = (
+                            ec.evaluate_variables(dr_consts, b.derived_role_params.ordered_variables)
+                            if b.derived_role_params
+                            else {}
+                        )
+                        sat = sat and ec.satisfies_condition(b.derived_role_condition, dr_consts, dr_vars)
+                except Exception:  # noqa: BLE001
+                    sat = False
+                recorder.add(
+                    comps,
+                    activated=sat,
+                    effect=b.effect if sat and b.effect != "EFFECT_UNSPECIFIED" else None,
+                    message="" if sat else "Condition not satisfied",
+                )
+        if ae is not None:
+            recorder.add(base, effect=ae.effect, message=f"Resolved by {ae.policy}")
+    return output, recorder

@@ -1,0 +1,293 @@
+"""Bundle format, AuthZen, playground, tracer, telemetry, observability, CLI."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from cerbos_tpu.bundle import BundleStore, build_bundle
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, Engine, Principal, Resource
+from cerbos_tpu.storage import DiskStore, new_store
+
+POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.owner == P.id
+"""
+
+
+@pytest.fixture()
+def policy_dir(tmp_path):
+    (tmp_path / "doc.yaml").write_text(POLICY)
+    schemas = tmp_path / "_schemas"
+    schemas.mkdir()
+    (schemas / "doc.json").write_text('{"type": "object"}')
+    return tmp_path
+
+
+class TestBundle:
+    def test_roundtrip(self, policy_dir, tmp_path):
+        store = DiskStore(str(policy_dir))
+        out = str(tmp_path / "b.crbp")
+        manifest = build_bundle(store, out)
+        assert manifest.policy_count == 1 and manifest.schema_count == 1
+
+        bstore = BundleStore(out)
+        pols = bstore.get_all()
+        assert len(pols) == 1
+        assert bstore.get_schema("doc.json") == b'{"type": "object"}'
+
+        # a PDP can serve directly from the bundle
+        eng = Engine.from_policies(compile_policy_set(pols))
+        r = eng.check([CheckInput(principal=Principal(id="u", roles=["user"]),
+                                  resource=Resource(kind="doc", id="d", attr={"owner": "u"}),
+                                  actions=["view"])])[0]
+        assert r.actions["view"].effect == "EFFECT_ALLOW"
+
+    def test_corruption_detected(self, policy_dir, tmp_path):
+        store = DiskStore(str(policy_dir))
+        out = str(tmp_path / "b.crbp")
+        build_bundle(store, out)
+        import gzip
+
+        data = bytearray(gzip.open(out, "rb").read())
+        # flip a byte inside a policy entry (not the tar structure)
+        idx = data.find(b"EFFECT_ALLOW")
+        data[idx:idx + 12] = b"EFFECT_DENYY"
+        with gzip.open(out, "wb") as f:
+            f.write(bytes(data))
+        with pytest.raises(ValueError, match="checksum"):
+            BundleStore(out)
+
+    def test_driver_registry(self, policy_dir, tmp_path):
+        store = DiskStore(str(policy_dir))
+        out = str(tmp_path / "b.crbp")
+        build_bundle(store, out)
+        s = new_store({"driver": "bundle", "bundle": {"path": out}})
+        assert len(s.get_all()) == 1
+
+
+class TestBlobStore:
+    def test_file_bucket(self, policy_dir, tmp_path_factory):
+        work = tmp_path_factory.mktemp("blob-work")
+        s = new_store({"driver": "blob", "blob": {
+            "bucket": f"file://{policy_dir}", "workDir": str(work), "updatePollInterval": 0,
+        }})
+        assert len(s.get_all()) == 1
+        # update source, re-sync
+        (policy_dir / "doc2.yaml").write_text(POLICY.replace("doc", "doc2"))
+        os.utime(policy_dir / "doc2.yaml")
+        events = s.sync_and_compare()
+        assert any(e.policy_fqn.endswith("doc2.vdefault") for e in events)
+        s.close()
+
+
+class TestAuthZen:
+    @pytest.fixture()
+    def app_client(self, policy_dir, event_loop=None):
+        from aiohttp.test_utils import TestClient, TestServer
+        from aiohttp import web
+        from cerbos_tpu.server.authzen import AuthZenService
+        from cerbos_tpu.server.service import CerbosService
+
+        eng = Engine.from_policies(compile_policy_set(DiskStore(str(policy_dir)).get_all()))
+        svc = CerbosService(eng)
+        app = web.Application()
+        AuthZenService(svc).add_http_routes(app)
+        return app
+
+    def test_evaluation(self, app_client):
+        import asyncio
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def run():
+            async with TestClient(TestServer(app_client)) as client:
+                resp = await client.post("/access/v1/evaluation", json={
+                    "subject": {"type": "user", "id": "u", "properties": {"roles": ["user"]}},
+                    "resource": {"type": "doc", "id": "d", "properties": {"owner": "u"}},
+                    "action": {"name": "view"},
+                })
+                body = await resp.json()
+                assert body == {"decision": True}
+                resp2 = await client.post("/access/v1/evaluation", json={
+                    "subject": {"type": "user", "id": "x", "properties": {"roles": ["user"]}},
+                    "resource": {"type": "doc", "id": "d", "properties": {"owner": "u"}},
+                    "action": {"name": "view"},
+                })
+                assert (await resp2.json()) == {"decision": False}
+                conf = await client.get("/.well-known/authzen-configuration")
+                assert "access_evaluation_endpoint" in await conf.json()
+
+        asyncio.run(run())
+
+    app_client = app_client  # keep fixture name
+
+
+class TestPlayground:
+    def test_validate_and_evaluate(self):
+        import asyncio
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+        from cerbos_tpu.server.playground import PlaygroundService
+
+        app = web.Application()
+        PlaygroundService().add_http_routes(app)
+
+        async def run():
+            async with TestClient(TestServer(app)) as client:
+                ok = await client.post("/api/playground/validate", json={
+                    "playgroundId": "p1",
+                    "files": [{"fileName": "doc.yaml", "contents": POLICY}],
+                })
+                assert "success" in await ok.json()
+                bad = await client.post("/api/playground/validate", json={
+                    "playgroundId": "p2",
+                    "files": [{"fileName": "doc.yaml", "contents": POLICY.replace("expr: R.attr", "expr: ((R.attr")}],
+                })
+                assert "failure" in await bad.json()
+                ev = await client.post("/api/playground/evaluate", json={
+                    "playgroundId": "p3",
+                    "files": [{"fileName": "doc.yaml", "contents": POLICY}],
+                    "principal": {"id": "u", "roles": ["user"]},
+                    "resource": {"kind": "doc", "id": "d", "attr": {"owner": "u"}},
+                    "actions": ["view"],
+                })
+                body = await ev.json()
+                assert body["success"]["results"][0]["effect"] == "EFFECT_ALLOW"
+
+        asyncio.run(run())
+
+
+class TestTracer:
+    def test_traced_check(self, policy_dir):
+        from cerbos_tpu.ruletable import build_rule_table
+        from cerbos_tpu.tracer import traced_check
+
+        rt = build_rule_table(compile_policy_set(DiskStore(str(policy_dir)).get_all()))
+        out, rec = traced_check(rt, CheckInput(
+            principal=Principal(id="u", roles=["user"]),
+            resource=Resource(kind="doc", id="d", attr={"owner": "u"}),
+            actions=["view"],
+        ))
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        events = rec.to_json()
+        assert any(e.get("event", {}).get("status") == "ACTIVATED" for e in events)
+
+
+class TestTelemetry:
+    def test_opt_out(self, monkeypatch, tmp_path):
+        from cerbos_tpu.telemetry import Telemetry, telemetry_enabled
+
+        assert not telemetry_enabled({"disabled": True})
+        monkeypatch.setenv("DO_NOT_TRACK", "1")
+        assert not telemetry_enabled({"disabled": False})
+        monkeypatch.delenv("DO_NOT_TRACK")
+        assert telemetry_enabled({"disabled": False})
+        t = Telemetry({"disabled": False}, state_dir=str(tmp_path))
+        t.record("server_start")
+        assert t._events and t.instance_id
+        t.close()
+
+
+class TestObservability:
+    def test_spans_nest(self):
+        from cerbos_tpu import observability as obs
+
+        captured = []
+
+        class Cap(obs.SpanExporter):
+            def export(self, span, duration_ms):
+                captured.append((span.name, span.parent_id, span.trace_id))
+
+        obs.set_exporter(Cap())
+        with obs.start_span("outer") as outer:
+            with obs.start_span("inner"):
+                pass
+        obs.set_exporter(obs.SpanExporter())
+        names = [c[0] for c in captured]
+        assert names == ["inner", "outer"]
+        assert captured[0][1] == outer.span_id  # inner's parent
+        assert captured[0][2] == captured[1][2]  # same trace
+
+
+class TestCLI:
+    def test_compile_ok_and_fail(self, policy_dir, tmp_path):
+        env = {**os.environ, "PYTHONPATH": "/root/repo"}
+        r = subprocess.run([sys.executable, "-m", "cerbos_tpu.cli", "compile", str(policy_dir)],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stderr
+        bad_dir = tmp_path / "bad"
+        bad_dir.mkdir()
+        (bad_dir / "bad.yaml").write_text(POLICY.replace("expr: R.attr", "expr: (((R.attr"))
+        r2 = subprocess.run([sys.executable, "-m", "cerbos_tpu.cli", "compile", str(bad_dir)],
+                            capture_output=True, text=True, env=env)
+        assert r2.returncode == 3
+
+    def test_compile_runs_tests_exit_4(self, policy_dir):
+        (policy_dir / "doc_test.yaml").write_text(yaml.safe_dump({
+            "name": "S",
+            "tests": [{
+                "name": "t",
+                "input": {"principals": ["u1"], "resources": ["d1"], "actions": ["view"]},
+                "expected": [{"principal": "u1", "resource": "d1", "actions": {"view": "EFFECT_DENY"}}],
+            }],
+            "principals": {"u1": {"id": "u1", "roles": ["user"]}},
+            "resources": {"d1": {"kind": "doc", "id": "d1", "attr": {"owner": "u1"}}},
+        }))
+        env = {**os.environ, "PYTHONPATH": "/root/repo"}
+        r = subprocess.run([sys.executable, "-m", "cerbos_tpu.cli", "compile", str(policy_dir)],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 4, r.stdout + r.stderr
+
+    def test_compilestore_and_healthcheck(self, policy_dir, tmp_path):
+        env = {**os.environ, "PYTHONPATH": "/root/repo"}
+        out = str(tmp_path / "b.crbp")
+        r = subprocess.run([sys.executable, "-m", "cerbos_tpu.cli", "compilestore", str(policy_dir), "-o", out],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 0 and os.path.exists(out), r.stderr
+        r2 = subprocess.run([sys.executable, "-m", "cerbos_tpu.cli", "healthcheck", "--host-port", "127.0.0.1:1", "--timeout", "0.5"],
+                            capture_output=True, text=True, env=env)
+        assert r2.returncode == 1
+
+
+class TestEmbeddingSDK:
+    def test_embedded(self, policy_dir):
+        from cerbos_tpu.serve import embedded
+
+        pdp = embedded(policy_dir=str(policy_dir), overrides=["engine.tpu.enabled=false"])
+        out = pdp.check([CheckInput(
+            principal=Principal(id="u", roles=["user"]),
+            resource=Resource(kind="doc", id="d", attr={"owner": "u"}),
+            actions=["view"],
+        )])[0]
+        assert out.actions["view"].effect == "EFFECT_ALLOW"
+        pdp.close()
+
+    def test_serve(self, policy_dir):
+        import urllib.request
+
+        from cerbos_tpu.serve import serve
+
+        pdp = serve(overrides=[
+            f"storage.disk.directory={policy_dir}",
+            "server.httpListenAddr=127.0.0.1:0",
+            "server.grpcListenAddr=127.0.0.1:0",
+            "engine.tpu.enabled=false",
+        ])
+        try:
+            with urllib.request.urlopen(f"http://{pdp.http_addr}/_cerbos/health") as resp:
+                assert json.loads(resp.read())["status"] == "SERVING"
+        finally:
+            pdp.close()

@@ -116,3 +116,23 @@ class TestReviewRegressions:
 
         assert not _py_matches_glob("a", "a\n")
         assert not matches_glob("a", "a\n")
+
+
+class TestGlobBraceClassAgreement:
+    """Native and Python matchers must agree on '[' / ']' inside '{...}'."""
+
+    CASES = ["{a],b}", "{a[,b}", "{[a,b]x,c}", "{a[}b],c}", "{a\\,b,c}"]
+    VALS = ["a]", "b", "c", "ax", ",x", "a,b", "{a[,b}", "a}b]"]
+
+    def test_agreement(self, mod):
+        for pat in self.CASES:
+            for val in self.VALS:
+                assert mod.glob_match(pat, val) == _py_matches_glob(pat, val), (pat, val)
+
+    def test_fuzz_with_commas(self, mod):
+        rng = random.Random(123)
+        alphabet = "ab:,*?[]{}\\-!c"
+        for _ in range(3000):
+            pat = "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 10)))
+            val = "".join(rng.choice("ab:c,]") for _ in range(rng.randint(0, 8)))
+            assert mod.glob_match(pat, val) == _py_matches_glob(pat, val), (pat, val)

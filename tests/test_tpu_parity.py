@@ -227,7 +227,7 @@ resourcePolicy:
 """
 
 
-@pytest.mark.parametrize("use_jax", [False], ids=["numpy"])
+@pytest.mark.parametrize("use_jax", [False, True], ids=["numpy", "jax"])
 def test_negative_number_ordering_parity(use_jax):
     # regression: sign-biased (hi, lo) key encoding — comparisons must be
     # correct across the positive/negative double boundary
