@@ -24,10 +24,30 @@ BATCH = 4096
 ITERS = 8
 
 
+def _jax_available(timeout_s: float = 60.0) -> bool:
+    """Probe jax initialization in a subprocess; the axon tunnel can wedge
+    the whole process if probed in-process."""
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=timeout_s,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    use_jax = _jax_available()
+    if not use_jax:
+        print("WARNING: jax/TPU backend unavailable; benchmarking the numpy fallback", flush=True)
     policies = list(parse_policies(bench_corpus.corpus_yaml(N_MODS)))
     rt = build_rule_table(compile_policy_set(policies))
-    ev = TpuEvaluator(rt)
+    ev = TpuEvaluator(rt, use_jax=use_jax)
     params = EvalParams()
 
     inputs = bench_corpus.requests(BATCH, N_MODS)

@@ -32,8 +32,11 @@ class Core:
     schema_mgr: SchemaManager
     audit_log: Any
     tpu_evaluator: Any = None
+    batcher: Any = None
 
     def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()
         if self.audit_log is not None:
             self.audit_log.close()
         self.store.close()
@@ -58,9 +61,14 @@ def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
     tpu_conf = engine_conf.get("tpu", {})
     tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
     tpu_evaluator = None
+    dispatch_evaluator = None
+    batcher = None
     if tpu_enabled:
         from .tpu import TpuEvaluator
 
+        import os as _os
+
+        backend = _os.environ.get("CERBOS_TPU_BACKEND", tpu_conf.get("backend", "jax"))
         tpu_evaluator = TpuEvaluator(
             manager.rule_table,
             globals_=eval_params.globals,
@@ -68,15 +76,29 @@ def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
             max_roles=int(tpu_conf.get("maxRoles", 8)),
             max_candidates=int(tpu_conf.get("maxCandidates", 32)),
             max_depth=int(tpu_conf.get("maxDepth", 8)),
+            use_jax=backend != "numpy",
+            min_device_batch=int(tpu_conf.get("minDeviceBatch", 16)),
         )
         manager.evaluator_refresh_hook(tpu_evaluator)
+        dispatch_evaluator = tpu_evaluator
+        if tpu_conf.get("requestBatching", True):
+            from .engine.batcher import BatchingEvaluator
+
+            batcher = BatchingEvaluator(
+                tpu_evaluator,
+                max_batch=int(tpu_conf.get("maxBatch", 4096)),
+                max_wait_ms=float(tpu_conf.get("batchWindowMs", 2.0)),
+            )
+            dispatch_evaluator = batcher
 
     engine = Engine(
         manager.rule_table,
         schema_mgr=schema_mgr,
         eval_params=eval_params,
-        tpu_evaluator=tpu_evaluator,
-        tpu_batch_threshold=int(tpu_conf.get("batchThreshold", 5)),
+        tpu_evaluator=dispatch_evaluator,
+        # with cross-request batching every request goes through the batcher;
+        # otherwise small batches take the serial oracle path (engine.go:229-235)
+        tpu_batch_threshold=1 if batcher is not None else int(tpu_conf.get("batchThreshold", 5)),
     )
 
     # keep the engine pointed at the latest table after swaps
@@ -134,4 +156,5 @@ def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
         schema_mgr=schema_mgr,
         audit_log=audit_log,
         tpu_evaluator=tpu_evaluator,
+        batcher=batcher,
     )

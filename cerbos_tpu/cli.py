@@ -30,11 +30,14 @@ def cmd_server(args: argparse.Namespace) -> int:
 
         extra.append(PlaygroundService())
 
+    tls = server_conf.get("tls", {}) or {}
     server = Server(
         core.service,
         ServerConfig(
             http_listen_addr=server_conf.get("httpListenAddr", "0.0.0.0:3592"),
             grpc_listen_addr=server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
+            tls_cert=tls.get("cert", ""),
+            tls_key=tls.get("key", ""),
         ),
         admin_service=_admin(core, server_conf),
         extra_services=extra,

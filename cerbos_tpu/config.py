@@ -75,11 +75,16 @@ class Config:
 
     @classmethod
     def load(cls, path: Optional[str] = None, overrides: Optional[list[str]] = None) -> "Config":
+        import copy
+
         data: dict[str, Any] = {}
         if path:
             with open(path, encoding="utf-8") as f:
                 data = yaml.safe_load(f) or {}
-        data = _deep_merge(DEFAULTS, _interpolate(data))
+        # deep-copy the defaults: _deep_merge shares untouched subtrees with
+        # its inputs, and --set overrides mutate nested dicts in place — a
+        # shared DEFAULTS would leak overrides across Config.load calls
+        data = _deep_merge(copy.deepcopy(DEFAULTS), _interpolate(data))
         for expr in overrides or []:
             keys, value = _parse_set(expr)
             cur = data

@@ -1,0 +1,103 @@
+"""Request micro-batcher: packs concurrent requests into device batches.
+
+The north-star BatchEvaluator (BASELINE.json): the reference fans requests
+onto a goroutine pool (engine.go:74-144); here concurrent CheckResources
+calls enqueue and a batcher thread drains them into one padded device batch
+— request count amortizes the per-dispatch cost. Requests block on a future
+and get their slice of the batch output back.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+from . import types as T
+
+
+@dataclass
+class _Pending:
+    inputs: list[T.CheckInput]
+    params: Optional[T.EvalParams]
+    future: Future
+
+
+class BatchingEvaluator:
+    """Wraps a batch evaluator (TpuEvaluator) with cross-request batching."""
+
+    def __init__(
+        self,
+        evaluator: Any,
+        max_batch: int = 4096,
+        max_wait_ms: float = 2.0,
+        min_batch_to_wait: int = 2,
+    ):
+        self.evaluator = evaluator
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1000.0
+        self.min_batch_to_wait = min_batch_to_wait
+        self._queue: list[_Pending] = []
+        self._lock = threading.Lock()
+        self._wakeup = threading.Condition(self._lock)
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="check-batcher")
+        self._thread.start()
+        self.stats = {"batches": 0, "batched_requests": 0}
+
+    def check(self, inputs: Sequence[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
+        fut: Future = Future()
+        with self._wakeup:
+            self._queue.append(_Pending(list(inputs), params, fut))
+            self._wakeup.notify()
+        return fut.result()
+
+    def _loop(self) -> None:
+        while True:
+            with self._wakeup:
+                while not self._queue and not self._stop:
+                    self._wakeup.wait()
+                if self._stop:
+                    return
+                # small wait to let concurrent requests coalesce
+                if len(self._queue) < self.min_batch_to_wait and self.max_wait > 0:
+                    self._wakeup.wait(self.max_wait)
+                pending: list[_Pending] = []
+                total = 0
+                while self._queue and total < self.max_batch:
+                    p = self._queue[0]
+                    if pending and total + len(p.inputs) > self.max_batch:
+                        break
+                    pending.append(self._queue.pop(0))
+                    total += len(p.inputs)
+            self._run(pending)
+
+    def _run(self, pending: list[_Pending]) -> None:
+        # group by params identity (globals etc. must match within a batch)
+        groups: dict[int, list[_Pending]] = {}
+        for p in pending:
+            groups.setdefault(id(p.params), []).append(p)
+        for group in groups.values():
+            all_inputs: list[T.CheckInput] = []
+            for p in group:
+                all_inputs.extend(p.inputs)
+            try:
+                outputs = self.evaluator.check(all_inputs, group[0].params)
+            except Exception as e:  # noqa: BLE001
+                for p in group:
+                    p.future.set_exception(e)
+                continue
+            self.stats["batches"] += 1
+            self.stats["batched_requests"] += len(group)
+            offset = 0
+            for p in group:
+                p.future.set_result(outputs[offset : offset + len(p.inputs)])
+                offset += len(p.inputs)
+
+    def close(self) -> None:
+        with self._wakeup:
+            self._stop = True
+            self._wakeup.notify_all()
+        self._thread.join(timeout=5)

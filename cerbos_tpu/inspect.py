@@ -1,0 +1,147 @@
+"""Policy inspection: extract actions, attributes, variables, derived roles.
+
+Behavioral reference: internal/inspect — used by the Admin API
+(InspectPolicies) and cerbosctl to answer "what does this policy reference".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .cel import ast as A
+from .cel import parse as cel_parse
+from .cel.errors import CelParseError
+from .policy import model
+
+
+@dataclass
+class PolicyInspection:
+    policy_id: str
+    actions: list[str] = field(default_factory=list)
+    roles: list[str] = field(default_factory=list)
+    derived_roles: list[str] = field(default_factory=list)
+    imported_derived_roles: list[str] = field(default_factory=list)
+    principal_attributes: list[str] = field(default_factory=list)
+    resource_attributes: list[str] = field(default_factory=list)
+    variables: list[str] = field(default_factory=list)
+    constants: list[str] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "policyId": self.policy_id,
+            "actions": self.actions,
+            "roles": self.roles,
+            "derivedRoles": self.derived_roles,
+            "importedDerivedRoles": self.imported_derived_roles,
+            "attributes": (
+                [{"kind": "KIND_PRINCIPAL_ATTRIBUTE", "name": n} for n in self.principal_attributes]
+                + [{"kind": "KIND_RESOURCE_ATTRIBUTE", "name": n} for n in self.resource_attributes]
+            ),
+            "variables": [{"name": n, "kind": "KIND_LOCAL"} for n in self.variables],
+            "constants": [{"name": n, "kind": "KIND_LOCAL"} for n in self.constants],
+        }
+
+
+def _attrs_from_expr(src: str, principal: set[str], resource: set[str], variables: set[str]) -> None:
+    try:
+        node = cel_parse(src)
+    except CelParseError:
+        return
+    for n in A.walk(node):
+        if isinstance(n, A.Select):
+            op = n.operand
+            if isinstance(op, A.Select) and op.field == "attr":
+                root = op.operand
+                name = None
+                if isinstance(root, A.Ident):
+                    name = root.name
+                elif isinstance(root, A.Select) and isinstance(root.operand, A.Ident) and root.operand.name == "request":
+                    name = {"principal": "P", "resource": "R"}.get(root.field)
+                if name == "P":
+                    principal.add(n.field)
+                elif name == "R":
+                    resource.add(n.field)
+            elif isinstance(op, A.Ident) and op.name in ("V", "variables"):
+                variables.add(n.field)
+
+
+def _walk_condition(cond: Optional[model.Condition], principal: set, resource: set, variables: set) -> None:
+    if cond is None or cond.match is None:
+        return
+
+    def walk_match(m: model.Match) -> None:
+        if m.expr is not None:
+            _attrs_from_expr(m.expr, principal, resource, variables)
+        for children in (m.all, m.any, m.none):
+            if children:
+                for c in children:
+                    walk_match(c)
+
+    walk_match(cond.match)
+
+
+def inspect_policy(pol: model.Policy) -> PolicyInspection:
+    from . import namer
+
+    out = PolicyInspection(policy_id=namer.policy_key_from_fqn(pol.fqn()))
+    p_attrs: set[str] = set()
+    r_attrs: set[str] = set()
+    variables: set[str] = set()
+    actions: set[str] = set()
+    roles: set[str] = set()
+    drs: set[str] = set()
+    constants: set[str] = set()
+
+    def handle_variables(v: Optional[model.Variables], c: Optional[model.Constants]) -> None:
+        if v is not None:
+            for name, expr in v.local.items():
+                variables.add(name)
+                _attrs_from_expr(expr, p_attrs, r_attrs, variables)
+        if c is not None:
+            constants.update(c.local.keys())
+
+    if pol.resource_policy is not None:
+        rp = pol.resource_policy
+        handle_variables(rp.variables, rp.constants)
+        out.imported_derived_roles = sorted(rp.import_derived_roles)
+        for rule in rp.rules:
+            actions.update(rule.actions)
+            roles.update(rule.roles)
+            drs.update(rule.derived_roles)
+            _walk_condition(rule.condition, p_attrs, r_attrs, variables)
+    elif pol.principal_policy is not None:
+        pp = pol.principal_policy
+        handle_variables(pp.variables, pp.constants)
+        for rule in pp.rules:
+            for a in rule.actions:
+                actions.add(a.action)
+                _walk_condition(a.condition, p_attrs, r_attrs, variables)
+    elif pol.role_policy is not None:
+        rp2 = pol.role_policy
+        roles.add(rp2.role)
+        for rule in rp2.rules:
+            actions.update(rule.allow_actions)
+            _walk_condition(rule.condition, p_attrs, r_attrs, variables)
+    elif pol.derived_roles is not None:
+        dr = pol.derived_roles
+        handle_variables(dr.variables, dr.constants)
+        for d in dr.definitions:
+            drs.add(d.name)
+            roles.update(d.parent_roles)
+            _walk_condition(d.condition, p_attrs, r_attrs, variables)
+    elif pol.export_variables is not None:
+        for name, expr in pol.export_variables.definitions.items():
+            variables.add(name)
+            _attrs_from_expr(expr, p_attrs, r_attrs, variables)
+    elif pol.export_constants is not None:
+        constants.update(pol.export_constants.definitions.keys())
+
+    out.actions = sorted(actions)
+    out.roles = sorted(roles)
+    out.derived_roles = sorted(drs)
+    out.principal_attributes = sorted(p_attrs)
+    out.resource_attributes = sorted(r_attrs)
+    out.variables = sorted(variables)
+    out.constants = sorted(constants)
+    return out

@@ -60,6 +60,7 @@ class AdminService:
         app.router.add_delete("/admin/schema", self._h_delete_schema)
         app.router.add_get("/admin/store/reload", self._h_reload_store)
         app.router.add_get("/admin/auditlog/list/{kind}", self._h_audit_list)
+        app.router.add_post("/admin/policies/inspect", self._h_inspect)
 
     def grpc_handler(self):
         return None  # gRPC admin surface lands with the full admin proto set
@@ -192,6 +193,17 @@ class AdminService:
             if store.delete_schema(sid):
                 n += 1
         return web.json_response({"deletedSchemas": n})
+
+    async def _h_inspect(self, request: web.Request) -> web.Response:
+        if (resp := self._guard(request)) is not None:
+            return resp
+        from ..inspect import inspect_policy
+
+        results = {}
+        for pol in self.core.store.get_all():
+            insp = inspect_policy(pol)
+            results[insp.policy_id] = insp.to_json()
+        return web.json_response({"results": results})
 
     async def _h_reload_store(self, request: web.Request) -> web.Response:
         if (resp := self._guard(request)) is not None:

@@ -27,11 +27,23 @@ from .service import CerbosService, RequestLimitExceeded
 
 @dataclass
 class ServerConfig:
-    """Ref: internal/server/conf.go (default ports 3592/3593)."""
+    """Ref: internal/server/conf.go (default ports 3592/3593; TCP or UDS
+    listeners server.go:152-162; TLS server.go:219-268)."""
 
     http_listen_addr: str = "0.0.0.0:3592"
     grpc_listen_addr: str = "0.0.0.0:3593"
     max_workers: int = 16
+    tls_cert: str = ""
+    tls_key: str = ""
+
+    def ssl_context(self):
+        if not (self.tls_cert and self.tls_key):
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.tls_cert, self.tls_key)
+        return ctx
 
 
 def _grpc_handlers(svc: CerbosService):
@@ -171,7 +183,13 @@ class Server:
             handler = self.admin_service.grpc_handler()
             if handler is not None:
                 server.add_generic_rpc_handlers((handler,))
-        port = server.add_insecure_port(self.config.grpc_listen_addr)
+        addr = self.config.grpc_listen_addr  # "host:port" or "unix:/path"
+        if self.config.tls_cert and self.config.tls_key:
+            with open(self.config.tls_key, "rb") as kf, open(self.config.tls_cert, "rb") as cf:
+                creds = grpc.ssl_server_credentials(((kf.read(), cf.read()),))
+            port = server.add_secure_port(addr, creds)
+        else:
+            port = server.add_insecure_port(addr)
         self.grpc_port = port
         server.start()
         self._grpc_server = server
@@ -182,6 +200,9 @@ class Server:
         app = web.Application(client_max_size=16 * 1024 * 1024)
         app.router.add_post("/api/check/resources", self._h_check_resources)
         app.router.add_post("/api/plan/resources", self._h_plan_resources)
+        # deprecated APIs kept for older SDKs (ref: cerbos_svc.go:123-252)
+        app.router.add_post("/api/check", self._h_check_resource_set)
+        app.router.add_post("/api/x/check_resource_batch", self._h_check_resource_batch)
         app.router.add_get("/_cerbos/health", self._h_health)
         app.router.add_get("/_cerbos/metrics", self._h_metrics)
         app.router.add_get("/api/server_info", self._h_server_info)
@@ -231,8 +252,108 @@ class Server:
             if aux_j.get("token"):
                 aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
             inputs, request_id, include_meta = convert.json_to_check_inputs(body, aux)
-            outputs, call_id = self.svc.check_resources(inputs)
+            outputs, call_id = await asyncio.get_running_loop().run_in_executor(
+                None, self.svc.check_resources, inputs
+            )
             return web.json_response(convert.outputs_to_json(body, outputs, request_id, include_meta, call_id))
+        except RequestLimitExceeded as e:
+            return web.json_response({"code": 3, "message": str(e)}, status=400)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"code": 13, "message": f"check failed: {e}"}, status=500)
+
+    async def _h_check_resource_set(self, request: web.Request) -> web.Response:
+        """Deprecated CheckResourceSet: one resource kind, instance map."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        try:
+            rs = body.get("resource") or {}
+            instances = rs.get("instances") or {}
+            actions = list(body.get("actions", []))
+            inner = {
+                "requestId": body.get("requestId", ""),
+                "includeMeta": bool(body.get("includeMeta", False)),
+                "principal": body.get("principal") or {},
+                "resources": [
+                    {
+                        "actions": actions,
+                        "resource": {
+                            "kind": rs.get("kind", ""),
+                            "policyVersion": rs.get("policyVersion", ""),
+                            "scope": rs.get("scope", ""),
+                            "id": rid,
+                            "attr": (inst or {}).get("attr", {}) or {},
+                        },
+                    }
+                    for rid, inst in instances.items()
+                ],
+            }
+            aux = None
+            aux_j = (body.get("auxData") or {}).get("jwt") or {}
+            if aux_j.get("token"):
+                aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
+            inputs, request_id, include_meta = convert.json_to_check_inputs(inner, aux)
+            outputs, call_id = await asyncio.get_running_loop().run_in_executor(
+                None, self.svc.check_resources, inputs
+            )
+            resource_instances = {}
+            for entry, out in zip(inner["resources"], outputs):
+                resource_instances[entry["resource"]["id"]] = {
+                    "actions": {a: ae.effect for a, ae in out.actions.items()}
+                }
+            resp: dict = {"requestId": request_id, "resourceInstances": resource_instances, "cerbosCallId": call_id}
+            if include_meta:
+                resp["meta"] = {
+                    "resourceInstances": {
+                        entry["resource"]["id"]: {
+                            "actions": {
+                                a: {"matchedPolicy": ae.policy, "matchedScope": ae.scope}
+                                for a, ae in out.actions.items()
+                            },
+                            "effectiveDerivedRoles": out.effective_derived_roles,
+                        }
+                        for entry, out in zip(inner["resources"], outputs)
+                    }
+                }
+            return web.json_response(resp)
+        except RequestLimitExceeded as e:
+            return web.json_response({"code": 3, "message": str(e)}, status=400)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"code": 13, "message": f"check failed: {e}"}, status=500)
+
+    async def _h_check_resource_batch(self, request: web.Request) -> web.Response:
+        """Deprecated CheckResourceBatch: per-resource action lists."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        try:
+            aux = None
+            aux_j = (body.get("auxData") or {}).get("jwt") or {}
+            if aux_j.get("token"):
+                aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
+            inputs, request_id, _ = convert.json_to_check_inputs(body, aux)
+            outputs, call_id = await asyncio.get_running_loop().run_in_executor(
+                None, self.svc.check_resources, inputs
+            )
+            return web.json_response(
+                {
+                    "requestId": request_id,
+                    "cerbosCallId": call_id,
+                    "results": [
+                        {
+                            "resourceId": out.resource_id,
+                            "actions": {a: ae.effect for a, ae in out.actions.items()},
+                            "validationErrors": [
+                                {"path": v.path, "message": v.message, "source": v.source}
+                                for v in out.validation_errors
+                            ] or None,
+                        }
+                        for out in outputs
+                    ],
+                }
+            )
         except RequestLimitExceeded as e:
             return web.json_response({"code": 3, "message": str(e)}, status=400)
         except Exception as e:  # noqa: BLE001
@@ -248,7 +369,9 @@ class Server:
             aux_j = (body.get("auxData") or {}).get("jwt") or {}
             if aux_j.get("token"):
                 aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
-            resp, _call_id = _plan_from_json(self.svc, body, aux)
+            resp, _call_id = await asyncio.get_running_loop().run_in_executor(
+                None, _plan_from_json, self.svc, body, aux
+            )
             return web.json_response(resp)
         except NotImplementedError as e:
             return web.json_response({"code": 12, "message": str(e)}, status=501)
@@ -269,11 +392,17 @@ class Server:
             self._loop = loop
             runner = web.AppRunner(self._http_app())
             loop.run_until_complete(runner.setup())
-            host, _, port = self.config.http_listen_addr.rpartition(":")
-            site = web.TCPSite(runner, host or "0.0.0.0", int(port))
+            addr = self.config.http_listen_addr
+            ssl_ctx = self.config.ssl_context()
+            if addr.startswith("unix:"):
+                site: web.BaseSite = web.UnixSite(runner, addr[len("unix:"):], ssl_context=ssl_ctx)
+            else:
+                host, _, port = addr.rpartition(":")
+                site = web.TCPSite(runner, host or "0.0.0.0", int(port), ssl_context=ssl_ctx)
             loop.run_until_complete(site.start())
-            for s in runner.sites:
-                self.http_port = s._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+            if not addr.startswith("unix:"):
+                for s in runner.sites:
+                    self.http_port = s._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
             self._http_runner = runner
             started.set()
             loop.run_forever()

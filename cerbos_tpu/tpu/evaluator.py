@@ -266,12 +266,14 @@ class TpuEvaluator:
         max_candidates: int = 32,
         max_depth: int = 8,
         use_jax: bool = True,
+        min_device_batch: int = 16,
     ):
         self.rule_table = rule_table
         self.schema_mgr = schema_mgr
         self.lowered = lower_table(rule_table, globals_)
         self.packer = Packer(self.lowered, max_roles=max_roles, max_candidates=max_candidates, max_depth=max_depth)
         self.use_jax = use_jax
+        self.min_device_batch = min_device_batch
         self.stats = {"device_inputs": 0, "oracle_inputs": 0, "trivial_inputs": 0}
         self._jit_cache: dict = {}
         self._dr_table_cache: dict = {}
@@ -287,6 +289,11 @@ class TpuEvaluator:
 
     def check(self, inputs: list[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
         params = params or T.EvalParams()
+        if len(inputs) < self.min_device_batch:
+            # device dispatch has a fixed cost; tiny batches are faster on
+            # the serial oracle (the reference's parallelismThreshold analogue)
+            self.stats["oracle_inputs"] += len(inputs)
+            return [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
         batch = self.packer.pack(inputs, params)
         final, role_results, win_j, sat_cond = _device_eval(
             self.lowered, batch, use_jax=self.use_jax, jit_cache=self._jit_cache

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""End-to-end load test: drive a running PDP's CheckResources API.
+
+Behavioral reference: hack/loadtest (ghz-driven gRPC load with the classic
+policy corpus; throughput probe then a sustained run). This harness spawns
+the server, generates the classic-like corpus, and reports RPS + latency
+percentiles the way the reference's reports do (loadtest-classic.md).
+
+Usage:
+    python loadtest/loadtest.py [--duration 30] [--connections 8] [--grpc]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def generate_policies(policy_dir: str, n_mods: int) -> None:
+    # one policy per file, as the reference's dir index expects
+    from cerbos_tpu.util import bench_corpus
+
+    docs = bench_corpus.corpus_yaml(n_mods).split("\n---\n")
+    for i, doc in enumerate(docs):
+        with open(os.path.join(policy_dir, f"policy_{i:05d}.yaml"), "w") as f:
+            f.write(doc)
+
+
+def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool) -> dict:
+    from cerbos_tpu.serve import serve
+    from cerbos_tpu.util import bench_corpus
+
+    tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
+    generate_policies(tmp, n_mods)
+    pdp = serve(overrides=[
+        f"storage.disk.directory={tmp}",
+        "server.httpListenAddr=127.0.0.1:0",
+        "server.grpcListenAddr=127.0.0.1:0",
+        f"engine.tpu.enabled={'true' if use_tpu else 'false'}",
+    ], use_tpu=use_tpu if use_tpu else None)
+
+    inputs = bench_corpus.requests(512, n_mods)
+    bodies = []
+    for i in inputs:
+        bodies.append(json.dumps({
+            "requestId": i.request_id,
+            "principal": {"id": i.principal.id, "roles": i.principal.roles,
+                          "policyVersion": i.principal.policy_version, "attr": i.principal.attr},
+            "resources": [{"actions": i.actions,
+                           "resource": {"kind": i.resource.kind, "id": i.resource.id,
+                                        "policyVersion": i.resource.policy_version, "attr": i.resource.attr}}],
+        }).encode())
+
+    latencies: list[float] = []
+    counts = [0] * connections
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def http_worker(wid: int) -> None:
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", pdp.server.http_port)
+        local_lat = []
+        n = 0
+        while not stop.is_set():
+            body = bodies[(wid + n) % len(bodies)]
+            t0 = time.perf_counter()
+            conn.request("POST", "/api/check/resources", body, {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            local_lat.append((time.perf_counter() - t0) * 1000)
+            n += 1
+        counts[wid] = n
+        with lock:
+            latencies.extend(local_lat)
+
+    workers = [threading.Thread(target=http_worker, args=(w,), daemon=True) for w in range(connections)]
+    t_start = time.perf_counter()
+    for w in workers:
+        w.start()
+    time.sleep(duration)
+    stop.set()
+    for w in workers:
+        w.join(timeout=10)
+    elapsed = time.perf_counter() - t_start
+    pdp.close()
+
+    total = sum(counts)
+    lat = sorted(latencies)
+
+    def pct(p: float) -> float:
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+    return {
+        "requests": total,
+        "rps": round(total / elapsed, 1),
+        "decisions_per_sec": round(total * 2 / elapsed, 1),  # 2 actions/request
+        "p50_ms": round(pct(0.50), 2),
+        "p95_ms": round(pct(0.95), 2),
+        "p99_ms": round(pct(0.99), 2),
+        "connections": connections,
+        "policies": n_mods * 4,
+        "duration_s": round(elapsed, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=15.0)
+    ap.add_argument("--connections", type=int, default=8)
+    ap.add_argument("--mods", type=int, default=200, help="policy name-mods (x4 policies each)")
+    ap.add_argument("--grpc", action="store_true")
+    ap.add_argument("--tpu", action="store_true", help="enable the TPU engine path")
+    args = ap.parse_args()
+    result = run(args.duration, args.connections, args.mods, args.grpc, args.tpu)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -227,3 +227,81 @@ class TestAdmin:
             time.sleep(0.1)
         assert entries, "no decision log entries recorded"
         assert entries[0]["kind"] == "decision"
+
+
+class TestDeprecatedAPIs:
+    def test_check_resource_set(self, server):
+        resp = http_post(server, "/api/check", {
+            "requestId": "set-1",
+            "actions": ["view"],
+            "principal": {"id": "alice", "roles": ["user"]},
+            "resource": {
+                "kind": "album",
+                "instances": {"a1": {"attr": {"owner": "alice"}}, "a2": {"attr": {"owner": "bob"}}},
+            },
+            "includeMeta": True,
+        })
+        insts = resp["resourceInstances"]
+        assert insts["a1"]["actions"]["view"] == "EFFECT_ALLOW"
+        assert insts["a2"]["actions"]["view"] == "EFFECT_DENY"
+        assert resp["meta"]["resourceInstances"]["a1"]["actions"]["view"]["matchedPolicy"] == "resource.album.vdefault"
+
+    def test_check_resource_batch(self, server):
+        resp = http_post(server, "/api/x/check_resource_batch", {
+            "requestId": "batch-1",
+            "principal": {"id": "alice", "roles": ["user"]},
+            "resources": [
+                {"actions": ["view"], "resource": {"kind": "album", "id": "a1", "attr": {"owner": "alice"}}},
+            ],
+        })
+        assert resp["results"][0]["actions"]["view"] == "EFFECT_ALLOW"
+
+
+class TestInspect:
+    AUTH = {"Authorization": "Basic " + __import__("base64").b64encode(b"cerbos:cerbosAdmin").decode()}
+
+    def test_inspect_policies(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.http_port}/admin/policies/inspect",
+            data=b"{}", headers={"Content-Type": "application/json", **self.AUTH}, method="POST",
+        )
+        with urllib.request.urlopen(req) as resp:
+            body = json.loads(resp.read())
+        insp = body["results"]["resource.album.vdefault"]
+        assert "view" in insp["actions"]
+        attrs = {a["name"] for a in insp["attributes"]}
+        assert {"owner", "public"} <= attrs
+
+
+class TestRequestBatching:
+    def test_batched_serving(self, tmp_path_factory):
+        """Concurrent requests coalesce into device batches (numpy backend)."""
+        import concurrent.futures
+
+        policy_dir = tmp_path_factory.mktemp("batch-policies")
+        (policy_dir / "album.yaml").write_text(POLICY)
+        config = Config.load(overrides=[
+            f"storage.disk.directory={policy_dir}",
+        ])
+        core = initialize(config)  # tpu enabled (numpy fallback inside evaluator when jax off)
+        core.tpu_evaluator.use_jax = False  # force numpy path for the test env
+        try:
+            def one(i):
+                from cerbos_tpu.engine import CheckInput, Principal, Resource
+
+                out = core.engine.check([CheckInput(
+                    principal=Principal(id=f"u{i}", roles=["user"]),
+                    resource=Resource(kind="album", id=f"a{i}", attr={"owner": f"u{i}"}),
+                    actions=["view"],
+                )])[0]
+                return out.actions["view"].effect
+
+            with concurrent.futures.ThreadPoolExecutor(max_workers=16) as pool:
+                results = list(pool.map(one, range(64)))
+            assert all(r == "EFFECT_ALLOW" for r in results)
+            assert core.batcher is not None
+            assert core.batcher.stats["batches"] >= 1
+            # at least some coalescing happened
+            assert core.batcher.stats["batched_requests"] == 64
+        finally:
+            core.close()

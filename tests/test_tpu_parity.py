@@ -20,7 +20,7 @@ import test_engine_check as corpus
 
 def assert_parity(rule_table, inputs, params=None, use_jax=False):
     params = params or EvalParams()
-    ev = TpuEvaluator(rule_table, globals_=params.globals, use_jax=use_jax)
+    ev = TpuEvaluator(rule_table, globals_=params.globals, use_jax=use_jax, min_device_batch=0)
     got = ev.check(inputs, params)
     want = [check_input(rule_table, i, params) for i in inputs]
     for i, (g, w) in enumerate(zip(got, want)):
