@@ -15,6 +15,7 @@ from .ast import Bind, Call, Comprehension, Ident, Index, ListLit, Lit, MapLit, 
 from .errors import CelError, no_such_key, no_such_overload
 from .stdlib import FUNCTIONS, METHODS
 from . import cerbos_lib  # noqa: F401  (registers cerbos functions on import)
+from . import spiffe  # noqa: F401  (registers SPIFFE functions on import)
 from .values import (
     Duration,
     Timestamp,

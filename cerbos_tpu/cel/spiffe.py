@@ -1,0 +1,189 @@
+"""SPIFFE ID support for CEL conditions.
+
+Behavioral reference: internal/conditions/types/spiffe.go — spiffeID(),
+spiffeTrustDomain(), matchers (spiffeMatchAny/Exact/OneOf/TrustDomain),
+member methods id.isMemberOf(td) / id.path() / id.trustDomain() /
+td.id() / td.name() / matcher.matchesID(id|string).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .errors import CelError, no_such_overload
+from .stdlib import _as_list, _as_str, func, method
+
+
+import re as _re
+
+# go-spiffe charsets: trust domains are lowercase-only; path segments are
+# restricted and must not be empty, '.' or '..'
+_TD_RX = _re.compile(r"^[a-z0-9._-]+$")
+_SEG_RX = _re.compile(r"^[a-zA-Z0-9._-]+$")
+
+
+def _validate_td(name: str, uri: str) -> str:
+    if not name or not _TD_RX.match(name):
+        raise CelError(f"invalid SPIFFE trust domain in {uri!r}")
+    return name
+
+
+def _validate_path(path: str, uri: str) -> str:
+    if not path:
+        return ""
+    for seg in path.split("/"):
+        if seg in ("", ".", "..") or not _SEG_RX.match(seg):
+            raise CelError(f"invalid SPIFFE ID path in {uri!r}")
+    return f"/{path}"
+
+
+class SpiffeID:
+    __slots__ = ("trust_domain", "path")
+
+    def __init__(self, uri: str):
+        if not uri.startswith("spiffe://"):
+            raise CelError(f"invalid SPIFFE ID {uri!r}: scheme must be spiffe://")
+        rest = uri[len("spiffe://"):]
+        td, _, path = rest.partition("/")
+        # go-spiffe rejects (not normalizes) malformed IDs — fail closed
+        self.trust_domain = _validate_td(td, uri)
+        self.path = _validate_path(path, uri)
+
+    def uri(self) -> str:
+        return f"spiffe://{self.trust_domain}{self.path}"
+
+    def cel_type_name(self) -> str:
+        return "cerbos.lib.spiffeID"
+
+    def cel_equals(self, other: Any) -> bool:
+        # the reference compares SPIFFE IDs against strings by URI
+        if isinstance(other, str):
+            return other == self.uri()
+        return isinstance(other, SpiffeID) and other.uri() == self.uri()
+
+
+class SpiffeTrustDomain:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        # accepts a bare name or a full spiffe:// URI (path discarded),
+        # matching go-spiffe TrustDomainFromString
+        if name.startswith("spiffe://"):
+            name = name[len("spiffe://"):].partition("/")[0]
+        self.name = _validate_td(name, name)
+
+    def id_uri(self) -> str:
+        return f"spiffe://{self.name}"
+
+    def cel_type_name(self) -> str:
+        return "cerbos.lib.spiffeTrustDomain"
+
+    def cel_equals(self, other: Any) -> bool:
+        return isinstance(other, SpiffeTrustDomain) and other.name == self.name
+
+
+class SpiffeMatcher:
+    __slots__ = ("kind", "arg")
+
+    def __init__(self, kind: str, arg: Any = None):
+        self.kind = kind  # any | exact | oneof | trustdomain
+        self.arg = arg
+
+    def matches(self, sid: SpiffeID) -> bool:
+        if self.kind == "any":
+            return True
+        if self.kind == "exact":
+            return sid.uri() == self.arg.uri()
+        if self.kind == "oneof":
+            return any(sid.uri() == x.uri() for x in self.arg)
+        if self.kind == "trustdomain":
+            return sid.trust_domain == self.arg.name
+        return False
+
+    def cel_type_name(self) -> str:
+        return "cerbos.lib.spiffeMatcher"
+
+
+def _as_spiffe_id(v: Any, fn: str) -> SpiffeID:
+    if isinstance(v, SpiffeID):
+        return v
+    if isinstance(v, str):
+        return SpiffeID(v)
+    raise no_such_overload(fn, v)
+
+
+@func("spiffeID")
+def _f_spiffe_id(args, ctx):
+    return SpiffeID(_as_str(args[0], "spiffeID"))
+
+
+@func("spiffeTrustDomain")
+def _f_spiffe_td(args, ctx):
+    v = args[0]
+    if isinstance(v, SpiffeID):
+        return SpiffeTrustDomain(v.trust_domain)
+    return SpiffeTrustDomain(_as_str(v, "spiffeTrustDomain"))
+
+
+@func("spiffeMatchAny")
+def _f_match_any(args, ctx):
+    return SpiffeMatcher("any")
+
+
+@func("spiffeMatchExact")
+def _f_match_exact(args, ctx):
+    return SpiffeMatcher("exact", _as_spiffe_id(args[0], "spiffeMatchExact"))
+
+
+@func("spiffeMatchOneOf")
+def _f_match_oneof(args, ctx):
+    ids = [_as_spiffe_id(x, "spiffeMatchOneOf") for x in _as_list(args[0], "spiffeMatchOneOf")]
+    return SpiffeMatcher("oneof", ids)
+
+
+@func("spiffeMatchTrustDomain")
+def _f_match_td(args, ctx):
+    v = args[0]
+    td = v if isinstance(v, SpiffeTrustDomain) else SpiffeTrustDomain(_as_str(v, "spiffeMatchTrustDomain"))
+    return SpiffeMatcher("trustdomain", td)
+
+
+@method("isMemberOf")
+def _m_is_member_of(t, args, ctx):
+    sid = _as_spiffe_id(t, "isMemberOf")
+    td = args[0]
+    if not isinstance(td, SpiffeTrustDomain):
+        raise no_such_overload("isMemberOf", td)
+    return sid.trust_domain == td.name
+
+
+@method("path")
+def _m_path(t, args, ctx):
+    return _as_spiffe_id(t, "path").path
+
+
+@method("trustDomain")
+def _m_trust_domain(t, args, ctx):
+    return SpiffeTrustDomain(_as_spiffe_id(t, "trustDomain").trust_domain)
+
+
+@method("matchesID")
+def _m_matches_id(t, args, ctx):
+    if not isinstance(t, SpiffeMatcher):
+        raise no_such_overload("matchesID", t)
+    return t.matches(_as_spiffe_id(args[0], "matchesID"))
+
+
+@method("name")
+def _m_name(t, args, ctx):
+    if isinstance(t, SpiffeTrustDomain):
+        return t.name
+    raise no_such_overload("name", t)
+
+
+@method("id")
+def _m_id(t, args, ctx):
+    if isinstance(t, SpiffeTrustDomain):
+        # the reference returns the ID *string* (td.IDString()), not an ID value
+        return t.id_uri()
+    raise no_such_overload("id", t)

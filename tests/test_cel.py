@@ -370,3 +370,33 @@ def test_bytes_unicode_escape_rejected():
         parse('b"\\U000000e9"')
     # plain unicode characters in bytes literals are fine (UTF-8 encoded)
     assert evaluate(parse('b"é"'), Activation({})) == "é".encode()
+
+
+class TestSpiffe:
+    def test_spiffe_ids(self):
+        assert ev("spiffeID('spiffe://example.org/workload').path()") == "/workload"
+        assert ev("spiffeID('spiffe://example.org/w').trustDomain().name()") == "example.org"
+        assert ev("spiffeID('spiffe://example.org/w').isMemberOf(spiffeTrustDomain('example.org'))") is True
+        assert ev("spiffeID('spiffe://other.org/w').isMemberOf(spiffeTrustDomain('example.org'))") is False
+        # string equality by URI, td from full URI, td.id() is a string
+        assert ev("spiffeID('spiffe://a.b/c') == 'spiffe://a.b/c'") is True
+        assert ev("spiffeTrustDomain('spiffe://example.org/workload').name()") == "example.org"
+        assert ev("spiffeTrustDomain(spiffeID('spiffe://a.b/c')).name()") == "a.b"
+        assert ev("spiffeTrustDomain('a.b').id() == 'spiffe://a.b'") is True
+
+    def test_spiffe_matchers(self):
+        assert ev("spiffeMatchAny().matchesID(spiffeID('spiffe://a.b/c'))") is True
+        assert ev("spiffeMatchExact(spiffeID('spiffe://a.b/c')).matchesID('spiffe://a.b/c')") is True
+        assert ev("spiffeMatchExact(spiffeID('spiffe://a.b/c')).matchesID('spiffe://a.b/d')") is False
+        assert ev("spiffeMatchOneOf(['spiffe://a.b/c', 'spiffe://a.b/d']).matchesID('spiffe://a.b/d')") is True
+        assert ev("spiffeMatchTrustDomain('a.b').matchesID('spiffe://a.b/zzz')") is True
+        assert ev("spiffeMatchTrustDomain('a.b').matchesID('spiffe://x.y/zzz')") is False
+
+    def test_invalid_spiffe(self):
+        # malformed IDs fail closed, matching go-spiffe validation
+        for bad in ["'http://nope'", "'spiffe://Example.Org/w'", "'spiffe://a.b/c/../d'",
+                    "'spiffe://a.b//x'", "'spiffe://a b/c'", "'spiffe://a.b/c/'"]:
+            with pytest.raises(CelError):
+                ev(f"spiffeID({bad})")
+        with pytest.raises(CelError):
+            ev("spiffeTrustDomain('Upper.Case')")

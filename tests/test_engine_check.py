@@ -475,3 +475,93 @@ def test_delete_role_policy_removes_parent_inheritance():
     eng.rule_table.delete_policy("cerbos.role.intern.vdefault/acme")
     out2 = check_one(eng, P(id="i1", roles=["intern"]), R(kind="doc", scope="acme"), ["view"])
     assert out2.actions["view"].effect == "EFFECT_DENY"
+
+
+class TestDefaultVersionAndScopeParams:
+    POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: gadget
+  version: beta
+  scope: acme
+  rules:
+    - actions: ["use"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+"""
+
+    def test_default_policy_version_param(self):
+        eng = make_engine(self.POLICIES)
+        # version unset on the request resolves via defaultPolicyVersion
+        out = check_one(
+            eng, P(id="u", roles=["user"]), R(kind="gadget", scope="acme"), ["use"],
+            params=EvalParams(default_policy_version="beta"),
+        )
+        assert out.actions["use"].effect == "EFFECT_ALLOW"
+        out2 = check_one(eng, P(id="u", roles=["user"]), R(kind="gadget", scope="acme"), ["use"])
+        assert out2.actions["use"].policy == "NO_MATCH"
+
+    def test_default_scope_param(self):
+        eng = make_engine(self.POLICIES)
+        out = check_one(
+            eng, P(id="u", roles=["user"]), R(kind="gadget"), ["use"],
+            params=EvalParams(default_policy_version="beta", default_scope="acme"),
+        )
+        assert out.actions["use"].effect == "EFFECT_ALLOW"
+
+    def test_lenient_vs_strict_scope(self):
+        eng = make_engine(self.POLICIES)
+        strict = check_one(
+            eng, P(id="u", roles=["user"]), R(kind="gadget", scope="acme.sub.deep"), ["use"],
+            params=EvalParams(default_policy_version="beta"),
+        )
+        assert strict.actions["use"].policy == "NO_MATCH"
+        lenient = check_one(
+            eng, P(id="u", roles=["user"]), R(kind="gadget", scope="acme.sub.deep"), ["use"],
+            params=EvalParams(default_policy_version="beta", lenient_scope_search=True),
+        )
+        assert lenient.actions["use"].effect == "EFFECT_ALLOW"
+        assert lenient.actions["use"].scope == "acme"
+
+
+class TestExportedConstantsChain:
+    POLICIES = """
+apiVersion: api.cerbos.dev/v1
+exportConstants:
+  name: limits
+  definitions:
+    max_size: 100
+    env: prod
+---
+apiVersion: api.cerbos.dev/v1
+exportVariables:
+  name: shared_vars
+  definitions:
+    oversized: R.attr.size > C.max_size
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: upload
+  version: default
+  variables:
+    import: [shared_vars]
+  constants:
+    import: [limits]
+  rules:
+    - actions: ["store"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          all:
+            of:
+              - expr: "!V.oversized"
+              - expr: C.env == "prod"
+"""
+
+    def test_imported_constants_in_imported_variables(self):
+        eng = make_engine(self.POLICIES)
+        ok = check_one(eng, P(id="u", roles=["user"]), R(kind="upload", attr={"size": 50}), ["store"])
+        assert ok.actions["store"].effect == "EFFECT_ALLOW"
+        no = check_one(eng, P(id="u", roles=["user"]), R(kind="upload", attr={"size": 500}), ["store"])
+        assert no.actions["store"].effect == "EFFECT_DENY"
