@@ -1,7 +1,28 @@
 import os
+import sys
 
 # Tests run on a virtual 8-device CPU mesh; the real TPU is exercised by
 # bench.py and the driver's dryrun_multichip.
+#
+# The axon TPU plugin (PYTHONPATH=/root/.axon_site, hooked via a .pth at
+# interpreter startup) initializes its backend inside every jax.backends()
+# call even under JAX_PLATFORMS=cpu, and hangs indefinitely when the TPU
+# tunnel is unreachable. Tests never need the real chip, so when the plugin
+# is present we re-exec pytest once with it scrubbed from the environment.
+_MARKER = "CERBOS_TPU_TESTS_REEXECED"
+if (
+    _MARKER not in os.environ
+    and any(".axon_site" in p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep))
+):
+    env = dict(os.environ)
+    env[_MARKER] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p and ".axon_site" not in p
+    ) or os.getcwd()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
