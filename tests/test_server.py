@@ -305,3 +305,68 @@ class TestRequestBatching:
             assert core.batcher.stats["batched_requests"] == 64
         finally:
             core.close()
+
+
+class TestListeners:
+    def test_tls(self, tmp_path_factory):
+        import ssl
+        import subprocess
+
+        tmp = tmp_path_factory.mktemp("tls")
+        cert, key = str(tmp / "cert.pem"), str(tmp / "key.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "1", "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        policy_dir = tmp_path_factory.mktemp("tls-policies")
+        (policy_dir / "album.yaml").write_text(POLICY)
+        config = Config.load(overrides=[
+            f"storage.disk.directory={policy_dir}", "engine.tpu.enabled=false",
+        ])
+        core = initialize(config, use_tpu=False)
+        srv = Server(core.service, ServerConfig(
+            http_listen_addr="127.0.0.1:0", grpc_listen_addr="127.0.0.1:0",
+            tls_cert=cert, tls_key=key,
+        ))
+        srv.start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            req = urllib.request.Request(f"https://127.0.0.1:{srv.http_port}/_cerbos/health")
+            with urllib.request.urlopen(req, context=ctx) as resp:
+                assert json.loads(resp.read())["status"] == "SERVING"
+        finally:
+            srv.stop()
+            core.close()
+
+    def test_unix_socket_grpc(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("uds")
+        sock = str(tmp / "cerbos.sock")
+        policy_dir = tmp_path_factory.mktemp("uds-policies")
+        (policy_dir / "album.yaml").write_text(POLICY)
+        config = Config.load(overrides=[
+            f"storage.disk.directory={policy_dir}", "engine.tpu.enabled=false",
+        ])
+        core = initialize(config, use_tpu=False)
+        srv = Server(core.service, ServerConfig(
+            http_listen_addr="127.0.0.1:0", grpc_listen_addr=f"unix:{sock}",
+        ))
+        srv.start()
+        try:
+            from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+            from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+
+            channel = grpc.insecure_channel(f"unix:{sock}")
+            stub = channel.unary_unary(
+                "/cerbos.svc.v1.CerbosService/ServerInfo",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=response_pb2.ServerInfoResponse.FromString,
+            )
+            resp = stub(request_pb2.ServerInfoRequest(), timeout=10)
+            assert "cerbos-tpu" in resp.version
+            channel.close()
+        finally:
+            srv.stop()
+            core.close()
