@@ -100,7 +100,102 @@ def _grpc_handlers(svc: CerbosService):
         info = svc.server_info()
         return response_pb2.ServerInfoResponse(version=info["version"], commit=info["commit"], build_date=info["buildDate"])
 
+    def check_resource_set(req: request_pb2.CheckResourceSetRequest, ctx: grpc.ServicerContext):
+        if not req.resource.instances:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "at least one resource instance must be specified")
+        try:
+            aux = None
+            if req.HasField("aux_data") and req.aux_data.jwt.token:
+                aux = svc._extract_aux_data(req.aux_data.jwt.token, req.aux_data.jwt.key_set_id)
+            principal = convert.principal_from_proto(req.principal)
+            inputs = []
+            rids = []
+            for rid, inst in req.resource.instances.items():
+                rids.append(rid)
+                inputs.append(T.CheckInput(
+                    request_id=req.request_id,
+                    principal=principal,
+                    resource=T.Resource(
+                        kind=req.resource.kind,
+                        id=rid,
+                        attr={k: convert.value_to_py(v) for k, v in inst.attr.items()},
+                        policy_version=req.resource.policy_version,
+                        scope=req.resource.scope,
+                    ),
+                    actions=list(req.actions),
+                    aux_data=aux,
+                ))
+            outputs, call_id = svc.check_resources(inputs)
+            resp = response_pb2.CheckResourceSetResponse(request_id=req.request_id, cerbos_call_id=call_id)
+            from ..api.cerbos.effect.v1 import effect_pb2
+
+            for rid, out in zip(rids, outputs):
+                inst_out = resp.resource_instances[rid]
+                for action, ae in out.actions.items():
+                    inst_out.actions[action] = convert._EFFECT_TO_ENUM.get(ae.effect, effect_pb2.EFFECT_DENY)
+                for ve in out.validation_errors:
+                    inst_out.validation_errors.add(
+                        path=ve.path, message=ve.message, source=convert._SOURCE_TO_ENUM.get(ve.source, 0)
+                    )
+                if req.include_meta:
+                    am = resp.meta.resource_instances[rid]
+                    for action, ae in out.actions.items():
+                        am.actions[action].matched_policy = ae.policy
+                        am.actions[action].matched_scope = ae.scope
+                    am.effective_derived_roles.extend(out.effective_derived_roles)
+            return resp
+        except RequestLimitExceeded as e:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001
+            ctx.abort(grpc.StatusCode.INTERNAL, f"check failed: {e}")
+
+    def check_resource_batch(req: request_pb2.CheckResourceBatchRequest, ctx: grpc.ServicerContext):
+        if not req.resources:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "at least one resource must be specified")
+        try:
+            aux = None
+            if req.HasField("aux_data") and req.aux_data.jwt.token:
+                aux = svc._extract_aux_data(req.aux_data.jwt.token, req.aux_data.jwt.key_set_id)
+            principal = convert.principal_from_proto(req.principal)
+            inputs = [
+                T.CheckInput(
+                    request_id=req.request_id,
+                    principal=principal,
+                    resource=convert.resource_from_proto(entry.resource),
+                    actions=list(entry.actions),
+                    aux_data=aux,
+                )
+                for entry in req.resources
+            ]
+            outputs, call_id = svc.check_resources(inputs)
+            resp = response_pb2.CheckResourceBatchResponse(request_id=req.request_id, cerbos_call_id=call_id)
+            from ..api.cerbos.effect.v1 import effect_pb2
+
+            for out in outputs:
+                r = resp.results.add(resource_id=out.resource_id)
+                for action, ae in out.actions.items():
+                    r.actions[action] = convert._EFFECT_TO_ENUM.get(ae.effect, effect_pb2.EFFECT_DENY)
+                for ve in out.validation_errors:
+                    r.validation_errors.add(
+                        path=ve.path, message=ve.message, source=convert._SOURCE_TO_ENUM.get(ve.source, 0)
+                    )
+            return resp
+        except RequestLimitExceeded as e:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001
+            ctx.abort(grpc.StatusCode.INTERNAL, f"check failed: {e}")
+
     rpcs = {
+        "CheckResourceSet": grpc.unary_unary_rpc_method_handler(
+            check_resource_set,
+            request_deserializer=request_pb2.CheckResourceSetRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        ),
+        "CheckResourceBatch": grpc.unary_unary_rpc_method_handler(
+            check_resource_batch,
+            request_deserializer=request_pb2.CheckResourceBatchRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        ),
         "CheckResources": grpc.unary_unary_rpc_method_handler(
             check_resources,
             request_deserializer=request_pb2.CheckResourcesRequest.FromString,

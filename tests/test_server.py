@@ -370,3 +370,53 @@ class TestListeners:
         finally:
             srv.stop()
             core.close()
+
+
+class TestDeprecatedGRPC:
+    def test_check_resource_set_grpc(self, server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+        from cerbos_tpu.server.convert import py_to_value
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}")
+        stub = channel.unary_unary(
+            "/cerbos.svc.v1.CerbosService/CheckResourceSet",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_pb2.CheckResourceSetResponse.FromString,
+        )
+        req = request_pb2.CheckResourceSetRequest(request_id="set-grpc", include_meta=True)
+        req.actions.append("view")
+        req.principal.id = "alice"
+        req.principal.roles.append("user")
+        req.resource.kind = "album"
+        req.resource.instances["a1"].attr["owner"].CopyFrom(py_to_value("alice"))
+        req.resource.instances["a2"].attr["owner"].CopyFrom(py_to_value("bob"))
+        resp = stub(req, timeout=10)
+        assert resp.resource_instances["a1"].actions["view"] == 1
+        assert resp.resource_instances["a2"].actions["view"] == 2
+        assert resp.meta.resource_instances["a1"].actions["view"].matched_policy == "resource.album.vdefault"
+        channel.close()
+
+    def test_check_resource_batch_grpc(self, server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+        from cerbos_tpu.server.convert import py_to_value
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}")
+        stub = channel.unary_unary(
+            "/cerbos.svc.v1.CerbosService/CheckResourceBatch",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_pb2.CheckResourceBatchResponse.FromString,
+        )
+        req = request_pb2.CheckResourceBatchRequest(request_id="batch-grpc")
+        req.principal.id = "alice"
+        req.principal.roles.append("user")
+        e = req.resources.add()
+        e.actions.append("view")
+        e.resource.kind = "album"
+        e.resource.id = "a1"
+        e.resource.attr["owner"].CopyFrom(py_to_value("alice"))
+        resp = stub(req, timeout=10)
+        assert resp.results[0].resource_id == "a1"
+        assert resp.results[0].actions["view"] == 1
+        channel.close()
