@@ -98,6 +98,8 @@ def cmd_compile(args: argparse.Namespace) -> int:
         return 0  # no test suites found
     if args.output == "json":
         print(json.dumps(results.to_json(), indent=2))
+    elif args.output == "junit":
+        print(results.to_junit())
     else:
         print(results.summary())
     return 4 if results.failed else 0
@@ -191,7 +193,7 @@ def main(argv: list[str] | None = None) -> int:
 
     p_compile = sub.add_parser("compile", help="compile policies and run policy tests")
     p_compile.add_argument("dir", help="policy directory")
-    p_compile.add_argument("--output", choices=("tree", "json"), default="tree")
+    p_compile.add_argument("--output", choices=("tree", "json", "junit"), default="tree")
     p_compile.add_argument("--run", help="run only tests matching this regex", default="")
     p_compile.add_argument("--skip-tests", action="store_true")
     p_compile.set_defaults(fn=cmd_compile)
