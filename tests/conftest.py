@@ -9,6 +9,33 @@ import sys
 # call even under JAX_PLATFORMS=cpu, and hangs indefinitely when the TPU
 # tunnel is unreachable. Tests never need the real chip, so when the plugin
 # is present we re-exec pytest once with it scrubbed from the environment.
+def _restore_captured_stdio() -> None:
+    """pytest's fd-level capture points fd 1/2 at throwaway tmpfiles by the
+    time conftest imports, keeping dups of the real stdout/stderr at higher
+    fds. The exec'd child would write into the doomed tmpfiles; find the
+    saved originals and put them back on 1/2 first."""
+    try:
+        if os.fstat(1).st_nlink != 0:  # fd1 not a deleted capture tmpfile
+            return
+    except OSError:
+        return
+    saved = []
+    for fd in range(3, 64):
+        try:
+            st = os.fstat(fd)
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue
+        if st.st_nlink == 0 or target.startswith("socket:") or target == "/dev/null":
+            continue
+        saved.append(fd)
+        if len(saved) == 2:
+            break
+    if len(saved) == 2:  # capture saves stdout first, then stderr
+        os.dup2(saved[0], 1)
+        os.dup2(saved[1], 2)
+
+
 _MARKER = "CERBOS_TPU_TESTS_REEXECED"
 if (
     _MARKER not in os.environ
@@ -21,6 +48,7 @@ if (
     ) or os.getcwd()
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    _restore_captured_stdio()
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
