@@ -15,7 +15,7 @@ from typing import Any
 
 from .errors import CelError, no_such_overload
 from .stdlib import FUNCTIONS, METHODS, _as_list, _as_str, func, method
-from .values import Duration, Timestamp, values_equal
+from .values import Duration, Timestamp, UInt, values_equal
 
 
 def _set_except(a: Any, b: Any) -> list:
@@ -253,13 +253,15 @@ def _f_volumename(args, ctx):
 
 
 class Hierarchy:
-    """Dotted-path hierarchy value: hierarchy("a.b.c")."""
+    """Dotted-path hierarchy value: hierarchy("a.b.c").
+
+    The reference applies no segment validation (hierarchy.go:146-167);
+    it is an indexable, sizable sequence of strings (hierarchy.go:259-276).
+    """
 
     __slots__ = ("parts",)
 
     def __init__(self, parts: list[str]):
-        if not parts or any(p == "" for p in parts):
-            raise CelError("invalid hierarchy")
         self.parts = parts
 
     def cel_type_name(self) -> str:
@@ -267,6 +269,17 @@ class Hierarchy:
 
     def cel_equals(self, other: Any) -> bool:
         return isinstance(other, Hierarchy) and other.parts == self.parts
+
+    def cel_size(self) -> int:
+        return len(self.parts)
+
+    def cel_index(self, idx: Any) -> str:
+        # hierarchy.go:259-270 Get accepts types.Int only (not uint)
+        if isinstance(idx, (bool, UInt)) or not isinstance(idx, int):
+            raise no_such_overload("_[_]", self, idx)
+        if not 0 <= idx < len(self.parts):
+            raise CelError("index out of range")
+        return self.parts[idx]
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"hierarchy({'.'.join(self.parts)!r})"
@@ -307,18 +320,17 @@ def _m_descendentof(t, args, ctx):
 
 @method("commonAncestors")
 def _m_commonancestors(t, args, ctx):
+    """Ref: hierarchy.go:297-323 — equal-length paths drop their last element
+    (excluding self), then the common prefix is the answer (possibly empty)."""
     h, o = _as_hierarchy(t, "commonAncestors"), _as_hierarchy(args[0], "commonAncestors")
+    short, long = (h.parts, o.parts) if len(h.parts) <= len(o.parts) else (o.parts, h.parts)
+    if len(long) == len(short):
+        short, long = short[:-1], long[:-1]
     common = []
-    for a, b in zip(h.parts, o.parts):
-        if a == b:
-            common.append(a)
-        else:
+    for a, b in zip(short, long):
+        if a != b:
             break
-    # the common ancestors exclude either hierarchy itself
-    if len(common) == len(h.parts) or len(common) == len(o.parts):
-        common = common[:-1]
-    if not common:
-        raise CelError("no common ancestors")
+        common.append(a)
     return Hierarchy(common)
 
 

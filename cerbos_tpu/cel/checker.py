@@ -20,10 +20,13 @@ ROOT_IDENTS = {
     "null_type", "type",
 }
 
+# cel-go indexes proto fields under both the proto (snake_case) and JSON
+# (camelCase) names, so both spellings are legal in conditions (e.g.
+# runtime.effective_derived_roles, internal/conditions/types/runtime.go:26).
 _REQUEST_FIELDS = {"principal", "resource", "auxData", "aux_data"}
-_PRINCIPAL_FIELDS = {"id", "roles", "attr", "policyVersion", "scope"}
-_RESOURCE_FIELDS = {"kind", "id", "attr", "policyVersion", "scope"}
-_RUNTIME_FIELDS = {"effectiveDerivedRoles"}
+_PRINCIPAL_FIELDS = {"id", "roles", "attr", "policyVersion", "policy_version", "scope"}
+_RESOURCE_FIELDS = {"kind", "id", "attr", "policyVersion", "policy_version", "scope"}
+_RUNTIME_FIELDS = {"effectiveDerivedRoles", "effective_derived_roles"}
 _AUXDATA_FIELDS = {"jwt"}
 
 

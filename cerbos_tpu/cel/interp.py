@@ -38,6 +38,12 @@ TYPE_IDENTS = {
 }
 
 
+def snake_to_json_name(field: str) -> str:
+    """Proto field name → JSON name (lowerCamelCase)."""
+    head, *rest = field.split("_")
+    return head + "".join(p[:1].upper() + p[1:] for p in rest)
+
+
 class Message:
     """A proto-message-like value: fixed fields with defaults.
 
@@ -52,15 +58,22 @@ class Message:
     def __init__(self, fields: dict[str, Any]):
         self.fields = fields
 
+    def _resolve(self, field: str) -> str:
+        """cel-go indexes proto fields under both the proto (snake_case) and
+        JSON (camelCase) names; canonical storage here is the JSON name."""
+        if field in self.fields:
+            return field
+        if "_" in field:
+            alias = snake_to_json_name(field)
+            if alias in self.fields:
+                return alias
+        raise CelError(f"no such field: {field}")
+
     def cel_select(self, field: str) -> Any:
-        try:
-            return self.fields[field]
-        except KeyError:
-            raise CelError(f"no such field: {field}") from None
+        return self.fields[self._resolve(field)]
 
     def cel_has(self, field: str) -> bool:
-        if field not in self.fields:
-            raise CelError(f"no such field: {field}")
+        field = self._resolve(field)
         v = self.fields[field]
         if isinstance(v, (str, bytes, list, tuple, dict)):
             return len(v) > 0
@@ -247,6 +260,8 @@ def _index(operand: Any, idx: Any) -> Any:
         if isinstance(idx, str):
             return operand.cel_select(idx)
         raise no_such_overload("_[_]", operand, idx)
+    if hasattr(operand, "cel_index"):
+        return operand.cel_index(idx)
     raise no_such_overload("_[_]", operand, idx)
 
 
@@ -554,4 +569,11 @@ def _comprehension(node: Comprehension, act: Activation) -> Any:
                         raise CelError(f"insert failed, key {rk!r} already exists")
                     out_map[rk] = rv
         return out_map
+    if kind == "sort_by":
+        # cel-go lists extension sortBy(e, keyExpr): stable sort by the key
+        import functools
+
+        keyed = [(_eval(node.step, bind(k, v)), v) for k, v in items]
+        keyed.sort(key=functools.cmp_to_key(lambda a, b: compare(a[0], b[0])))
+        return [v for _, v in keyed]
     raise CelError(f"unknown comprehension kind {kind}")

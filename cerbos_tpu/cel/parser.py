@@ -219,7 +219,11 @@ def _scan_string(src: str, i: int, raw: bool, as_bytes: bool = False) -> tuple[s
     raise CelParseError("unterminated string literal", i, src)
 
 
-_ONE_VAR_MACROS = {"all": "all", "exists": "exists", "exists_one": "exists_one", "existsOne": "exists_one", "map": "map", "filter": "filter"}
+_ONE_VAR_MACROS = {
+    "all": "all", "exists": "exists", "exists_one": "exists_one",
+    "existsOne": "exists_one", "map": "map", "filter": "filter",
+    "sortBy": "sort_by",
+}
 _TWO_VAR_MACROS = {
     "all": "all", "exists": "exists", "existsOne": "exists_one", "exists_one": "exists_one",
     "transformList": "transform_list", "transformMap": "transform_map",

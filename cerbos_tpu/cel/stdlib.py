@@ -231,6 +231,8 @@ def _to_duration(v: Any) -> Duration:
 def _size(v: Any) -> int:
     if isinstance(v, (str, bytes, list, tuple, dict)):
         return len(v)
+    if hasattr(v, "cel_size"):
+        return v.cel_size()
     raise no_such_overload("size", v)
 
 
@@ -625,6 +627,98 @@ def _m_join(t, args, ctx):
     return sep.join(parts)
 
 
+def _fmt_string(v: Any) -> str:
+    """%s clause of the cel-go strings extension (ext/formatting.go)."""
+    if v is None:
+        return "null"
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_fmt_string(e) for e in v) + "]"
+    if isinstance(v, dict):
+        # cel-go sorts map entries by key for deterministic output
+        entries = sorted(((_fmt_string(k), _fmt_string(val)) for k, val in v.items()))
+        return "{" + ", ".join(f"{k}: {val}" for k, val in entries) + "}"
+    return _to_string(v)
+
+
+@method("format")
+def _m_format(t, args, ctx):
+    """cel-go strings extension: "%s_%d".format([a, b]) (ext/formatting.go)."""
+    fmt = _as_str(t, "format")
+    fargs = _as_list(args[0], "format") if args else []
+    out: list[str] = []
+    ai = 0
+    i = 0
+    n = len(fmt)
+    while i < n:
+        c = fmt[i]
+        if c != "%":
+            out.append(c)
+            i += 1
+            continue
+        i += 1
+        if i < n and fmt[i] == "%":
+            out.append("%")
+            i += 1
+            continue
+        precision = -1
+        if i < n and fmt[i] == ".":
+            i += 1
+            start = i
+            while i < n and fmt[i].isdigit():
+                i += 1
+            precision = int(fmt[start:i] or "0")
+        if i >= n:
+            raise CelError("format: unexpected end of format string")
+        verb = fmt[i]
+        i += 1
+        if ai >= len(fargs):
+            raise CelError("format: index %d out of range" % ai)
+        v = fargs[ai]
+        ai += 1
+        if verb == "s":
+            out.append(_fmt_string(v))
+        elif verb == "d":
+            if isinstance(v, bool) or not isinstance(v, (int, UInt)):
+                raise CelError("format: integer clause can only be used on integers")
+            out.append(str(int(v)))
+        elif verb in ("f", "e"):
+            if isinstance(v, bool) or not isinstance(v, (int, float, UInt)):
+                raise CelError("format: fixed-point clause can only be used on numbers")
+            p = 6 if precision < 0 else precision
+            out.append(("%." + str(p) + verb) % float(v))
+        elif verb == "b":
+            if isinstance(v, bool):
+                out.append("1" if v else "0")
+            elif isinstance(v, (int, UInt)):
+                x = int(v)
+                out.append(("-" if x < 0 else "") + bin(abs(x))[2:])
+            else:
+                raise CelError("format: binary clause can only be used on integers and bools")
+        elif verb in ("x", "X"):
+            if isinstance(v, bool):
+                raise CelError("format: hex clause can only be used on integers, bytes and strings")
+            if isinstance(v, (int, UInt)):
+                x = int(v)
+                s = ("-" if x < 0 else "") + hex(abs(x))[2:]
+            elif isinstance(v, str):
+                s = v.encode("utf-8").hex()
+            elif isinstance(v, bytes):
+                s = v.hex()
+            else:
+                raise CelError("format: hex clause can only be used on integers, bytes and strings")
+            out.append(s.upper() if verb == "X" else s)
+        elif verb == "o":
+            if isinstance(v, bool) or not isinstance(v, (int, UInt)):
+                raise CelError("format: octal clause can only be used on integers")
+            x = int(v)
+            out.append(("-" if x < 0 else "") + oct(abs(x))[2:])
+        else:
+            raise CelError(f"format: unrecognized formatting clause: {verb}")
+    return "".join(out)
+
+
 @method("lowerAscii")
 def _m_lowerascii(t, args, ctx):
     return "".join(c.lower() if "A" <= c <= "Z" else c for c in _as_str(t, "lowerAscii"))
@@ -714,6 +808,15 @@ def _m_slice(t, args, ctx):
     if start < 0 or end < 0 or start > len(items) or end > len(items) or start > end:
         raise CelError(f"slice: invalid range [{start}:{end}]")
     return items[start:end]
+
+
+@func("lists.range")
+def _f_lists_range(args, ctx):
+    n = args[0]
+    # int only — no uint overload in the lists extension
+    if isinstance(n, (bool, UInt)) or not isinstance(n, int):
+        raise no_such_overload("lists.range", n)
+    return list(range(int(n)))
 
 
 @method("distinct")
@@ -831,8 +934,8 @@ def _m_getseconds(t, args, ctx):
 def _m_getmillis(t, args, ctx):
     v = _dur_or_ts(t, "getMilliseconds")
     if isinstance(v, Duration):
-        # Go remainder semantics: sign follows the dividend
-        us = _dur_us(v)
-        r = _trunc_div(us, 1_000) - _trunc_div(us, 1_000_000) * 1000
-        return r
+        # cel-go returns the TOTAL milliseconds for durations
+        # (time.Duration.Milliseconds), not the millisecond component —
+        # confirmed by cel_eval/duration_funcs.yaml (3750s → 3750000)
+        return _trunc_div(_dur_us(v), 1_000)
     return _ts_in_tz(v, args).microsecond // 1000

@@ -320,8 +320,11 @@ def _compile_resource_policy(pol: model.Policy, ctx: _Ctx) -> CompiledResourcePo
     scope = namer.scope_value(rp.scope)
     params = _params(rp.variables, rp.constants, pol.variables, ctx)
 
-    # derived roles: merge imported sets (ref: compile/resource.go)
-    derived_roles: dict[str, CompiledDerivedRole] = {}
+    # derived roles: collect all imported definitions, then keep only the ones
+    # referenced by a rule (ref: compile/compile.go:247-327
+    # compileImportedDerivedRoles — unreferenced roles are pruned, a name
+    # defined in more than one import is ambiguous only if referenced)
+    role_imports: dict[str, list[CompiledDerivedRole]] = {}
     for imp in rp.import_derived_roles:
         fqn = namer.derived_roles_fqn(imp)
         dr_pol = ctx.repo.get(fqn)
@@ -331,27 +334,32 @@ def _compile_resource_policy(pol: model.Policy, ctx: _Ctx) -> CompiledResourcePo
         dr = dr_pol.derived_roles
         dr_params = _params(dr.variables, dr.constants, dr_pol.variables, ctx)
         for d in dr.definitions:
-            if d.name in derived_roles:
-                ctx.err(f"duplicate derived role definition {d.name!r}")
-                continue
-            derived_roles[d.name] = CompiledDerivedRole(
-                name=d.name,
-                parent_roles=frozenset(d.parent_roles),
-                condition=_compile_condition(d.condition, ctx, f"derived role {d.name}"),
-                params=dr_params,
-                origin_fqn=fqn,
+            role_imports.setdefault(d.name, []).append(
+                CompiledDerivedRole(
+                    name=d.name,
+                    parent_roles=frozenset(d.parent_roles),
+                    condition=_compile_condition(d.condition, ctx, f"derived role {d.name}"),
+                    params=dr_params,
+                    origin_fqn=fqn,
+                )
             )
 
+    derived_roles: dict[str, CompiledDerivedRole] = {}
     rules = []
     for i, r in enumerate(rp.rules, start=1):
         for dr_name in r.derived_roles:
-            if dr_name not in derived_roles:
-                ctx.err(f"rule references unknown derived role {dr_name!r}")
+            imps = role_imports.get(dr_name)
+            if imps is None:
+                ctx.err(f"derived role {dr_name!r} is not defined in any imports")
+            elif len(imps) > 1:
+                ctx.err(f"derived role {dr_name!r} is defined in more than one import")
+            else:
+                derived_roles[dr_name] = imps[0]
         rules.append(
             CompiledResourceRule(
                 actions=tuple(r.actions),
                 roles=tuple(r.roles),
-                derived_roles=tuple(d for d in r.derived_roles if d in derived_roles),
+                derived_roles=tuple(d for d in r.derived_roles if d in role_imports),
                 effect=r.effect,
                 name=_rule_name(r.name, i),
                 condition=_compile_condition(r.condition, ctx, f"rule {_rule_name(r.name, i)}"),

@@ -281,7 +281,12 @@ def _check(rt: RuleTable, input: T.CheckInput, params: T.EvalParams, schema_mgr:
         key = params_obj.cache_key()
         if key in var_cache:
             return params_obj.constants, var_cache[key]
-        variables = eval_ctx.evaluate_variables(params_obj.constants, params_obj.ordered_variables)
+        # evaluate against the *current* context so variables referencing
+        # runtime.effectiveDerivedRoles see the roles activated for this
+        # scope (check.go:242-251 uses the post-withEffectiveDerivedRoles ctx)
+        variables = nonlocal_ctx["eval_ctx"].evaluate_variables(
+            params_obj.constants, params_obj.ordered_variables
+        )
         var_cache[key] = variables
         return params_obj.constants, variables
 
@@ -325,7 +330,11 @@ def _check(rt: RuleTable, input: T.CheckInput, params: T.EvalParams, schema_mgr:
                         )
                         if drs:
                             for name, dr in drs.items():
-                                if not (dr.parent_roles & including_parent_roles):
+                                # the literal "*" parent role matches any
+                                # principal role (internal/utils.go:56-68)
+                                if "*" not in dr.parent_roles and not (
+                                    dr.parent_roles & including_parent_roles
+                                ):
                                     continue
                                 constants, variables = cached_variables(dr.params)
                                 try:

@@ -120,7 +120,10 @@ class RuleTable:
         return self.policy_derived_roles.get(namer.module_id(fqn))
 
     def get_schema(self, fqn: str) -> Optional[model.Schemas]:
-        return self.schemas.get(namer.module_id(fqn))
+        """Only the schema defined by the root (scopeless) policy of the scope
+        chain is in effect (compile/compile.go:182-183)."""
+        root = fqn.partition("/")[0]
+        return self.schemas.get(namer.module_id(root))
 
     def get_meta(self, fqn: str) -> Optional[PolicyMeta]:
         return self.meta.get(namer.module_id(fqn))

@@ -1,0 +1,179 @@
+"""Loader + comparison helpers for the ported reference golden corpora.
+
+Behavioral reference: internal/engine/engine_test.go:46-255 (TestCheck /
+TestCheckWithLenientScopeSearch / TestSchemaValidation) and
+internal/test/test.go (LoadTestCases). The fixtures under tests/golden/
+are the reference's own testdata, ported as data per SURVEY §4 tier 1.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Optional
+
+import yaml
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, Engine, EvalParams, Principal, Resource
+from cerbos_tpu.engine.types import AuxData
+from cerbos_tpu.schema import SchemaManager
+from cerbos_tpu.storage import DiskStore
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+STORE_DIR = os.path.join(GOLDEN_DIR, "store")
+
+# mkEngine sets these (engine_test.go:375-377)
+GOLDEN_GLOBALS = {"environment": "test"}
+
+
+def load_cases(subdir: str) -> list[tuple[str, dict]]:
+    """Mirror of test.LoadTestCases: every .yaml directly in the dir, sorted."""
+    d = os.path.join(GOLDEN_DIR, subdir)
+    out = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".yaml"):
+            continue
+        with open(os.path.join(d, name)) as f:
+            out.append((f"{subdir}/{name}", yaml.safe_load(f)))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def golden_policies():
+    store = DiskStore(STORE_DIR)
+    return store, compile_policy_set(store.get_all())
+
+
+def golden_engine(
+    lenient: bool = False,
+    schema_enforcement: str = "none",
+    **engine_kwargs,
+) -> Engine:
+    store, compiled = golden_policies()
+    params = EvalParams(globals=dict(GOLDEN_GLOBALS), lenient_scope_search=lenient)
+    schema_mgr = None
+    if schema_enforcement != "none":
+        schema_mgr = SchemaManager(store, enforcement=schema_enforcement)
+    return Engine.from_policies(
+        compiled, schema_mgr=schema_mgr, eval_params=params, **engine_kwargs
+    )
+
+
+def parse_input(raw: dict) -> CheckInput:
+    p = raw["principal"]
+    r = raw["resource"]
+    aux = None
+    if raw.get("auxData"):
+        aux = AuxData(jwt=raw["auxData"].get("jwt", {}))
+    return CheckInput(
+        principal=Principal(
+            id=p["id"],
+            roles=list(p.get("roles", [])),
+            attr=p.get("attr", {}) or {},
+            policy_version=p.get("policyVersion", ""),
+            scope=p.get("scope", ""),
+        ),
+        resource=Resource(
+            kind=r["kind"],
+            id=r.get("id", ""),
+            attr=r.get("attr", {}) or {},
+            policy_version=r.get("policyVersion", ""),
+            scope=r.get("scope", ""),
+        ),
+        actions=list(raw.get("actions", [])),
+        request_id=raw.get("requestId", ""),
+        aux_data=aux,
+    )
+
+
+def _norm_val(v: Any) -> Any:
+    """Expected values are parsed from YAML/JSON; ours are structpb-Value-like
+    (numbers become doubles). Normalize both sides."""
+    if isinstance(v, bool) or v is None or isinstance(v, str):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, list):
+        return [_norm_val(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _norm_val(x) for k, x in v.items()}
+    return v
+
+
+def diff_output(want: dict, have) -> list[str]:
+    """Compare a wantOutputs entry against a CheckOutput; return mismatch list.
+
+    Mirrors the protocmp.Diff options in engine_test.go:85-96: outputs sorted
+    by src, effective_derived_roles order-insensitive, empty==absent.
+    """
+    errs: list[str] = []
+    if want.get("requestId", "") != have.request_id:
+        errs.append(f"requestId: want {want.get('requestId')!r} have {have.request_id!r}")
+    if want.get("resourceId", "") != have.resource_id:
+        errs.append(f"resourceId: want {want.get('resourceId')!r} have {have.resource_id!r}")
+
+    want_actions = want.get("actions", {})
+    have_actions = have.actions
+    if set(want_actions) != set(have_actions):
+        errs.append(f"actions keys: want {sorted(want_actions)} have {sorted(have_actions)}")
+    for action, wa in want_actions.items():
+        ha = have_actions.get(action)
+        if ha is None:
+            continue
+        if wa.get("effect") != ha.effect:
+            errs.append(f"actions[{action}].effect: want {wa.get('effect')} have {ha.effect}")
+        if wa.get("policy", "") != ha.policy:
+            errs.append(f"actions[{action}].policy: want {wa.get('policy')!r} have {ha.policy!r}")
+        if wa.get("scope", "") != ha.scope:
+            errs.append(f"actions[{action}].scope: want {wa.get('scope')!r} have {ha.scope!r}")
+
+    want_edr = sorted(want.get("effectiveDerivedRoles", want.get("effective_derived_roles", [])))
+    have_edr = sorted(have.effective_derived_roles)
+    if want_edr != have_edr:
+        errs.append(f"effectiveDerivedRoles: want {want_edr} have {have_edr}")
+
+    want_outputs = sorted(want.get("outputs", []), key=lambda o: o.get("src", ""))
+    have_outputs = sorted(have.outputs, key=lambda o: o.src)
+    if len(want_outputs) != len(have_outputs):
+        errs.append(
+            f"outputs count: want {len(want_outputs)} have {len(have_outputs)}"
+            f" (want srcs {[o.get('src') for o in want_outputs]},"
+            f" have srcs {[o.src for o in have_outputs]})"
+        )
+    else:
+        for wo, ho in zip(want_outputs, have_outputs):
+            if wo.get("src", "") != ho.src:
+                errs.append(f"output src: want {wo.get('src')!r} have {ho.src!r}")
+            if wo.get("action", "") != ho.action:
+                errs.append(f"output[{ho.src}].action: want {wo.get('action')!r} have {ho.action!r}")
+            if _norm_val(wo.get("val")) != _norm_val(ho.val):
+                errs.append(f"output[{ho.src}].val: want {wo.get('val')!r} have {ho.val!r}")
+            # error is a free-text message; require presence parity only
+            if bool(wo.get("error")) != bool(ho.error):
+                errs.append(f"output[{ho.src}].error: want {wo.get('error')!r} have {ho.error!r}")
+
+    def _ve_key(v):
+        return (v[0], v[1])
+
+    want_ve = sorted(
+        ((v.get("source", ""), v.get("path", ""), v.get("message", "")) for v in want.get("validationErrors", [])),
+        key=_ve_key,
+    )
+    have_ve = sorted(((v.source, v.path, v.message) for v in have.validation_errors), key=_ve_key)
+    if [(s, p) for s, p, _ in want_ve] != [(s, p) for s, p, _ in have_ve]:
+        errs.append(f"validationErrors: want {want_ve} have {have_ve}")
+    return errs
+
+
+def run_case(engine: Engine, case: dict, params: Optional[EvalParams] = None) -> list[str]:
+    inputs = [parse_input(raw) for raw in case.get("inputs", [])]
+    outputs = engine.check(inputs, params=params)
+    errs: list[str] = []
+    want_outputs = case.get("wantOutputs", [])
+    if len(want_outputs) != len(outputs):
+        return [f"output count: want {len(want_outputs)} have {len(outputs)}"]
+    for i, (want, have) in enumerate(zip(want_outputs, outputs)):
+        for e in diff_output(want, have):
+            errs.append(f"[{i}] {e}")
+    return errs

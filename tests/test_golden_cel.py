@@ -1,0 +1,54 @@
+"""The reference's cel_eval golden cases through the CEL runtime.
+
+Behavioral reference: internal/engine/evaluator_test.go TestSatisfiesCondition:
+each case compiles a condition tree and evaluates it against the request with
+now() pinned to 2021-04-22T10:05:20.021-05:00, comparing the boolean result.
+"""
+
+import datetime
+
+import pytest
+
+from cerbos_tpu.compile.compiler import _Ctx, _compile_match
+from cerbos_tpu.engine.types import EvalParams
+from cerbos_tpu.policy import model
+from cerbos_tpu.ruletable.check import EvalContext, build_request_messages
+from cerbos_tpu.cel.values import Timestamp
+
+from golden_loader import load_cases, parse_input
+
+CASES = load_cases("cel_eval")
+
+NOW = Timestamp.from_datetime(
+    datetime.datetime(2021, 4, 22, 10, 5, 20, 21000,
+                      tzinfo=datetime.timezone(datetime.timedelta(hours=-5)))
+)
+
+
+def parse_match(raw: dict) -> model.Match:
+    if "expr" in raw:
+        return model.Match(expr=raw["expr"])
+    for kind in ("all", "any", "none"):
+        if kind in raw:
+            children = [parse_match(m) for m in raw[kind].get("of", [])]
+            return model.Match(**{kind: children})
+    raise ValueError(f"unrecognized condition node: {raw}")
+
+
+def _id(case_tuple):
+    return case_tuple[0].rsplit("/", 1)[-1]
+
+
+@pytest.mark.parametrize("case_tuple", CASES, ids=_id)
+def test_cel_eval(case_tuple):
+    name, case = case_tuple
+    ctx = _Ctx({}, name)
+    cond = _compile_match(parse_match(case["condition"]), ctx, "condition")
+    assert not ctx.errors, ctx.errors
+
+    inp = parse_input(case["request"])
+    request, principal, resource = build_request_messages(inp)
+    params = EvalParams(now_fn=lambda: NOW)
+    ec = EvalContext(params, request, principal, resource)
+    have = ec.satisfies_condition(cond, {}, {})
+    assert have == case["want"], f"{name}: want {case['want']} have {have}"

@@ -1,0 +1,69 @@
+"""The reference's own golden engine cases, run through the CPU oracle.
+
+Behavioral reference: internal/engine/engine_test.go TestCheck (engine +
+engine_strict_scope_search under strict scope search),
+TestCheckWithLenientScopeSearch (engine + engine_lenient_scope_search),
+TestSchemaValidation (engine_schema_enforcement/{warn,reject}).
+"""
+
+import pytest
+
+from golden_loader import golden_engine, load_cases, run_case
+
+STRICT_CASES = load_cases("engine") + load_cases("engine_strict_scope_search")
+LENIENT_CASES = load_cases("engine") + load_cases("engine_lenient_scope_search")
+WARN_CASES = load_cases("engine_schema_enforcement/warn")
+REJECT_CASES = load_cases("engine_schema_enforcement/reject")
+
+
+def _id(case_tuple):
+    name, case = case_tuple
+    return f"{name}:{case.get('description', '')[:40]}"
+
+
+@pytest.fixture(scope="module")
+def strict_engine():
+    return golden_engine(lenient=False)
+
+
+@pytest.fixture(scope="module")
+def lenient_engine():
+    return golden_engine(lenient=True)
+
+
+@pytest.fixture(scope="module")
+def warn_engine():
+    return golden_engine(schema_enforcement="warn")
+
+
+@pytest.fixture(scope="module")
+def reject_engine():
+    return golden_engine(schema_enforcement="reject")
+
+
+@pytest.mark.parametrize("case_tuple", STRICT_CASES, ids=_id)
+def test_strict(strict_engine, case_tuple):
+    _, case = case_tuple
+    errs = run_case(strict_engine, case)
+    assert not errs, "\n".join(errs)
+
+
+@pytest.mark.parametrize("case_tuple", LENIENT_CASES, ids=_id)
+def test_lenient(lenient_engine, case_tuple):
+    _, case = case_tuple
+    errs = run_case(lenient_engine, case)
+    assert not errs, "\n".join(errs)
+
+
+@pytest.mark.parametrize("case_tuple", WARN_CASES, ids=_id)
+def test_schema_warn(warn_engine, case_tuple):
+    _, case = case_tuple
+    errs = run_case(warn_engine, case)
+    assert not errs, "\n".join(errs)
+
+
+@pytest.mark.parametrize("case_tuple", REJECT_CASES, ids=_id)
+def test_schema_reject(reject_engine, case_tuple):
+    _, case = case_tuple
+    errs = run_case(reject_engine, case)
+    assert not errs, "\n".join(errs)
