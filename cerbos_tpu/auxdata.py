@@ -78,8 +78,16 @@ def load_keyset(conf: dict) -> KeySet:
         from cryptography.hazmat.primitives import serialization
 
         ks.keys = [serialization.load_pem_public_key(raw)]
-    else:
+    elif str(conf.get("algorithm", "")).startswith("HS"):
+        # raw bytes are a symmetric secret only when the keyset explicitly
+        # opts into an HS* algorithm; otherwise a corrupted public-key file
+        # must fail load, not silently downgrade to HMAC
         ks.keys = [("hmac", raw)]
+    else:
+        raise JWTError(
+            f"keyset {ks.id!r}: key material is neither JWKS nor PEM; "
+            "set algorithm: HS256/HS384/HS512 to use it as an HMAC secret"
+        )
     return ks
 
 

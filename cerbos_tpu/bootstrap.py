@@ -88,6 +88,7 @@ def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
                 tpu_evaluator,
                 max_batch=int(tpu_conf.get("maxBatch", 4096)),
                 max_wait_ms=float(tpu_conf.get("batchWindowMs", 2.0)),
+                request_timeout_s=float(tpu_conf.get("requestTimeoutMs", 30000)) / 1000.0,
             )
             dispatch_evaluator = batcher
 

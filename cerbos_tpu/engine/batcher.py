@@ -25,6 +25,18 @@ class _Pending:
     future: Future
 
 
+def _settle(fut: Future, result: Any = None, error: Optional[BaseException] = None) -> None:
+    """Resolve a future without ever raising out of the batcher thread — the
+    waiter may have timed out and abandoned it."""
+    try:
+        if error is not None:
+            fut.set_exception(error)
+        else:
+            fut.set_result(result)
+    except Exception:  # noqa: BLE001  (InvalidStateError and kin)
+        pass
+
+
 class BatchingEvaluator:
     """Wraps a batch evaluator (TpuEvaluator) with cross-request batching."""
 
@@ -34,9 +46,11 @@ class BatchingEvaluator:
         max_batch: int = 4096,
         max_wait_ms: float = 2.0,
         min_batch_to_wait: int = 2,
+        request_timeout_s: float = 30.0,
     ):
         self.evaluator = evaluator
         self.max_batch = max_batch
+        self.request_timeout = request_timeout_s
         self.max_wait = max_wait_ms / 1000.0
         self.min_batch_to_wait = min_batch_to_wait
         self._queue: list[_Pending] = []
@@ -49,10 +63,29 @@ class BatchingEvaluator:
 
     def check(self, inputs: Sequence[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
         fut: Future = Future()
+        pending = _Pending(list(inputs), params, fut)
         with self._wakeup:
-            self._queue.append(_Pending(list(inputs), params, fut))
+            self._queue.append(pending)
             self._wakeup.notify()
-        return fut.result()
+        try:
+            return fut.result(timeout=self.request_timeout)
+        except TimeoutError:
+            # a wedged device must not block server threads forever: drop the
+            # request from the queue (if still there) and serve it from the
+            # CPU oracle. The future is NOT cancelled — if the device call
+            # eventually returns, _run's set_result on it must stay legal.
+            with self._wakeup:
+                try:
+                    self._queue.remove(pending)
+                except ValueError:
+                    pass
+            from ..ruletable import check_input
+
+            ev = self.evaluator
+            return [
+                check_input(ev.rule_table, i, params or T.EvalParams(), ev.schema_mgr)
+                for i in pending.inputs
+            ]
 
     def _loop(self) -> None:
         while True:
@@ -87,13 +120,13 @@ class BatchingEvaluator:
                 outputs = self.evaluator.check(all_inputs, group[0].params)
             except Exception as e:  # noqa: BLE001
                 for p in group:
-                    p.future.set_exception(e)
+                    _settle(p.future, error=e)
                 continue
             self.stats["batches"] += 1
             self.stats["batched_requests"] += len(group)
             offset = 0
             for p in group:
-                p.future.set_result(outputs[offset : offset + len(p.inputs)])
+                _settle(p.future, result=outputs[offset : offset + len(p.inputs)])
                 offset += len(p.inputs)
 
     def close(self) -> None:

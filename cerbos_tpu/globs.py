@@ -15,6 +15,35 @@ import re
 SEPARATOR = ":"
 
 
+def _class_body(body: str) -> str:
+    """Re-emit a [...] class body as single chars and a-b ranges, escaping
+    everything else — keeps glob semantics while avoiding Python 3.12's
+    set-operation FutureWarnings (`--`, `&&`, `~~`, `||`) and any silent
+    semantic change those operators would later introduce."""
+    items: list[str] = []
+    i, n = 0, len(body)
+    while i < n:
+        ch = body[i]
+        if ch == "\\" and i + 1 < n:
+            ch = body[i + 1]
+            i += 2
+        else:
+            i += 1
+        # a-b range: dash with chars on both sides (dash not first/last)
+        if i < n - 1 and body[i] == "-":
+            lo, hi = ch, body[i + 1]
+            consumed = 2  # '-' + hi
+            if hi == "\\" and i + 2 < n:
+                hi = body[i + 2]
+                consumed = 3
+            if lo <= hi:
+                items.append(f"{re.escape(lo)}-{re.escape(hi)}")
+                i += consumed
+                continue
+        items.append(re.escape(ch))
+    return "".join(items)
+
+
 def _translate(pat: str) -> str:
     out: list[str] = []
     i, n = 0, len(pat)
@@ -46,8 +75,7 @@ def _translate(pat: str) -> str:
                 out.append(re.escape(c))
                 i += 1
                 continue
-            body = pat[j:k].replace("\\", "\\\\").replace("^", "\\^").replace("[", "\\[")
-            out.append(f"[{'^' if neg else ''}{body}]")
+            out.append(f"[{'^' if neg else ''}{_class_body(pat[j:k])}]")
             i = k + 1
         elif c == "{":
             # find matching close brace; braces inside [...] classes are

@@ -112,15 +112,18 @@ class EvalContext:
         )
 
     def evaluate_variables(self, constants: dict[str, Any], ordered_variables) -> dict[str, Any]:
-        """Failed variables are simply absent (missing → CelError → false at
-        the condition boundary), matching check.go:605-630."""
+        """A variable whose expression yields a CEL error *value* (missing
+        key, no-such-overload, ...) becomes null — check.go:776-786
+        evaluateCELExprToRaw returns (nil, nil) for IsError results and the
+        name is still assigned (check.go:582). Non-CEL failures (interpreter
+        bugs) propagate, mirroring the reference's genuine-error path."""
         evald: dict[str, Any] = {}
         for var in ordered_variables:
             act = self.activation(constants, evald)
             try:
                 evald[var.name] = evaluate(var.expr.node, act)
             except CelError:
-                continue
+                evald[var.name] = None
         return evald
 
     def satisfies_condition(self, cond: Optional[CompiledCondition], constants, variables) -> bool:

@@ -69,7 +69,9 @@ def _compute(
     sat_cond [B,C]) — see module docstring for the lattice.
     """
     refs = Refs(xp, tags, his, los, sids, nans, pred_vals, pred_errs)
-    B = next(iter(tags.values())).shape[0] if tags else (next(iter(pred_vals.values())).shape[0] if pred_vals else 1)
+    # scope_sp is always [B, 2, D]; column dicts can all be empty when the
+    # policy set has only unconditional rules, so B must not come from them
+    B = scope_sp.shape[0]
 
     sat_list = []
     for k in kernels:
@@ -168,8 +170,20 @@ def _next_bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
-def _device_eval(lt: LoweredTable, batch: PackedBatch, use_jax: bool = True, jit_cache: Optional[dict] = None):
-    """Run _compute, optionally through a shape-bucketed jax.jit cache."""
+def _device_eval(
+    lt: LoweredTable,
+    batch: PackedBatch,
+    use_jax: bool = True,
+    jit_cache: Optional[dict] = None,
+    mesh=None,
+):
+    """Run _compute, optionally through a shape-bucketed jax.jit cache.
+
+    With a ``mesh``, batch-axis arrays are placed with a NamedSharding over
+    the mesh's "data" axis (padded bucket sizes are powers of two ≥16, so
+    they divide evenly over 2/4/8-device meshes) and XLA partitions the
+    computation across devices.
+    """
     kernels = lt.compiler.kernels
     K, J, D = batch.K, batch.J, batch.D
     BA = batch.cand_cond.shape[0]
@@ -233,6 +247,11 @@ def _device_eval(lt: LoweredTable, batch: PackedBatch, use_jax: bool = True, jit
         scope_sp=pad_b(batch.scope_sp),
     )
 
+    if mesh is not None:
+        from ..parallel.mesh import shard_packed_arrays
+
+        padded = shard_packed_arrays(padded, mesh)
+
     if jit_cache is None:
         jit_cache = {}
     key = (B_pad, BA_pad, K, J)
@@ -267,6 +286,7 @@ class TpuEvaluator:
         max_depth: int = 8,
         use_jax: bool = True,
         min_device_batch: int = 16,
+        mesh=None,
     ):
         self.rule_table = rule_table
         self.schema_mgr = schema_mgr
@@ -274,6 +294,7 @@ class TpuEvaluator:
         self.packer = Packer(self.lowered, max_roles=max_roles, max_candidates=max_candidates, max_depth=max_depth)
         self.use_jax = use_jax
         self.min_device_batch = min_device_batch
+        self.mesh = mesh
         self.stats = {"device_inputs": 0, "oracle_inputs": 0, "trivial_inputs": 0}
         self._jit_cache: dict = {}
         self._dr_table_cache: dict = {}
@@ -296,7 +317,7 @@ class TpuEvaluator:
             return [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
         batch = self.packer.pack(inputs, params)
         final, role_results, win_j, sat_cond = _device_eval(
-            self.lowered, batch, use_jax=self.use_jax, jit_cache=self._jit_cache
+            self.lowered, batch, use_jax=self.use_jax, jit_cache=self._jit_cache, mesh=self.mesh
         )
 
         outputs: list[T.CheckOutput] = []
@@ -327,13 +348,23 @@ class TpuEvaluator:
 
         processed_scopes: set[int] = set()  # resource-chain depths processed
         output_entries: list[T.OutputEntry] = []
-        ec_cache: dict[int, Any] = {}
+        ec_cache: dict[Any, Any] = {}
 
         def eval_ctx():
             if "ec" not in ec_cache:
                 request, principal, resource = build_request_messages(inp)
                 ec_cache["ec"] = EvalContext(params, request, principal, resource)
             return ec_cache["ec"]
+
+        def eval_ctx_at_depth(depth: int):
+            """Context carrying the EDR activated at this resource-chain scope,
+            so outputs/variables referencing runtime.effectiveDerivedRoles see
+            the same values as the oracle's per-scope walk (check.go:242-271)."""
+            key = ("d", depth)
+            if key not in ec_cache:
+                edr = self._edr_at_depth(plan, bi, depth, params, eval_ctx, sat_cond)
+                ec_cache[key] = eval_ctx().with_effective_derived_roles(edr)
+            return ec_cache[key]
 
         for action in inp.actions:
             ci = action_to_ba.get(action)
@@ -365,7 +396,7 @@ class TpuEvaluator:
             # reconstruct processed resource-chain depths + emitted outputs
             self._reconstruct(
                 plan, bi, batch, ci, role_results, win_j, sat_cond,
-                processed_scopes, output_entries, eval_ctx,
+                processed_scopes, output_entries, eval_ctx, eval_ctx_at_depth,
             )
 
         # effective derived roles for processed resource scopes
@@ -382,7 +413,7 @@ class TpuEvaluator:
             return per_k[k][j]
         return None
 
-    def _reconstruct(self, plan, bi, batch, ci, role_results, win_j, sat_cond, processed_scopes, output_entries, eval_ctx):
+    def _reconstruct(self, plan, bi, batch, ci, role_results, win_j, sat_cond, processed_scopes, output_entries, eval_ctx, eval_ctx_at_depth):
         """Mirror the visit order to collect processed scopes + outputs."""
         inp = plan.input
         sat_b = sat_cond[bi]
@@ -428,7 +459,7 @@ class TpuEvaluator:
                     expr = emit.rule_activated if sat else emit.condition_not_met
                     if expr is None:
                         continue
-                    ec = eval_ctx()
+                    ec = eval_ctx_at_depth(e.depth) if pt == PT_RESOURCE else eval_ctx()
                     constants, variables = {}, {}
                     if e.row.params is not None:
                         constants = e.row.params.constants
@@ -469,8 +500,11 @@ class TpuEvaluator:
             self._dr_table_cache[key] = hit
         return hit
 
-    def _effective_derived_roles(self, plan, bi, depths, params, eval_ctx, sat_cond) -> list[str]:
+    def _edr_at_depth(self, plan, bi, depth, params, eval_ctx, sat_cond) -> set[str]:
+        """Derived roles activated at one resource-chain scope depth."""
         inp = plan.input
+        if depth >= len(plan.resource_scopes):
+            return set()
         resource_version = T.effective_version(inp.resource.policy_version, params)
         rt = self.rule_table
         roles_key = (T.effective_scope(inp.resource.scope, params), tuple(inp.principal.roles))
@@ -482,22 +516,29 @@ class TpuEvaluator:
             self._roles_cache[roles_key] = all_roles
         edr: set[str] = set()
         sat_b = sat_cond[bi]
-        for d in depths:
-            if d >= len(plan.resource_scopes):
+        table = self._dr_table(inp.resource.kind, resource_version, plan.resource_scopes[depth])
+        for name, parent_roles, cid, dr in table:
+            if name in edr:
                 continue
-            table = self._dr_table(inp.resource.kind, resource_version, plan.resource_scopes[d])
-            for name, parent_roles, cid, dr in table:
-                if name in edr or not (parent_roles & all_roles):
-                    continue
-                if dr.condition is None:
+            # literal "*" parent role matches any principal role
+            # (internal/utils.go:56-68), mirroring the oracle
+            if "*" not in parent_roles and not (parent_roles & all_roles):
+                continue
+            if dr.condition is None:
+                edr.add(name)
+            elif cid >= 0:
+                if bool(sat_b[cid]):
                     edr.add(name)
-                elif cid >= 0:
-                    if bool(sat_b[cid]):
-                        edr.add(name)
-                else:
-                    # condition outside device coverage: host-evaluate
-                    ec = eval_ctx()
-                    variables = ec.evaluate_variables(dr.params.constants, dr.params.ordered_variables)
-                    if ec.satisfies_condition(dr.condition, dr.params.constants, variables):
-                        edr.add(name)
+            else:
+                # condition outside device coverage: host-evaluate
+                ec = eval_ctx()
+                variables = ec.evaluate_variables(dr.params.constants, dr.params.ordered_variables)
+                if ec.satisfies_condition(dr.condition, dr.params.constants, variables):
+                    edr.add(name)
+        return edr
+
+    def _effective_derived_roles(self, plan, bi, depths, params, eval_ctx, sat_cond) -> list[str]:
+        edr: set[str] = set()
+        for d in depths:
+            edr |= self._edr_at_depth(plan, bi, d, params, eval_ctx, sat_cond)
         return sorted(edr)

@@ -8,7 +8,9 @@ TestSchemaValidation (engine_schema_enforcement/{warn,reject}).
 
 import pytest
 
-from golden_loader import golden_engine, load_cases, run_case
+from cerbos_tpu.engine import Engine, EvalParams
+
+from golden_loader import GOLDEN_GLOBALS, golden_engine, load_cases, run_case
 
 STRICT_CASES = load_cases("engine") + load_cases("engine_strict_scope_search")
 LENIENT_CASES = load_cases("engine") + load_cases("engine_lenient_scope_search")
@@ -39,6 +41,55 @@ def warn_engine():
 @pytest.fixture(scope="module")
 def reject_engine():
     return golden_engine(schema_enforcement="reject")
+
+
+@pytest.fixture(scope="module", params=["numpy", "jax", "mesh8"])
+def device_engine(request):
+    """The same golden cases through the TPU evaluator (device path): numpy
+    fallback, single-device jax, and jax sharded over the 8-device CPU mesh —
+    gating device ≡ reference."""
+    from cerbos_tpu.ruletable import build_rule_table
+    from cerbos_tpu.tpu import TpuEvaluator
+    from golden_loader import golden_policies
+
+    mesh = None
+    if request.param == "mesh8":
+        from cerbos_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+    _, compiled = golden_policies()
+    table = build_rule_table(compiled)
+    ev = TpuEvaluator(
+        table,
+        globals_=dict(GOLDEN_GLOBALS),
+        use_jax=request.param != "numpy",
+        min_device_batch=0,
+        mesh=mesh,
+    )
+    return Engine(
+        table,
+        eval_params=EvalParams(globals=dict(GOLDEN_GLOBALS)),
+        tpu_evaluator=ev,
+        tpu_batch_threshold=1,
+    )
+
+
+@pytest.mark.parametrize("case_tuple", STRICT_CASES, ids=_id)
+def test_strict_device(device_engine, case_tuple):
+    _, case = case_tuple
+    errs = run_case(device_engine, case)
+    assert not errs, "\n".join(errs)
+
+
+@pytest.mark.parametrize("case_tuple", LENIENT_CASES, ids=_id)
+def test_lenient_device(device_engine, case_tuple):
+    _, case = case_tuple
+    errs = run_case(
+        device_engine,
+        case,
+        params=EvalParams(globals=dict(GOLDEN_GLOBALS), lenient_scope_search=True),
+    )
+    assert not errs, "\n".join(errs)
 
 
 @pytest.mark.parametrize("case_tuple", STRICT_CASES, ids=_id)

@@ -18,9 +18,29 @@ from cerbos_tpu.tpu import TpuEvaluator
 import test_engine_check as corpus
 
 
-def assert_parity(rule_table, inputs, params=None, use_jax=False):
+MODES = ["numpy", "jax", "mesh8"]
+
+
+def _make_evaluator(rule_table, params, mode):
+    kwargs = {}
+    if mode == "mesh8":
+        from cerbos_tpu.parallel.mesh import make_mesh
+
+        kwargs["mesh"] = make_mesh(8)
+    return TpuEvaluator(
+        rule_table,
+        globals_=params.globals,
+        use_jax=mode != "numpy",
+        min_device_batch=0,
+        **kwargs,
+    )
+
+
+def assert_parity(rule_table, inputs, params=None, use_jax=False, mode=None):
     params = params or EvalParams()
-    ev = TpuEvaluator(rule_table, globals_=params.globals, use_jax=use_jax, min_device_batch=0)
+    if mode is None:
+        mode = "jax" if use_jax else "numpy"
+    ev = _make_evaluator(rule_table, params, mode)
     got = ev.check(inputs, params)
     want = [check_input(rule_table, i, params) for i in inputs]
     for i, (g, w) in enumerate(zip(got, want)):
@@ -85,10 +105,10 @@ def corpus_inputs():
 
 
 @pytest.mark.parametrize("name", sorted(CORPORA))
-@pytest.mark.parametrize("use_jax", [False, True], ids=["numpy", "jax"])
-def test_corpus_parity(name, use_jax):
+@pytest.mark.parametrize("mode", MODES)
+def test_corpus_parity(name, mode):
     rt = table_for(CORPORA[name])
-    ev = assert_parity(rt, corpus_inputs()[name], use_jax=use_jax)
+    ev = assert_parity(rt, corpus_inputs()[name], mode=mode)
     # the corpora are designed to be device-evaluable
     assert ev.stats["device_inputs"] > 0
 
@@ -167,8 +187,8 @@ principalPolicy:
 """
 
 
-@pytest.mark.parametrize("use_jax", [False, True], ids=["numpy", "jax"])
-def test_fuzz_parity(use_jax):
+@pytest.mark.parametrize("mode", MODES)
+def test_fuzz_parity(mode):
     rng = random.Random(42)
     rt = table_for(FUZZ_POLICIES)
     inputs = []
@@ -201,7 +221,7 @@ def test_fuzz_parity(use_jax):
                 actions=rng.sample(["read", "write", "purge", "zap"], k=rng.randint(1, 3)),
             )
         )
-    ev = assert_parity(rt, inputs, use_jax=use_jax)
+    ev = assert_parity(rt, inputs, mode=mode)
     # most inputs should take the device path
     assert ev.stats["device_inputs"] >= 150, ev.stats
 
@@ -227,8 +247,8 @@ resourcePolicy:
 """
 
 
-@pytest.mark.parametrize("use_jax", [False, True], ids=["numpy", "jax"])
-def test_negative_number_ordering_parity(use_jax):
+@pytest.mark.parametrize("mode", MODES)
+def test_negative_number_ordering_parity(mode):
     # regression: sign-biased (hi, lo) key encoding — comparisons must be
     # correct across the positive/negative double boundary
     rt = table_for(NEGATIVE_NUM_POLICIES)
@@ -239,4 +259,35 @@ def test_negative_number_ordering_parity(use_jax):
             resource=Resource(kind="ledger", id=f"l{i}", attr={"balance": bal}),
             actions=["post", "audit"],
         ))
-    assert_parity(rt, inputs, use_jax=use_jax)
+    assert_parity(rt, inputs, mode=mode)
+
+
+UNCONDITIONAL_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: plain
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+    - actions: ["nuke"]
+      effect: EFFECT_DENY
+      roles: ["*"]
+"""
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_no_condition_table_parity(mode):
+    # regression (ADVICE r1): a table with no attribute/predicate columns must
+    # still size the condition matrix to the real batch, not B=1
+    rt = table_for(UNCONDITIONAL_POLICIES)
+    inputs = [
+        CheckInput(
+            principal=Principal(id=f"u{i}", roles=["user"], attr={}),
+            resource=Resource(kind="plain", id=f"p{i}", attr={}),
+            actions=["view", "nuke", "ghost"],
+        )
+        for i in range(20)
+    ]
+    assert_parity(rt, inputs, mode=mode)
