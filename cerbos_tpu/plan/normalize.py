@@ -1,0 +1,151 @@
+"""Filter-AST normalisation and multi-action merging.
+
+Behavioral reference: internal/ruletable/planner/ast.go:594-806
+(normaliseFilter / normaliseFilterExprOpExpr / normaliseInExpr) and
+merge.go:14-48 (MergeWithAnd). Operates on the Operand/Expr wire tree:
+
+- `in` over a 1-element list/map → `eq`; over an empty one → false; over a
+  non-collection value → `eq`
+- and/or: literal true/false operands drop out or short-circuit, duplicate
+  operands (by canonical JSON) collapse, single-operand and/or unwraps
+- not of a literal bool folds
+- a filter that normalises to a literal bool becomes
+  ALWAYS_ALLOWED/ALWAYS_DENIED
+- multiple per-action filters AND together after dedup, sorted by their
+  string form; any ALWAYS_DENIED wins, ALWAYS_ALLOWED drops out
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .types import (
+    KIND_ALWAYS_ALLOWED,
+    KIND_ALWAYS_DENIED,
+    KIND_CONDITIONAL,
+    Expr,
+    Operand,
+)
+
+
+def _as_bool(op: Optional[Operand]) -> Optional[bool]:
+    if op is not None and op.expression is None and op.variable is None and isinstance(op.value, bool):
+        return op.value
+    return None
+
+
+_TRUE = Operand.val(True)
+_FALSE = Operand.val(False)
+
+
+def _canon(op: Operand) -> str:
+    return json.dumps(op.to_json(), sort_keys=True)
+
+
+def normalise_operand(op: Optional[Operand]) -> Optional[Operand]:
+    if op is None or op.expression is None:
+        return op
+    expr = op.expression
+
+    if expr.op == "in" and len(expr.operands) == 2:
+        simplified, expr = _normalise_in(expr)
+        if simplified is not None:
+            return simplified
+
+    logical = expr.op if expr.op in ("and", "or", "not") else ""
+    seen: set[str] = set()
+    operands: list[Operand] = []
+    for o in expr.operands:
+        n = normalise_operand(o)
+        if n is None:
+            continue
+        if logical:
+            b = _as_bool(n)
+            if b is not None:
+                if logical == "and" and b:
+                    continue
+                if logical == "or" and not b:
+                    continue
+                if logical == "and":
+                    return _FALSE
+                if logical == "or":
+                    return _TRUE
+            if logical != "not":
+                # dedup by the NORMALISED operand: the reference normalises
+                # protos in place, so its HashPB(op) sees post-normalisation
+                # content (ast.go:694-701)
+                key = _canon(n)
+                if key in seen:
+                    continue
+                seen.add(key)
+        operands.append(n)
+
+    if logical:
+        if not operands:
+            if logical == "and":
+                return _TRUE
+            if logical == "or":
+                return _FALSE
+            return None
+        if len(operands) == 1:
+            if logical in ("and", "or"):
+                return operands[0]
+            b = _as_bool(operands[0])
+            if b is not None:
+                return Operand.val(not b)
+
+    return Operand(expression=Expr(op=expr.op, operands=operands))
+
+
+def _normalise_in(expr: Expr) -> tuple[Optional[Operand], Expr]:
+    """ast.go:753-795 — → (replacement, possibly-rewritten expr). Builds a
+    fresh Expr instead of mutating, keeping normalise_operand pure."""
+    rhs = expr.operands[1]
+    if rhs.expression is not None or rhs.variable is not None:
+        return None, expr
+    v = rhs.value
+    if isinstance(v, dict):
+        if len(v) == 0:
+            return _FALSE, expr
+        if len(v) == 1:
+            expr = Expr(op="eq", operands=[expr.operands[0], Operand.val(next(iter(v)))])
+    elif isinstance(v, list):
+        if len(v) == 0:
+            return _FALSE, expr
+        if len(v) == 1:
+            expr = Expr(op="eq", operands=[expr.operands[0], Operand.val(v[0])])
+    else:
+        expr = Expr(op="eq", operands=list(expr.operands))
+    return None, expr
+
+
+def normalise_filter(kind: str, condition: Optional[Operand]) -> tuple[str, Optional[Operand]]:
+    """→ (kind, condition), folding literal-bool conditions into the kind."""
+    if kind != KIND_CONDITIONAL:
+        return kind, None
+    condition = normalise_operand(condition)
+    if condition is None:
+        return KIND_ALWAYS_ALLOWED, None
+    b = _as_bool(condition)
+    if b is not None:
+        return (KIND_ALWAYS_ALLOWED, None) if b else (KIND_ALWAYS_DENIED, None)
+    return KIND_CONDITIONAL, condition
+
+
+def merge_with_and(filters: list[tuple[str, Optional[Operand]]]) -> tuple[str, Optional[Operand]]:
+    """merge.go MergeWithAnd: per-action filters → one filter."""
+    conds: dict[str, Operand] = {}
+    for kind, cond in filters:
+        if kind == KIND_ALWAYS_ALLOWED:
+            continue
+        if kind == KIND_ALWAYS_DENIED:
+            return KIND_ALWAYS_DENIED, None
+        assert cond is not None
+        conds[_canon(cond)] = cond
+    if not conds:
+        return KIND_ALWAYS_ALLOWED, None
+    if len(conds) == 1:
+        return KIND_CONDITIONAL, next(iter(conds.values()))
+    operands = [conds[k] for k in sorted(conds)]
+    return KIND_CONDITIONAL, Operand(expression=Expr(op="and", operands=operands))

@@ -23,15 +23,77 @@ class Residual:
     node: A.Node
 
 
+def _has_non_literal_value(v: Any) -> bool:
+    """True if v contains a CEL value with no constant form in the filter
+    AST (duration, timestamp, hierarchy, SPIFFE ids, ...) — cel prune keeps
+    the originating call for these instead of a value."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return False
+    if isinstance(v, (list, tuple)):
+        return any(_has_non_literal_value(x) for x in v)
+    if isinstance(v, dict):
+        return any(_has_non_literal_value(x) for x in v.values())
+    return True
+
+
+def _substitute_many(node: A.Node, mapping: dict[str, A.Node]) -> A.Node:
+    """Replace free identifiers per mapping (comprehension unrolling)."""
+    if isinstance(node, A.Ident):
+        return mapping.get(node.name, node)
+    if isinstance(node, A.Select):
+        return A.Select(_substitute_many(node.operand, mapping), node.field)
+    if isinstance(node, A.Present):
+        return A.Present(_substitute_many(node.operand, mapping), node.field)
+    if isinstance(node, A.Index):
+        return A.Index(_substitute_many(node.operand, mapping), _substitute_many(node.index, mapping))
+    if isinstance(node, A.Call):
+        return A.Call(
+            node.fn,
+            tuple(_substitute_many(a, mapping) for a in node.args),
+            target=_substitute_many(node.target, mapping) if node.target is not None else None,
+        )
+    if isinstance(node, A.ListLit):
+        return A.ListLit(tuple(_substitute_many(x, mapping) for x in node.items))
+    if isinstance(node, A.MapLit):
+        return A.MapLit(
+            tuple((_substitute_many(k, mapping), _substitute_many(v, mapping)) for k, v in node.entries)
+        )
+    if isinstance(node, A.Bind):
+        inner = {k: v for k, v in mapping.items() if k != node.name}
+        return A.Bind(node.name, _substitute_many(node.init, mapping), _substitute_many(node.body, inner))
+    if isinstance(node, A.Comprehension):
+        inner = {k: v for k, v in mapping.items() if k not in (node.iter_var, node.iter_var2)}
+        return A.Comprehension(
+            kind=node.kind,
+            iter_range=_substitute_many(node.iter_range, mapping),
+            iter_var=node.iter_var,
+            step=_substitute_many(node.step, inner),
+            iter_var2=node.iter_var2,
+            step2=_substitute_many(node.step2, inner) if node.step2 is not None else None,
+        )
+    return node
+
+
 class _Unknown(Exception):
     """Internal: subtree references an unknown."""
 
 
 class PartialEvaluator:
-    def __init__(self, act: Activation, known_attrs: dict[str, Any], var_defs: dict[str, A.Node]):
+    def __init__(
+        self,
+        act: Activation,
+        known_attrs: dict[str, Any],
+        var_defs: dict[str, A.Node],
+        derived_roles_list=None,
+    ):
         self.act = act
         self.known_attrs = known_attrs
         self.var_defs = var_defs  # variable name -> definition AST (inlined on use)
+        # (name, condition-node) pairs for runtime.effectiveDerivedRoles
+        # substitution (planner.go:795-851): the select is replaced by
+        # (cond1 ? [name1] : []) + (cond2 ? [name2] : []) + ...
+        self.derived_roles_list = derived_roles_list
+        self._opaque_idents: set[str] = set()
 
     def run(self, node: A.Node):
         """→ concrete value, Residual, or raises CelError."""
@@ -39,7 +101,128 @@ class PartialEvaluator:
         try:
             return self._eval(node)
         except _Unknown:
-            return Residual(self._residualize(node))
+            residual = self._residualize(node)
+            rewritten = self._struct_match(residual)
+            if rewritten is not None:
+                residual = self._residualize(rewritten)
+            if isinstance(residual, A.Lit) and isinstance(residual.value, bool):
+                return residual.value
+            return Residual(residual)
+
+    # -- struct matcher ------------------------------------------------------
+    #
+    # Behavioral reference: internal/ruletable/planner/struct_matcher.go.
+    # A root-level residual of the form `<known-map>[<unknown-select>](.f)?
+    # <op> <const>` (s1) or `<const> in <known-map>[<unknown-select>](.f)?`
+    # (s2) expands to an OR over the map's entries:
+    # `(indexer == key) && (const <op> value(.f))` — constant arms then fold
+    # away in the follow-up partial evaluation.
+
+    _STRUCT_OPS = ("_==_", "_!=_", "_<_", "_<=_", "_>_", "_>=_")
+
+    def _struct_match(self, node: A.Node) -> Optional[A.Node]:
+        if isinstance(node, A.Comprehension):
+            return self._lambda_match(node)
+        if not isinstance(node, A.Call) or node.target is not None or len(node.args) != 2:
+            return None
+        if node.fn in self._STRUCT_OPS:
+            indexed = self._match_struct_indexer(node.args[0])
+            if indexed is None or not isinstance(node.args[1], A.Lit):
+                return None
+            entries, indexer, field = indexed
+            const = node.args[1]
+        elif node.fn == "_in_":
+            indexed = self._match_struct_indexer(node.args[1])
+            if indexed is None or not isinstance(node.args[0], A.Lit):
+                return None
+            entries, indexer, field = indexed
+            const = node.args[0]
+        else:
+            return None
+        if not entries:
+            return None
+        opts: list[A.Node] = []
+        for key, value in entries:
+            val_node: A.Node = A.Lit(value)
+            if field is not None:
+                val_node = A.Select(val_node, field)
+            opts.append(
+                A.Call(
+                    "_&&_",
+                    (
+                        A.Call("_==_", (indexer, A.Lit(key))),
+                        A.Call(node.fn, (const, val_node)),
+                    ),
+                )
+            )
+        # right-nested OR chain (struct_matcher.go mkLogicalOr)
+        out = opts[-1]
+        for o in reversed(opts[:-1]):
+            out = A.Call("_||_", (o, out))
+        return out
+
+    _LAMBDA_MAX_ITEMS = 10  # struct_matcher.go:352 maxItems
+
+    def _lambda_match(self, node: A.Comprehension) -> Optional[A.Node]:
+        """Root-level exists/all over a known list/map of ≤10 items unrolls
+        to an or/and chain (struct_matcher.go lambdaMatcher.Process)."""
+        if node.kind not in ("all", "exists"):
+            return None
+        rng = node.iter_range
+        if not (isinstance(rng, A.Lit) and isinstance(rng.value, (list, dict))):
+            return None
+        if len(rng.value) > self._LAMBDA_MAX_ITEMS or len(rng.value) == 0:
+            return None
+        if isinstance(rng.value, list):
+            items = list(enumerate(rng.value)) if node.iter_var2 else [(None, v) for v in rng.value]
+        else:
+            if not node.iter_var2:
+                items = [(None, k) for k in rng.value.keys()]
+            else:
+                items = list(rng.value.items())
+        opts: list[A.Node] = []
+        for k, v in items:
+            mapping = {node.iter_var: A.Lit(v)} if k is None else {
+                node.iter_var: A.Lit(k), node.iter_var2: A.Lit(v)
+            }
+            opts.append(self._residualize(_substitute_many(node.step, mapping)))
+        fn = "_&&_" if node.kind == "all" else "_||_"
+        out = opts[-1]
+        for o in reversed(opts[:-1]):
+            out = A.Call(fn, (o, out))
+        return out
+
+    def _match_struct_indexer(self, node: A.Node):
+        """→ (sorted entries, indexer expr, optional field) or None."""
+        field = None
+        if isinstance(node, A.Select):
+            field = node.field
+            node = node.operand
+        if not isinstance(node, A.Index):
+            return None
+        if not (isinstance(node.operand, A.Lit) and isinstance(node.operand.value, dict)):
+            return None
+        if not isinstance(node.index, (A.Select, A.Index)):
+            return None
+        entries = sorted(node.operand.value.items(), key=lambda kv: str(kv[0]))
+        return entries, node.index, field
+
+    def _edr_list_expr(self) -> A.Node:
+        parts: list[A.Node] = []
+        for name, cond in self.derived_roles_list or []:
+            if isinstance(cond, A.Lit) and cond.value is False:
+                continue
+            if isinstance(cond, A.Lit) and cond.value is True:
+                parts.append(A.ListLit((A.Lit(name),)))
+            else:
+                parts.append(A.Call("_?_:_", (cond, A.ListLit((A.Lit(name),)), A.ListLit(()))))
+        if not parts:
+            return A.ListLit(())
+        # mkBinaryOperatorExpr: right-nested adds (planner.go:853-860)
+        out = parts[-1]
+        for p in reversed(parts[:-1]):
+            out = A.Call("_+_", (p, out))
+        return out
 
     # -- variable inlining (variables may reference resource attrs) --------
 
@@ -50,6 +233,14 @@ class PartialEvaluator:
             if node.field in self.var_defs:
                 return self._inline_vars(self.var_defs[node.field], depth + 1)
             raise CelError(f"undefined variable {node.field}")
+        if (
+            isinstance(node, A.Select)
+            and isinstance(node.operand, A.Ident)
+            and node.operand.name == "runtime"
+            and node.field in ("effectiveDerivedRoles", "effective_derived_roles")
+            and self.derived_roles_list is not None
+        ):
+            return self._edr_list_expr()
         if isinstance(node, A.Select):
             return A.Select(self._inline_vars(node.operand, depth), node.field)
         if isinstance(node, A.Present):
@@ -80,34 +271,55 @@ class PartialEvaluator:
         return node
 
     # -- unknown detection --------------------------------------------------
+    #
+    # The reference declares the ENTIRE resource as unknown
+    # (cel.AttributePattern("R") / request.resource, planner.go:510-516) and
+    # then re-declares specific qualified names as known variables:
+    # R.attr.<name> for every provided attribute, R.kind, R.scope and
+    # P.scope (planner.go:525-570). So R.id and absent attrs are unknown;
+    # provided attrs, kind and scope are concrete.
 
-    def _attr_key(self, node: A.Node) -> Optional[str]:
-        """R.attr.<k> / request.resource.attr.<k> (or [k]) → k."""
-        field = None
-        if isinstance(node, A.Select):
-            field = node.field
-            operand = node.operand
-        elif isinstance(node, A.Index) and isinstance(node.index, A.Lit) and isinstance(node.index.value, str):
-            field = node.index.value
-            operand = node.operand
-        else:
+    _DYNAMIC = object()
+
+    def _resource_chain(self, node: A.Node) -> Optional[list]:
+        """Accessor steps (outermost-last) for a chain rooted at R or
+        request.resource; None if not resource-rooted. A step is a field /
+        literal string index, or _DYNAMIC for a computed index."""
+        steps: list = []
+        cur = node
+        while True:
+            if isinstance(cur, (A.Select, A.Present)):
+                steps.append(cur.field)
+                cur = cur.operand
+            elif isinstance(cur, A.Index):
+                if isinstance(cur.index, A.Lit) and isinstance(cur.index.value, str):
+                    steps.append(cur.index.value)
+                else:
+                    steps.append(self._DYNAMIC)
+                cur = cur.operand
+            elif isinstance(cur, A.Ident):
+                steps.reverse()
+                if cur.name == "R":
+                    return steps
+                if cur.name == "request" and steps[:1] == ["resource"]:
+                    return steps[1:]
+                return None
+            else:
+                return None
+
+    def _classify_resource(self, node: A.Node) -> Optional[bool]:
+        """True = unknown, False = known concrete, None = not resource-rooted."""
+        steps = self._resource_chain(node)
+        if steps is None:
             return None
-        if isinstance(operand, A.Select) and operand.field == "attr":
-            root = operand.operand
-            if isinstance(root, A.Ident) and root.name == "R":
-                return field
-            if (
-                isinstance(root, A.Select)
-                and root.field == "resource"
-                and isinstance(root.operand, A.Ident)
-                and root.operand.name == "request"
-            ):
-                return field
-        return None
-
-    def _is_unknown(self, node: A.Node) -> bool:
-        k = self._attr_key(node)
-        return k is not None and k not in self.known_attrs
+        if not steps:
+            return True  # bare R / request.resource
+        head = steps[0]
+        if head in ("kind", "scope"):
+            return False
+        if head == "attr" and len(steps) >= 2 and isinstance(steps[1], str) and steps[1] in self.known_attrs:
+            return False
+        return True  # id, policyVersion, absent attrs, dynamic indexes: unknown
 
     def _eval(self, node: A.Node) -> Any:
         """Evaluate if fully known, else raise _Unknown."""
@@ -135,11 +347,16 @@ class PartialEvaluator:
             raise _Unknown
         return evaluate(node, self.act)
 
-    _unknown_cache: dict
-
     def _has_unknown(self, node: A.Node) -> bool:
-        if self._is_unknown(node):
+        if isinstance(node, A.Ident) and node.name in self._opaque_idents:
             return True
+        cls = self._classify_resource(node)
+        if cls is not None:
+            # a resource-rooted chain is classified atomically: a KNOWN chain
+            # (provided attr / kind / scope) must not be re-examined through
+            # its R-rooted operand, and dynamic index exprs inside an unknown
+            # chain don't change the verdict
+            return cls
         if isinstance(node, (A.Select, A.Present)):
             return self._has_unknown(node.operand)
         if isinstance(node, A.Index):
@@ -168,9 +385,33 @@ class PartialEvaluator:
         """Replace fully-known subtrees with literals; keep unknowns."""
         if not self._has_unknown(node):
             try:
-                return A.Lit(self._eval(node))
-            except (_Unknown, CelError):
-                return node
+                v = self._eval(node)
+            except _Unknown:
+                pass
+            except CelError:
+                # evaluation failed (e.g. select of a missing key on a known
+                # map): keep the node's structure but materialize its known
+                # children, the way cel prune does — P.attr.missing becomes
+                # get-field(<attr literal>, missing), not a bare chain
+                return self._residualize_children(node)
+            else:
+                if _has_non_literal_value(v):
+                    # durations/timestamps re-materialize in canonical call
+                    # form (duration("1h") → duration("3600s")); other
+                    # non-constant values (hierarchy, ...) keep their call
+                    # with known args pruned to constants
+                    from ..cel.values import Duration, Timestamp
+
+                    if isinstance(v, Duration):
+                        from ..cel.stdlib import _to_string
+
+                        return A.Call("duration", (A.Lit(_to_string(v)),))
+                    if isinstance(v, Timestamp):
+                        from ..cel.stdlib import _to_string
+
+                        return A.Call("timestamp", (A.Lit(_to_string(v)),))
+                    return self._residualize_children(node)
+                return A.Lit(v)
         if isinstance(node, A.Call):
             if node.fn in ("_&&_", "_||_") and node.target is None:
                 short = node.fn == "_||_"
@@ -205,6 +446,66 @@ class PartialEvaluator:
                 tuple(self._residualize(a) for a in node.args),
                 target=self._residualize(node.target) if node.target is not None else None,
             )
-        if isinstance(node, (A.Select, A.Present, A.Index, A.ListLit, A.MapLit)):
-            return node  # unknown leaf chains stay as-is
+        if isinstance(node, A.ListLit):
+            return A.ListLit(tuple(self._residualize(x) for x in node.items))
+        if isinstance(node, A.MapLit):
+            return A.MapLit(
+                tuple((self._residualize(k), self._residualize(v)) for k, v in node.entries)
+            )
+        if isinstance(node, A.Comprehension):
+            return self._residualize_comprehension(node)
+        if isinstance(node, A.Index):
+            if self._classify_resource(node) is True:
+                return node  # unknown resource chains stay as-is
+            return A.Index(self._residualize(node.operand), self._residualize(node.index))
+        if isinstance(node, A.Select):
+            if self._classify_resource(node) is True:
+                return node
+            return A.Select(self._residualize(node.operand), node.field)
+        if isinstance(node, A.Present):
+            if self._classify_resource(node) is True:
+                return node
+            return A.Present(self._residualize(node.operand), node.field)
         return node
+
+    def _residualize_children(self, node: A.Node) -> A.Node:
+        if isinstance(node, A.Select):
+            return A.Select(self._residualize(node.operand), node.field)
+        if isinstance(node, A.Present):
+            return A.Present(self._residualize(node.operand), node.field)
+        if isinstance(node, A.Index):
+            return A.Index(self._residualize(node.operand), self._residualize(node.index))
+        if isinstance(node, A.Call):
+            return A.Call(
+                node.fn,
+                tuple(self._residualize(a) for a in node.args),
+                target=self._residualize(node.target) if node.target is not None else None,
+            )
+        if isinstance(node, A.ListLit):
+            return A.ListLit(tuple(self._residualize(x) for x in node.items))
+        if isinstance(node, A.MapLit):
+            return A.MapLit(tuple((self._residualize(k), self._residualize(v)) for k, v in node.entries))
+        return node
+
+    def _residualize_comprehension(self, node: A.Comprehension) -> A.Node:
+        """The iter range residualizes; the body is partially evaluated with
+        the iteration vars left opaque (planner.go evalComprehensionBody).
+        Unrolling over known ranges happens only at the residual root, via
+        the lambda matcher (struct_matcher.go:316-411) in run()."""
+        range_r = self._residualize(node.iter_range)
+        added = {node.iter_var} | ({node.iter_var2} if node.iter_var2 else set())
+        added -= self._opaque_idents
+        self._opaque_idents |= added
+        try:
+            step_r = self._residualize(node.step)
+            step2_r = self._residualize(node.step2) if node.step2 is not None else None
+        finally:
+            self._opaque_idents -= added
+        return A.Comprehension(
+            kind=node.kind,
+            iter_range=range_r,
+            iter_var=node.iter_var,
+            step=step_r,
+            iter_var2=node.iter_var2,
+            step2=step2_r,
+        )

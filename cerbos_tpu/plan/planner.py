@@ -17,6 +17,10 @@ from ..cel import ast as A
 from ..cel.errors import CelError
 from ..engine import types as T
 from ..ruletable.check import EvalContext, build_request_messages
+from ..policy.model import (
+    SCOPE_PERMISSIONS_OVERRIDE_PARENT,
+    SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT,
+)
 from ..ruletable.rows import KIND_PRINCIPAL, KIND_RESOURCE
 from ..ruletable.table import RuleTable
 from .partial import PartialEvaluator, Residual
@@ -35,6 +39,7 @@ FALSE = object()
 
 
 def _or(nodes: list[Any]) -> Any:
+    """n-ary OR (MkOrLogicalOperation: one LO node with n operands)."""
     out: list[A.Node] = []
     for n in nodes:
         if n is TRUE:
@@ -44,13 +49,13 @@ def _or(nodes: list[Any]) -> Any:
         out.append(n)
     if not out:
         return FALSE
-    res = out[0]
-    for n in out[1:]:
-        res = A.Call("_||_", (res, n))
-    return res
+    if len(out) == 1:
+        return out[0]
+    return A.Call("_||_", tuple(out))
 
 
 def _and(nodes: list[Any]) -> Any:
+    """n-ary AND (MkAndLogicalOperation: one LO node with n operands)."""
     out: list[A.Node] = []
     for n in nodes:
         if n is FALSE:
@@ -60,10 +65,9 @@ def _and(nodes: list[Any]) -> Any:
         out.append(n)
     if not out:
         return TRUE
-    res = out[0]
-    for n in out[1:]:
-        res = A.Call("_&&_", (res, n))
-    return res
+    if len(out) == 1:
+        return out[0]
+    return A.Call("_&&_", tuple(out))
 
 
 def _not(n: Any) -> Any:
@@ -127,28 +131,35 @@ class Planner:
         pe = self._partial_evaluator(input, params)
         sanitized = namer.sanitize(input.resource_kind)
 
-        action_filters: list[Any] = []
+        from .normalize import merge_with_and, normalise_filter
+
+        action_filters: list[tuple[str, Optional[Any]]] = []
+        dr_lists: dict[str, Any] = {}  # scope → derived-roles list, shared across actions
         for action in dict.fromkeys(input.actions):
             node, matched_scope = self._plan_action(
-                pe, input, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes
+                pe, input, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes, dr_lists
             )
-            action_filters.append(node)
+            if node is TRUE:
+                action_filters.append((KIND_ALWAYS_ALLOWED, None))
+            elif node is FALSE:
+                action_filters.append((KIND_ALWAYS_DENIED, None))
+            else:
+                action_filters.append(normalise_filter(KIND_CONDITIONAL, ast_to_operand(node)))
             output.matched_scopes[action] = matched_scope
 
-        final = _and(action_filters)  # multi-action: MergeWithAnd semantics
-        if final is TRUE:
-            output.kind = KIND_ALWAYS_ALLOWED
-        elif final is FALSE:
-            output.kind = KIND_ALWAYS_DENIED
-        else:
-            output.kind = KIND_CONDITIONAL
-            output.condition = ast_to_operand(final)
+        output.kind, output.condition = merge_with_and(action_filters)
         return output
 
     def _partial_evaluator(self, input: PlanInput, params: T.EvalParams):
         check_in = T.CheckInput(
             principal=input.principal,
-            resource=T.Resource(kind=input.resource_kind, id="", attr=dict(input.resource_attr)),
+            resource=T.Resource(
+                kind=input.resource_kind,
+                id="",
+                attr=dict(input.resource_attr),
+                scope=input.resource_scope,
+                policy_version=input.resource_policy_version,
+            ),
             actions=list(input.actions),
             aux_data=input.aux_data,
         )
@@ -156,66 +167,220 @@ class Planner:
         ec = EvalContext(params, request, principal, resource)
         act = ec.activation({}, {})
 
-        def make(known_attrs: dict[str, Any], var_defs: dict[str, A.Node], constants: dict[str, Any]):
+        def make(known_attrs: dict[str, Any], var_defs: dict[str, A.Node], constants: dict[str, Any], drl=None):
             consts_act = ec.activation(constants, {})
-            return PartialEvaluator(consts_act, known_attrs, var_defs)
+            return PartialEvaluator(consts_act, known_attrs, var_defs, derived_roles_list=drl)
 
         return make
 
     def _plan_action(
-        self, pe_factory, input: PlanInput, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes
+        self, pe_factory, input: PlanInput, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes, dr_lists
     ) -> tuple[Any, str]:
+        """One action → TRUE/FALSE/residual node.
+
+        Faithful port of the plan.go:100-371 walk: resource policies first,
+        then principal; per role: per scope allow/deny nodes with
+        role-policy denies tracked separately, child-OVERRIDE_PARENT allows
+        gating parent denies, REQUIRE_PARENTAL_CONSENT pending allows, const
+        deny collapsing the role to false, role-policy denies ANDed into the
+        role allow; across policy types allow ORs into the root and deny
+        inverts and ANDs (plan.go:336-359); no policy-type allow at all →
+        unconditional deny.
+        """
         rt = self.rt
         known = {str(k): v for k, v in input.resource_attr.items()}
         matched_scope = ""
+        roles = input.principal.roles or [""]
 
-        def eval_rows(pt: str, scopes: list[str], role: str, pid: str) -> tuple[list[Any], list[Any], str]:
-            allows: list[Any] = []
-            denies: list[Any] = []
-            first_scope = ""
-            parent_roles = rt.idx.add_parent_roles([resource_scope], [role])
-            for scope in scopes:
-                rows = rt.idx.query(resource_version, sanitized, scope, action, parent_roles, pt, pid)
-                for b in rows:
-                    var_defs = {}
-                    constants = {}
-                    if b.params is not None:
-                        var_defs = {v.name: v.expr.node for v in b.params.ordered_variables}
-                        constants = b.params.constants
-                    pe = pe_factory(known, var_defs, constants)
-                    node = self._cond_node(pe, b.derived_role_condition, b.derived_role_params, known, pe_factory)
-                    if node is FALSE:
+        def is_true(n) -> bool:
+            return n is TRUE or (isinstance(n, A.Lit) and n.value is True)
+
+        def is_false(n) -> bool:
+            return n is FALSE or (isinstance(n, A.Lit) and n.value is False)
+
+        def to_node(n) -> A.Node:
+            if n is TRUE:
+                return A.Lit(True)
+            if n is FALSE:
+                return A.Lit(False)
+            return n
+
+        def or2(a, b):
+            return A.Call("_||_", (to_node(a), to_node(b)))
+
+        def and2(a, b):
+            return A.Call("_&&_", (to_node(a), to_node(b)))
+
+        def add_node(curr, nxt, combine):
+            if nxt is None:
+                return curr
+            if curr is None:
+                return nxt
+            return combine(curr, nxt)
+
+        def invert(n):
+            """InvertNodeBooleanValue (planner.go:285-304)."""
+            if is_true(n):
+                return FALSE
+            if is_false(n):
+                return TRUE
+            if isinstance(n, A.Call) and n.fn == "!_":
+                if len(n.args) == 1:
+                    return n.args[0]
+            return A.Call("!_", (to_node(n),))
+
+        def gate_by_child_override(child_allow, deny):
+            """gateByChildOverrideAllow (plan.go:405-415)."""
+            if deny is None or child_allow is None:
+                return deny
+            inv = invert(child_allow)
+            if is_true(deny):
+                return inv
+            return and2(inv, deny)
+
+        def derived_roles_list(scope: str):
+            """Sorted (name, condition-node) pairs for runtime.effectiveDerivedRoles
+            substitution (plan.go:144-183, planner.go:831-851)."""
+            if scope in dr_lists:
+                return dr_lists[scope]
+            out = []
+            drs = rt.get_derived_roles(
+                namer.resource_policy_fqn(input.resource_kind, resource_version, scope)
+            )
+            if drs:
+                principal_parent_roles = set(
+                    rt.idx.add_parent_roles([resource_scope], list(input.principal.roles))
+                )
+                for name in sorted(drs):
+                    dr = drs[name]
+                    if "*" not in dr.parent_roles and not (dr.parent_roles & principal_parent_roles):
                         continue
-                    cond_node = self._cond_node(pe, b.condition, b.params, known, pe_factory)
-                    combined = _and([node, cond_node])
-                    if combined is FALSE:
-                        continue
-                    if not first_scope:
-                        first_scope = scope
-                    if b.effect == "EFFECT_ALLOW":
-                        allows.append(combined)
-                    elif b.effect == "EFFECT_DENY":
-                        denies.append(combined)
-            return allows, denies, first_scope
+                    dr_pe = self._pe_for(pe_factory, known, dr.params, None)
+                    node = self._cond_node(dr_pe, dr.condition)
+                    if node is TRUE:
+                        node = A.Lit(True)
+                    elif node is FALSE:
+                        node = A.Lit(False)
+                    out.append((name, node))
+            dr_lists[scope] = out
+            return out
 
-        # principal pass (role-agnostic)
-        p_allows, p_denies, p_matched = eval_rows(KIND_PRINCIPAL, p_scopes, input.principal.roles[0] if input.principal.roles else "", input.principal.id)
+        root = None
+        has_pt_allow = False
+        for pt in (KIND_RESOURCE, KIND_PRINCIPAL):
+            pt_allow = None
+            pt_deny = None
+            scopes = p_scopes if pt == KIND_PRINCIPAL else r_scopes
 
-        # resource pass per role, combined with OR (role independence)
-        role_filters: list[Any] = []
-        r_matched = ""
-        for role in input.principal.roles:
-            allows, denies, first_scope = eval_rows(KIND_RESOURCE, r_scopes, role, "")
-            if not r_matched:
-                r_matched = first_scope
-            role_filters.append(_and([_or(allows), _not(_or(denies))]))
-        r_combined = _or(role_filters)
+            for role_idx, role in enumerate(roles):
+                if role_idx > 0 and pt == KIND_PRINCIPAL:
+                    break
+                role_allow = None
+                role_deny = None
+                role_deny_rp = None
+                pending_allow = False
+                child_override_allow = None
+                parent_roles = rt.idx.add_parent_roles([resource_scope], [role])
 
-        final = _and([_not(_or(p_denies)), _or([_or(p_allows), r_combined])])
-        matched_scope = p_matched or r_matched
-        return final, matched_scope
+                for scope in scopes:
+                    if child_override_allow is not None and is_true(child_override_allow):
+                        break
+                    scope_allow = None
+                    scope_deny = None
+                    scope_deny_rp = None
+                    drl = derived_roles_list(scope) if pt == KIND_RESOURCE else []
+                    pid = input.principal.id if pt == KIND_PRINCIPAL else ""
+                    rows = rt.idx.query(resource_version, sanitized, scope, action, parent_roles, pt, pid)
+                    for b in rows:
+                        pe = self._pe_for(pe_factory, known, b.params, drl)
+                        node = self._cond_node(pe, b.condition)
+                        if b.derived_role_condition is not None:
+                            dr_pe = self._pe_for(pe_factory, known, b.derived_role_params, drl)
+                            dr_node = self._cond_node(dr_pe, b.derived_role_condition)
+                            node = dr_node if b.condition is None else _and([node, dr_node])
+                        if b.effect == "EFFECT_ALLOW":
+                            scope_allow = add_node(scope_allow, node, or2)
+                        elif b.effect == "EFFECT_DENY":
+                            if is_false(node):
+                                continue
+                            if b.from_role_policy:
+                                scope_deny_rp = add_node(scope_deny_rp, node, or2)
+                            else:
+                                scope_deny = add_node(scope_deny, node, or2)
 
-    def _cond_node(self, pe: PartialEvaluator, cond, params_obj, known, pe_factory) -> Any:
+                    scope_deny = gate_by_child_override(child_override_allow, scope_deny)
+                    scope_deny_rp = gate_by_child_override(child_override_allow, scope_deny_rp)
+                    role_deny = add_node(role_deny, scope_deny, or2)
+                    role_deny_rp = add_node(role_deny_rp, scope_deny_rp, or2)
+
+                    sp = rt.get_scope_scope_permissions(scope)
+                    if scope_allow is not None:
+                        if role_allow is None:
+                            role_allow = scope_allow
+                        elif pending_allow:
+                            role_allow = and2(role_allow, scope_allow)
+                            pending_allow = False
+                        else:
+                            role_allow = or2(role_allow, scope_allow)
+                        if sp == SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT:
+                            pending_allow = True
+
+                    if (
+                        (scope_deny is not None or scope_deny_rp is not None or scope_allow is not None)
+                        and sp == SCOPE_PERMISSIONS_OVERRIDE_PARENT
+                    ):
+                        matched_scope = scope
+                    if scope_allow is not None and sp == SCOPE_PERMISSIONS_OVERRIDE_PARENT:
+                        child_override_allow = add_node(child_override_allow, scope_allow, or2)
+
+                # an ALLOW pending parental consent with no parent match → no allow
+                if pending_allow:
+                    role_allow = None
+
+                const_deny = (role_deny is not None and is_true(role_deny)) or (
+                    role_deny_rp is not None and is_true(role_deny_rp)
+                )
+                if const_deny:
+                    # role independence: fold the const DENY into this role's
+                    # allow so other roles can still override (plan.go:302-312)
+                    role_allow = FALSE
+                    role_deny = None
+                    role_deny_rp = None
+                elif role_allow is not None and role_deny is None and role_deny_rp is None and is_true(role_allow):
+                    pt_allow = role_allow
+                    pt_deny = None
+                    break
+
+                if role_deny_rp is not None and role_allow is not None:
+                    role_allow = and2(role_allow, invert(role_deny_rp))
+
+                pt_allow = add_node(pt_allow, role_allow, or2)
+                pt_deny = add_node(pt_deny, role_deny, or2)
+
+            if pt_allow is not None:
+                has_pt_allow = True
+                root = pt_allow if root is None else or2(pt_allow, root)
+            if pt_deny is not None:
+                inv = invert(pt_deny)
+                root = inv if root is None else and2(inv, root)
+
+        if root is None or not has_pt_allow:
+            return FALSE, matched_scope
+        if is_true(root):
+            return TRUE, matched_scope
+        if is_false(root):
+            return FALSE, matched_scope
+        return to_node(root), matched_scope
+
+    def _pe_for(self, pe_factory, known, params_obj, drl) -> PartialEvaluator:
+        var_defs = {}
+        constants = {}
+        if params_obj is not None:
+            var_defs = {v.name: v.expr.node for v in params_obj.ordered_variables}
+            constants = params_obj.constants
+        return pe_factory(known, var_defs, constants, drl)
+
+    def _cond_node(self, pe: PartialEvaluator, cond) -> Any:
         """CompiledCondition → TRUE/FALSE/residual node via partial eval."""
         if cond is None:
             return TRUE
@@ -227,31 +392,67 @@ class Planner:
             if isinstance(r, Residual):
                 return r.node
             return TRUE if r is True else FALSE
-        children = [self._cond_node(pe, c, params_obj, known, pe_factory) for c in cond.children]
+        children = [self._cond_node(pe, c) for c in cond.children]
         if cond.kind == "all":
             return _and(children)
         if cond.kind == "any":
             return _or(children)
         if cond.kind == "none":
-            return _not(_or(children))
+            # NOT distributes over the children: none{a,b} → !a && !b
+            # (planner.go:365-393, InvertNodeBooleanValue per child)
+            parts: list[Any] = []
+            for c in children:
+                if c is TRUE:
+                    return FALSE
+                if c is FALSE:
+                    continue
+                parts.append(_not(c))
+            return _and(parts)
         raise ValueError(f"unknown condition kind {cond.kind}")
 
 
 # ---------------------------------------------------------------------------
 # residual AST → filter expression tree
+#
+# Behavioral reference: internal/ruletable/planner/ast.go buildExprImpl
+# (operator names via opFromCLE ast.go:62-101; has() → literal true
+# ast.go:395-397; `x in <map>` rewrites the RHS to its sorted key list
+# ast.go:464-477 + structKeys; struct → set-field entries ast.go:478-497)
+# and lambda.go / mkNode (comprehension → op(range, lambda(...)), the
+# iteration range of a non-transform op over a map becomes its key list,
+# ast.go:538-546).
 
 _OP_NAMES = {
     "_==_": "eq", "_!=_": "ne", "_<_": "lt", "_<=_": "le", "_>_": "gt", "_>=_": "ge",
     "_&&_": "and", "_||_": "or", "!_": "not", "_in_": "in",
     "_+_": "add", "_-_": "sub", "_*_": "mult", "_/_": "div", "_%_": "mod", "-_": "neg",
-    "_[_]": "index",
+    "_[_]": "index", "_?_:_": "if",
 }
 
+_COMPREHENSION_OPS = {
+    "all": "all", "exists": "exists", "exists_one": "exists_one",
+    "filter": "filter", "map": "map", "transform_list": "transformList",
+    "transform_map": "transformMap", "transform_map_entry": "transformMapEntry",
+    "sort_by": "sortBy",
+}
 
-def _flatten(node: A.Node, op: str) -> list[A.Node]:
-    if isinstance(node, A.Call) and node.fn == op and node.target is None:
-        return _flatten(node.args[0], op) + _flatten(node.args[1], op)
-    return [node]
+_STRUCT_OPS = {"transformList", "transformMap", "transformMapEntry"}
+
+
+def _map_keys_operand(node: A.Node) -> Optional[Operand]:
+    """Map-typed node → list-of-keys operand (structKeys: sorted), or None."""
+    if isinstance(node, A.Lit) and isinstance(node.value, dict):
+        keys = sorted(node.value.keys(), key=str)
+        from ..util import normalize_attr
+
+        return Operand.val([normalize_attr(k) for k in keys])
+    if isinstance(node, A.MapLit):
+        entries = sorted(node.entries, key=lambda kv: repr(kv[0]))
+        keys = [ast_to_operand(k) for k, _ in entries]
+        if all(o.expression is None and o.variable is None for o in keys):
+            return Operand.val([o.value for o in keys])
+        return Operand.expr("list", *keys)
+    return None
 
 
 def ast_to_operand(node: A.Node) -> Operand:
@@ -261,27 +462,46 @@ def ast_to_operand(node: A.Node) -> Operand:
         v = node.value
         from ..util import normalize_attr
 
+        if isinstance(v, dict):
+            # residual map values surface as struct expressions (ast.go:478)
+            ops = [
+                Operand.expr("set-field", Operand.val(normalize_attr(k)), Operand.val(normalize_attr(x)))
+                for k, x in v.items()
+            ]
+            return Operand.expr("struct", *ops)
         return Operand.val(normalize_attr(v))
-    if isinstance(node, (A.Select, A.Index, A.Ident, A.Present)):
+    if isinstance(node, A.Present):
+        # has() in a residual converts to literal true (ast.go:395-397)
+        return Operand.val(True)
+    if isinstance(node, (A.Select, A.Index, A.Ident)):
         var = _variable_name(node)
         if var is not None:
             return Operand.var(var)
-        if isinstance(node, A.Present):
-            return Operand.expr("has", ast_to_operand(A.Select(node.operand, node.field)))
         if isinstance(node, A.Index):
             return Operand.expr("index", ast_to_operand(node.operand), ast_to_operand(node.index))
+        if isinstance(node, A.Select):
+            return Operand.expr("get-field", ast_to_operand(node.operand), Operand.var(node.field))
         raise ValueError(f"cannot convert {node} to filter operand")
     if isinstance(node, A.ListLit):
-        return Operand.expr("list", *[ast_to_operand(x) for x in node.items])
+        items = [ast_to_operand(x) for x in node.items]
+        if all(o.expression is None and o.variable is None for o in items):
+            return Operand.val([o.value for o in items])
+        return Operand.expr("list", *items)
     if isinstance(node, A.MapLit):
         ops = []
         for k, v in node.entries:
-            ops.append(Operand.expr("map-entry", ast_to_operand(k), ast_to_operand(v)))
-        return Operand.expr("map", *ops)
+            ops.append(Operand.expr("set-field", ast_to_operand(k), ast_to_operand(v)))
+        return Operand.expr("struct", *ops)
+    if isinstance(node, A.Comprehension):
+        return _comprehension_to_operand(node)
+    if isinstance(node, A.Bind):
+        # cel.bind residual: inline the bound value
+        return ast_to_operand(_substitute(node.body, node.name, node.init))
     if isinstance(node, A.Call):
-        if node.fn in ("_&&_", "_||_"):
-            parts = _flatten(node, node.fn)
-            return Operand.expr(_OP_NAMES[node.fn], *[ast_to_operand(p) for p in parts])
+        if node.fn == "_in_" and len(node.args) == 2:
+            keys = _map_keys_operand(node.args[1])
+            if keys is not None:
+                return Operand.expr("in", ast_to_operand(node.args[0]), keys)
         op = _OP_NAMES.get(node.fn, node.fn)
         operands = []
         if node.target is not None:
@@ -291,15 +511,60 @@ def ast_to_operand(node: A.Node) -> Operand:
     raise ValueError(f"cannot convert {type(node).__name__} to filter operand")
 
 
+def _comprehension_to_operand(node: A.Comprehension) -> Operand:
+    """Comprehension → op(iterRange, lambda(expr[, expr2], vars...))."""
+    op = _COMPREHENSION_OPS.get(node.kind)
+    if op is None:
+        raise ValueError(f"cannot convert comprehension kind {node.kind}")
+    # 3-arg map (map with predicate) surfaces as transformList (lambda.go:96-104)
+    expr, expr2 = node.step, None
+    if node.step2 is not None:
+        if node.kind == "map":
+            op = "transformList"
+        expr, expr2 = node.step2, node.step
+    lambda_args = [ast_to_operand(expr)]
+    if expr2 is not None:
+        lambda_args.append(ast_to_operand(expr2))
+    lambda_args.append(Operand.var(node.iter_var))
+    if node.iter_var2:
+        lambda_args.append(Operand.var(node.iter_var2))
+    iter_range = node.iter_range
+    range_op = None
+    if op not in _STRUCT_OPS:
+        range_op = _map_keys_operand(iter_range)
+    if range_op is None:
+        range_op = ast_to_operand(iter_range)
+    return Operand.expr(op, range_op, Operand.expr("lambda", *lambda_args))
+
+
+def _substitute(node: A.Node, name: str, value: A.Node) -> A.Node:
+    if isinstance(node, A.Ident):
+        return value if node.name == name else node
+    if isinstance(node, A.Select):
+        return A.Select(_substitute(node.operand, name, value), node.field)
+    if isinstance(node, A.Present):
+        return A.Present(_substitute(node.operand, name, value), node.field)
+    if isinstance(node, A.Index):
+        return A.Index(_substitute(node.operand, name, value), _substitute(node.index, name, value))
+    if isinstance(node, A.Call):
+        return A.Call(
+            node.fn,
+            tuple(_substitute(a, name, value) for a in node.args),
+            target=_substitute(node.target, name, value) if node.target is not None else None,
+        )
+    if isinstance(node, A.ListLit):
+        return A.ListLit(tuple(_substitute(x, name, value) for x in node.items))
+    if isinstance(node, A.MapLit):
+        return A.MapLit(tuple((_substitute(k, name, value), _substitute(v, name, value)) for k, v in node.entries))
+    return node
+
+
 def _variable_name(node: A.Node) -> Optional[str]:
     segs: list[str] = []
     cur = node
     while True:
         if isinstance(cur, A.Select):
             segs.append(cur.field)
-            cur = cur.operand
-        elif isinstance(cur, A.Index) and isinstance(cur.index, A.Lit) and isinstance(cur.index.value, str):
-            segs.append(cur.index.value)
             cur = cur.operand
         elif isinstance(cur, A.Ident):
             root = cur.name
@@ -309,6 +574,7 @@ def _variable_name(node: A.Node) -> Optional[str]:
                 return ".".join(["request", "principal"] + list(reversed(segs)))
             if root == "request":
                 return ".".join(["request"] + list(reversed(segs)))
-            return None
+            # compound dotted variable (e.g. a comprehension iteration var)
+            return ".".join([root] + list(reversed(segs)))
         else:
             return None

@@ -356,15 +356,21 @@ class TpuEvaluator:
                 ec_cache["ec"] = EvalContext(params, request, principal, resource)
             return ec_cache["ec"]
 
-        def eval_ctx_at_depth(depth: int):
-            """Context carrying the EDR activated at this resource-chain scope,
-            so outputs/variables referencing runtime.effectiveDerivedRoles see
-            the same values as the oracle's per-scope walk (check.go:242-271)."""
-            key = ("d", depth)
-            if key not in ec_cache:
-                edr = self._edr_at_depth(plan, bi, depth, params, eval_ctx, sat_cond)
-                ec_cache[key] = eval_ctx().with_effective_derived_roles(edr)
-            return ec_cache[key]
+        def bookkeep_depth(depth: int):
+            """EDR bookkeeping for a newly visited resource-chain scope: the
+            current context is REPLACED with that scope's activated set, and
+            later rule visits — including other roles re-walking already
+            processed scopes — keep whatever context is current, mirroring
+            the oracle's processedScopedDerivedRoles statefulness
+            (check.go:231-271 / check.py:321-341)."""
+            if depth in processed_scopes:
+                return
+            processed_scopes.add(depth)
+            edr = self._edr_at_depth(plan, bi, depth, params, eval_ctx, sat_cond)
+            ec_cache["cur"] = eval_ctx().with_effective_derived_roles(edr)
+
+        def current_ctx():
+            return ec_cache.get("cur") or eval_ctx()
 
         for action in inp.actions:
             ci = action_to_ba.get(action)
@@ -396,7 +402,7 @@ class TpuEvaluator:
             # reconstruct processed resource-chain depths + emitted outputs
             self._reconstruct(
                 plan, bi, batch, ci, role_results, win_j, sat_cond,
-                processed_scopes, output_entries, eval_ctx, eval_ctx_at_depth,
+                output_entries, eval_ctx, bookkeep_depth, current_ctx,
             )
 
         # effective derived roles for processed resource scopes
@@ -413,8 +419,11 @@ class TpuEvaluator:
             return per_k[k][j]
         return None
 
-    def _reconstruct(self, plan, bi, batch, ci, role_results, win_j, sat_cond, processed_scopes, output_entries, eval_ctx, eval_ctx_at_depth):
-        """Mirror the visit order to collect processed scopes + outputs."""
+    def _reconstruct(self, plan, bi, batch, ci, role_results, win_j, sat_cond, output_entries, eval_ctx, bookkeep_depth, current_ctx):
+        """Mirror the visit order: per role, walk resource-chain depths in
+        order, bookkeeping each newly visited scope's derived roles BEFORE
+        evaluating that scope's rule outputs, so outputs see the same
+        (stateful) runtime.effectiveDerivedRoles context as the oracle."""
         inp = plan.input
         sat_b = sat_cond[bi]
         # principal pass decided?
@@ -431,43 +440,38 @@ class TpuEvaluator:
                 code = int(role_results[ci, k, pt, 0])
                 depth = int(role_results[ci, k, pt, 1])
                 max_depth = min(depth, len(chain) - 1) if code != CODE_NO_MATCH else len(chain) - 1
-                if pt == PT_RESOURCE:
-                    for d in range(0, max_depth + 1):
-                        processed_scopes.add(d)
-                if not emit_outputs:
-                    if code == CODE_ALLOW:
-                        break
-                    continue
-                # outputs from visited candidates
                 entries = batch.cand_entries[ci][k] if k < len(batch.cand_entries[ci]) else []
                 wj = int(win_j[ci, k, pt]) if code == CODE_DENY else -1
-                for j, e in enumerate(entries):
-                    if e is None or e.pt != pt:
+                for d in range(0, max_depth + 1):
+                    if pt == PT_RESOURCE:
+                        bookkeep_depth(d)
+                    if not emit_outputs:
                         continue
-                    if code != CODE_NO_MATCH and e.depth > depth:
-                        continue
-                    if code == CODE_DENY and e.depth == depth and wj >= 0 and j > wj:
-                        continue
-                    if not e.has_output or e.row is None or e.row.emit_output is None:
-                        continue
-                    sat = True
-                    if e.cond_id >= 0:
-                        sat = bool(sat_b[e.cond_id])
-                    if e.drcond_id >= 0 and not bool(sat_b[e.drcond_id]):
-                        continue  # derived-role condition unmet: rule skipped entirely
-                    emit = e.row.emit_output
-                    expr = emit.rule_activated if sat else emit.condition_not_met
-                    if expr is None:
-                        continue
-                    ec = eval_ctx_at_depth(e.depth) if pt == PT_RESOURCE else eval_ctx()
-                    constants, variables = {}, {}
-                    if e.row.params is not None:
-                        constants = e.row.params.constants
-                        variables = ec.evaluate_variables(constants, e.row.params.ordered_variables)
-                    src = self._rule_src(e)
-                    output_entries.append(
-                        ec.evaluate_output(e.row.name, src, batch.ba_action[ci], expr, constants, variables)
-                    )
+                    for j, e in enumerate(entries):
+                        if e is None or e.pt != pt or e.depth != d:
+                            continue
+                        if code == CODE_DENY and e.depth == depth and wj >= 0 and j > wj:
+                            continue
+                        if not e.has_output or e.row is None or e.row.emit_output is None:
+                            continue
+                        sat = True
+                        if e.cond_id >= 0:
+                            sat = bool(sat_b[e.cond_id])
+                        if e.drcond_id >= 0 and not bool(sat_b[e.drcond_id]):
+                            continue  # derived-role condition unmet: rule skipped entirely
+                        emit = e.row.emit_output
+                        expr = emit.rule_activated if sat else emit.condition_not_met
+                        if expr is None:
+                            continue
+                        ec = current_ctx() if pt == PT_RESOURCE else eval_ctx()
+                        constants, variables = {}, {}
+                        if e.row.params is not None:
+                            constants = e.row.params.constants
+                            variables = ec.evaluate_variables(constants, e.row.params.ordered_variables)
+                        src = self._rule_src(e)
+                        output_entries.append(
+                            ec.evaluate_output(e.row.name, src, batch.ba_action[ci], expr, constants, variables)
+                        )
                 # stop visiting further roles if this role allowed
                 if code == CODE_ALLOW:
                     break

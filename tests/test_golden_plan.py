@@ -1,0 +1,165 @@
+"""The reference's query-planner golden suites through the planner.
+
+Behavioral reference: internal/engine/engine_test.go TestQueryPlan:
+policies from query_planner/policies, now pinned to
+2024-01-16T10:18:27.395+13:00, auxData.jwt.customInt=42, globals
+{"environment": "test"}; filters compared after stabilisation (operands of
+commutative operators sorted by their JSON encoding; struct entries sorted
+by key — engine_test.go:500-575).
+"""
+
+import datetime
+import functools
+import json
+
+import pytest
+
+from cerbos_tpu.cel.values import Timestamp
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import EvalParams, Principal
+from cerbos_tpu.engine.types import AuxData
+from cerbos_tpu.plan import Planner
+from cerbos_tpu.plan.types import PlanInput
+from cerbos_tpu.ruletable import build_rule_table
+from cerbos_tpu.storage import DiskStore
+
+from golden_loader import GOLDEN_DIR, load_cases
+
+NOW = Timestamp.from_datetime(
+    datetime.datetime(2024, 1, 16, 10, 18, 27, 395000,
+                      tzinfo=datetime.timezone(datetime.timedelta(hours=13)))
+)
+
+COMMUTATIVE = {"and", "or", "eq", "ne", "add", "mult"}
+
+
+@functools.lru_cache(maxsize=None)
+def plan_table():
+    store = DiskStore(GOLDEN_DIR + "/query_planner/policies")
+    return build_rule_table(compile_policy_set(store.get_all()))
+
+
+def make_params(lenient: bool) -> EvalParams:
+    return EvalParams(
+        globals={"environment": "test"},
+        now_fn=lambda: NOW,
+        lenient_scope_search=lenient,
+    )
+
+
+def stabilise(operand_json):
+    """Mirror of engine_test.go stabiliseOperand."""
+    if not isinstance(operand_json, dict) or "expression" not in operand_json:
+        return operand_json
+    expr = operand_json["expression"]
+    ops = [stabilise(o) for o in expr.get("operands", [])]
+    op = expr.get("operator", "")
+    if op == "struct":
+        ops.sort(key=lambda o: str(o.get("expression", {}).get("operands", [{}])[0].get("value", "")))
+    if op in COMMUTATIVE:
+        ops.sort(key=lambda o: json.dumps(o, sort_keys=True))
+    return {"expression": {"operator": op, "operands": ops}}
+
+
+def norm_values(x):
+    """YAML ints vs structpb doubles: normalize numbers inside value nodes."""
+    if isinstance(x, dict):
+        if set(x) == {"value"}:
+            v = x["value"]
+            return {"value": _norm_v(v)}
+        return {k: norm_values(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [norm_values(v) for v in x]
+    return x
+
+
+def _norm_v(v):
+    if isinstance(v, bool) or v is None or isinstance(v, str):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, list):
+        return [_norm_v(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _norm_v(x) for k, x in v.items()}
+    return v
+
+
+def run_suite(name, suite, lenient):
+    planner = Planner(plan_table())
+    params = make_params(lenient)
+    p = suite["principal"]
+    principal = Principal(
+        id=p["id"],
+        roles=list(p.get("roles", [])),
+        attr=p.get("attr", {}) or {},
+        policy_version=p.get("policyVersion", ""),
+        scope=p.get("scope", ""),
+    )
+    aux = AuxData(jwt={"customInt": 42})
+    failures = []
+    for i, tt in enumerate(suite.get("tests", [])):
+        actions = tt.get("actions") or [tt["action"]]
+        res = tt["resource"]
+        inp = PlanInput(
+            request_id="requestId",
+            actions=list(actions),
+            principal=principal,
+            resource_kind=res["kind"],
+            resource_attr=res.get("attr", {}) or {},
+            resource_policy_version=res.get("policyVersion", ""),
+            resource_scope=res.get("scope", ""),
+            aux_data=aux,
+            include_meta=True,
+        )
+        label = f"{name}#{i} {res['kind']}/{','.join(actions)}"
+        if tt.get("wantErr"):
+            try:
+                planner.plan(inp, params)
+                failures.append(f"{label}: expected error, got success")
+            except Exception:
+                pass
+            continue
+        try:
+            out = planner.plan(inp, params)
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"{label}: raised {type(e).__name__}: {e}")
+            continue
+        want = tt["want"]
+        have = {"kind": out.kind}
+        if out.condition is not None:
+            have["condition"] = out.condition.to_json()
+        want_n = {"kind": want["kind"]}
+        if "condition" in want:
+            want_n["condition"] = stabilise(norm_values(want["condition"]))
+        have_n = {"kind": have["kind"]}
+        if "condition" in have:
+            have_n["condition"] = stabilise(norm_values(have["condition"]))
+        if want_n != have_n:
+            failures.append(
+                f"{label}:\n  want {json.dumps(want_n, sort_keys=True)}\n  have {json.dumps(have_n, sort_keys=True)}"
+            )
+    return failures
+
+
+COMMON = load_cases("query_planner/suite/common")
+STRICT = load_cases("query_planner/suite/strict_scope_search")
+LENIENT = load_cases("query_planner/suite/lenient_scope_search")
+
+
+def _id(ct):
+    return ct[0].rsplit("/", 1)[-1]
+
+
+@pytest.mark.parametrize("case_tuple", COMMON + STRICT, ids=_id)
+def test_plan_strict(case_tuple):
+    name, suite = case_tuple
+    failures = run_suite(name, suite, lenient=False)
+    assert not failures, "\n".join(failures)
+
+
+@pytest.mark.parametrize("case_tuple", COMMON + LENIENT, ids=_id)
+def test_plan_lenient(case_tuple):
+    name, suite = case_tuple
+    failures = run_suite(name, suite, lenient=True)
+    assert not failures, "\n".join(failures)
