@@ -146,13 +146,18 @@ class PartialEvaluator:
             val_node: A.Node = A.Lit(value)
             if field is not None:
                 val_node = A.Select(val_node, field)
+            # mkOption emits op(const, value); that order is correct for the
+            # symmetric ==/!=/in cases the reference tests, but inverts the
+            # ordered comparisons (m[x] < c must become value < c, not
+            # c < value) — deliberate fix over struct_matcher.go:258-264
+            if node.fn in ("_<_", "_<=_", "_>_", "_>=_"):
+                cmp_node = A.Call(node.fn, (val_node, const))
+            else:
+                cmp_node = A.Call(node.fn, (const, val_node))
             opts.append(
                 A.Call(
                     "_&&_",
-                    (
-                        A.Call("_==_", (indexer, A.Lit(key))),
-                        A.Call(node.fn, (const, val_node)),
-                    ),
+                    (A.Call("_==_", (indexer, A.Lit(key))), cmp_node),
                 )
             )
         # right-nested OR chain (struct_matcher.go mkLogicalOr)

@@ -495,8 +495,11 @@ def ast_to_operand(node: A.Node) -> Operand:
     if isinstance(node, A.Comprehension):
         return _comprehension_to_operand(node)
     if isinstance(node, A.Bind):
-        # cel.bind residual: inline the bound value
-        return ast_to_operand(_substitute(node.body, node.name, node.init))
+        # cel.bind residual: inline the bound value (shadow-aware, recurses
+        # into comprehensions — see partial._substitute_many)
+        from .partial import _substitute_many
+
+        return ast_to_operand(_substitute_many(node.body, {node.name: node.init}))
     if isinstance(node, A.Call):
         if node.fn == "_in_" and len(node.args) == 2:
             keys = _map_keys_operand(node.args[1])
@@ -535,28 +538,6 @@ def _comprehension_to_operand(node: A.Comprehension) -> Operand:
     if range_op is None:
         range_op = ast_to_operand(iter_range)
     return Operand.expr(op, range_op, Operand.expr("lambda", *lambda_args))
-
-
-def _substitute(node: A.Node, name: str, value: A.Node) -> A.Node:
-    if isinstance(node, A.Ident):
-        return value if node.name == name else node
-    if isinstance(node, A.Select):
-        return A.Select(_substitute(node.operand, name, value), node.field)
-    if isinstance(node, A.Present):
-        return A.Present(_substitute(node.operand, name, value), node.field)
-    if isinstance(node, A.Index):
-        return A.Index(_substitute(node.operand, name, value), _substitute(node.index, name, value))
-    if isinstance(node, A.Call):
-        return A.Call(
-            node.fn,
-            tuple(_substitute(a, name, value) for a in node.args),
-            target=_substitute(node.target, name, value) if node.target is not None else None,
-        )
-    if isinstance(node, A.ListLit):
-        return A.ListLit(tuple(_substitute(x, name, value) for x in node.items))
-    if isinstance(node, A.MapLit):
-        return A.MapLit(tuple((_substitute(k, name, value), _substitute(v, name, value)) for k, v in node.entries))
-    return node
 
 
 def _variable_name(node: A.Node) -> Optional[str]:

@@ -203,24 +203,16 @@ class _Compiler:
 
     def path_of(self, node: A.Node) -> Optional[tuple[str, ...]]:
         """Select/Index chain rooted at request/R/P → canonical path."""
-        segs: list[str] = []
-        cur = node
-        while True:
-            if isinstance(cur, A.Select):
-                segs.append(cur.field)
-                cur = cur.operand
-            elif isinstance(cur, A.Index) and isinstance(cur.index, A.Lit) and isinstance(cur.index.value, str):
-                segs.append(cur.index.value)
-                cur = cur.operand
-            elif isinstance(cur, A.Ident):
-                if cur.name == "runtime":
-                    self.k.references_runtime = True
-                    return None
-                if cur.name in _ROOT_ALIASES:
-                    return _ROOT_ALIASES[cur.name] + tuple(reversed(segs))
-                return None
-            else:
-                return None
+        split = _split_chain(node)
+        if split is None:
+            return None
+        root, segs = split
+        if root == "runtime":
+            self.k.references_runtime = True
+            return None
+        if root in _ROOT_ALIASES:
+            return _ROOT_ALIASES[root] + segs
+        return None
 
     # -- boolean compilation ----------------------------------------------
 
@@ -518,19 +510,91 @@ class _Compiler:
     interner: StringInterner  # set by compile_condition
 
 
+def _split_chain(node: A.Node) -> Optional[tuple[str, tuple[str, ...]]]:
+    """Maximal select/literal-index chain → (root ident, segments)."""
+    segs: list[str] = []
+    cur = node
+    while True:
+        if isinstance(cur, A.Select):
+            segs.append(cur.field)
+            cur = cur.operand
+        elif isinstance(cur, A.Index) and isinstance(cur.index, A.Lit) and isinstance(cur.index.value, str):
+            segs.append(cur.index.value)
+            cur = cur.operand
+        elif isinstance(cur, A.Ident):
+            return cur.name, tuple(reversed(segs))
+        else:
+            return None
+
+
+def _chain_of(node: A.Node) -> Optional[tuple[str, ...]]:
+    """Maximal chain rooted at request/R/P → canonical path."""
+    split = _split_chain(node)
+    if split is None or split[0] not in _ROOT_ALIASES:
+        return None
+    return _ROOT_ALIASES[split[0]] + split[1]
+
+
 def _pred_refs(node: A.Node) -> tuple[set[tuple[str, ...]], bool, bool]:
-    """(referenced request paths, references_runtime, time_dependent)."""
+    """(referenced request paths, references_runtime, time_dependent).
+
+    Paths are MAXIMAL chains (e.g. ("aux_data", "jwt", "aud"), not
+    ("aux_data",)) so the packer's predicate cache keys freeze only the leaf
+    values actually read, not whole subtrees."""
     paths: set[tuple[str, ...]] = set()
     refs_runtime = False
     time_dep = False
-    for n in A.walk(node):
+
+    def visit(n: A.Node) -> None:
         if isinstance(n, A.Ident):
             if n.name == "runtime":
+                nonlocal refs_runtime
                 refs_runtime = True
-        if isinstance(n, A.Call) and n.fn in ("now", "timeSince"):
-            time_dep = True
-        if isinstance(n, A.Select) and isinstance(n.operand, A.Ident) and n.operand.name in _ROOT_ALIASES:
-            paths.add(_ROOT_ALIASES[n.operand.name] + (n.field,))
+            return
+        if isinstance(n, A.Call):
+            nonlocal time_dep
+            if n.fn in ("now", "timeSince"):
+                time_dep = True
+            if n.target is not None:
+                visit(n.target)
+            for a in n.args:
+                visit(a)
+            return
+        if isinstance(n, (A.Select, A.Present, A.Index)):
+            chain = _chain_of(n if not isinstance(n, A.Present) else A.Select(n.operand, n.field))
+            if chain is not None:
+                paths.add(chain)
+                # still visit a computed index expression
+                if isinstance(n, A.Index):
+                    visit(n.index)
+                return
+            if isinstance(n, (A.Select, A.Present)):
+                visit(n.operand)
+            else:
+                visit(n.operand)
+                visit(n.index)
+            return
+        if isinstance(n, A.ListLit):
+            for x in n.items:
+                visit(x)
+            return
+        if isinstance(n, A.MapLit):
+            for k, v in n.entries:
+                visit(k)
+                visit(v)
+            return
+        if isinstance(n, A.Bind):
+            visit(n.init)
+            visit(n.body)
+            return
+        if isinstance(n, A.Comprehension):
+            visit(n.iter_range)
+            visit(n.step)
+            if n.step2 is not None:
+                visit(n.step2)
+            return
+
+    visit(node)
     return paths, refs_runtime, time_dep
 
 

@@ -34,6 +34,19 @@ from .lowering import (
 )
 from .packer import PackedBatch, Packer, PT_PRINCIPAL, PT_RESOURCE
 
+def _clone_output(template: "T.CheckOutput", inp: "T.CheckInput") -> "T.CheckOutput":
+    """Fresh CheckOutput from a memoized assembly (ids swapped, effects copied)."""
+    return T.CheckOutput(
+        request_id=inp.request_id,
+        resource_id=inp.resource.id,
+        actions={
+            a: T.ActionEffect(effect=e.effect, policy=e.policy, scope=e.scope)
+            for a, e in template.actions.items()
+        },
+        effective_derived_roles=list(template.effective_derived_roles),
+    )
+
+
 CODE_NO_MATCH = 0
 CODE_ALLOW = 1
 CODE_DENY = 2
@@ -299,6 +312,9 @@ class TpuEvaluator:
         self._jit_cache: dict = {}
         self._dr_table_cache: dict = {}
         self._roles_cache: dict = {}
+        self._edr_memo: dict = {}
+        self._assemble_memo: dict = {}
+        self._dr_cids_cache: dict = {}
 
     def refresh(self) -> None:
         """Re-lower after a policy reload (storage event hook)."""
@@ -307,6 +323,9 @@ class TpuEvaluator:
         self._jit_cache.clear()
         self._dr_table_cache.clear()
         self._roles_cache.clear()
+        self._edr_memo.clear()
+        self._assemble_memo.clear()
+        self._dr_cids_cache.clear()
 
     def check(self, inputs: list[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
         params = params or T.EvalParams()
@@ -335,8 +354,81 @@ class TpuEvaluator:
                 outputs.append(out)
                 continue
             self.stats["device_inputs"] += 1
-            outputs.append(self._assemble(plan, bi, batch, final, role_results, win_j, sat_cond, params))
+            # schema validation runs on host per input, mirroring the
+            # oracle's pre-loop check (check.go:129-151); a reject means
+            # every action denies without evaluating rules
+            vr_errors: list = []
+            if self.schema_mgr is not None:
+                vr_errors, reject = self.schema_mgr.validate_check_input(
+                    self.rule_table.get_schema(plan.resource_policy_fqn), inp
+                )
+                if reject:
+                    out = T.CheckOutput(request_id=inp.request_id, resource_id=inp.resource.id)
+                    for action in inp.actions:
+                        out.actions[action] = T.ActionEffect(
+                            effect=T.EFFECT_DENY, policy=plan.resource_policy_key
+                        )
+                    out.validation_errors = vr_errors
+                    outputs.append(out)
+                    continue
+            key = None
+            if not vr_errors:
+                key = self._assemble_key(plan, bi, batch, final, role_results, win_j, sat_cond, params)
+            if key is not None:
+                hit = self._assemble_memo.get(key)
+                if hit is not None:
+                    outputs.append(_clone_output(hit, inp))
+                    continue
+            out = self._assemble(plan, bi, batch, final, role_results, win_j, sat_cond, params)
+            out.validation_errors = vr_errors
+            if key is not None:
+                if len(self._assemble_memo) > 65536:
+                    self._assemble_memo.clear()
+                self._assemble_memo[key] = out
+            outputs.append(out)
         return outputs
+
+    def _assemble_key(self, plan, bi, batch, final, role_results, win_j, sat_cond, params):
+        """Equivalence-class key for a device result: inputs with the same
+        plan signature, device decision rows and derived-role condition bits
+        assemble to identical outputs (modulo request/resource ids). Not
+        applicable when the table emits outputs (output values read raw
+        attrs) or a schema manager can attach per-input validation errors."""
+        if self.lowered.has_outputs:
+            return None
+        inp = plan.input
+        start, end = plan.ba_range
+        version = T.effective_version(inp.resource.policy_version, params)
+        chain_key = (inp.resource.kind, version, tuple(plan.resource_scopes))
+        cids = self._dr_cids_cache.get(chain_key)
+        if cids is None:
+            all_cids: list[int] = []
+            for scope in plan.resource_scopes:
+                for _, _, cid, dr in self._dr_table(inp.resource.kind, version, scope):
+                    if cid >= 0:
+                        all_cids.append(cid)
+                    elif dr.condition is not None:
+                        all_cids = None  # host-evaluated DR: not memoizable
+                        break
+                if all_cids is None:
+                    break
+            cids = np.asarray(all_cids, dtype=np.int64) if all_cids is not None else "host"
+            self._dr_cids_cache[chain_key] = cids
+        if isinstance(cids, str):
+            return None
+        dr_bits = sat_cond[bi, cids].tobytes() if cids.size else b""
+        return (
+            chain_key,
+            tuple(plan.principal_scopes),
+            plan.principal_policy_key,
+            plan.resource_policy_key,
+            tuple(plan.roles),
+            tuple(inp.actions),
+            np.asarray(final[start:end]).tobytes(),
+            np.asarray(role_results[start:end]).tobytes(),
+            np.asarray(win_j[start:end]).tobytes(),
+            dr_bits,
+        )
 
     # -- host assembly -----------------------------------------------------
 
@@ -436,6 +528,19 @@ class TpuEvaluator:
         emit_outputs = self.lowered.has_outputs
         for pt, ks in passes:
             chain = plan.principal_scopes if pt == PT_PRINCIPAL else plan.resource_scopes
+            if not emit_outputs and pt == PT_RESOURCE:
+                # no outputs anywhere in the table: only the processed-depth
+                # bookkeeping matters, and the max depth over roles covers it
+                overall = -1
+                for k in ks:
+                    code = int(role_results[ci, k, pt, 0])
+                    depth = int(role_results[ci, k, pt, 1])
+                    overall = max(overall, min(depth, len(chain) - 1) if code != CODE_NO_MATCH else len(chain) - 1)
+                    if code == CODE_ALLOW:
+                        break
+                for d in range(0, overall + 1):
+                    bookkeep_depth(d)
+                continue
             for k in ks:
                 code = int(role_results[ci, k, pt, 0])
                 depth = int(role_results[ci, k, pt, 1])
@@ -505,7 +610,12 @@ class TpuEvaluator:
         return hit
 
     def _edr_at_depth(self, plan, bi, depth, params, eval_ctx, sat_cond) -> set[str]:
-        """Derived roles activated at one resource-chain scope depth."""
+        """Derived roles activated at one resource-chain scope depth.
+
+        Memoized per (scope fqn, principal roles, device condition bits) —
+        inputs sharing role sets and condition outcomes (the common case in
+        large batches) reuse the set. Tables with host-evaluated derived-role
+        conditions bypass the cache (their outcome depends on raw attrs)."""
         inp = plan.input
         if depth >= len(plan.resource_scopes):
             return set()
@@ -521,6 +631,15 @@ class TpuEvaluator:
         edr: set[str] = set()
         sat_b = sat_cond[bi]
         table = self._dr_table(inp.resource.kind, resource_version, plan.resource_scopes[depth])
+        cacheable = all(cid >= 0 or dr.condition is None for _, _, cid, dr in table)
+        if cacheable:
+            bits = tuple(bool(sat_b[cid]) for _, _, cid, _ in table if cid >= 0)
+            mkey = (inp.resource.kind, resource_version, plan.resource_scopes[depth], roles_key, bits)
+            hit = self._edr_memo.get(mkey)
+            if hit is not None:
+                return hit
+        else:
+            mkey = None
         for name, parent_roles, cid, dr in table:
             if name in edr:
                 continue
@@ -539,6 +658,10 @@ class TpuEvaluator:
                 variables = ec.evaluate_variables(dr.params.constants, dr.params.ordered_variables)
                 if ec.satisfies_condition(dr.condition, dr.params.constants, variables):
                     edr.add(name)
+        if mkey is not None:
+            if len(self._edr_memo) > 65536:
+                self._edr_memo.clear()
+            self._edr_memo[mkey] = edr
         return edr
 
     def _effective_derived_roles(self, plan, bi, depths, params, eval_ctx, sat_cond) -> list[str]:

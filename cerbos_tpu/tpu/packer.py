@@ -25,7 +25,6 @@ from .columns import (
     ColumnBatch,
     TAG_OTHER,
     encode_value,
-    resolve_path,
 )
 from .condcompile import evaluate_pred_host
 from .lowering import (
@@ -107,6 +106,7 @@ class Packer:
         self._exists_cache: dict[tuple, bool] = {}
         self._cell_cache: dict[tuple, Optional[tuple]] = {}
         self._accessors: dict[tuple, Any] = {}
+        self._pred_accessors: dict[int, list] = {}
         self._encode_cache: dict[Any, tuple] = {}
 
     def invalidate(self) -> None:
@@ -116,6 +116,7 @@ class Packer:
         self._exists_cache.clear()
         self._cell_cache.clear()
         self._accessors.clear()
+        self._pred_accessors.clear()
         self._encode_cache.clear()
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
@@ -470,7 +471,16 @@ class Packer:
         if fn is not None:
             return fn
         _MISSING = _MISSING_SENTINEL
-        if len(path) == 3 and path[0] in ("principal", "resource") and path[1] == "attr":
+        if len(path) == 3 and path[0] in ("aux_data", "auxData") and path[1] == "jwt":
+            leaf = path[2]
+
+            def fn(inp, leaf=leaf):  # type: ignore[misc]
+                aux = inp.aux_data
+                if aux is None:
+                    return _MISSING
+                return aux.jwt.get(leaf, _MISSING)
+
+        elif len(path) == 3 and path[0] in ("principal", "resource") and path[1] == "attr":
             root, leaf = path[0], path[2]
 
             def fn(inp, root=root, leaf=leaf):  # type: ignore[misc]
@@ -518,6 +528,8 @@ class Packer:
         paths = sorted(self.lt.paths)
         encode_cache = self._encode_cache
         native = native_mod.get()
+        # filter once, not once per path
+        active = [(bi, plan) for bi, plan in enumerate(plans) if not (plan.trivial or plan.oracle)]
         for p in paths:
             t = np.zeros(B, dtype=np.int8)
             h = np.zeros(B, dtype=np.int32)
@@ -529,9 +541,7 @@ class Packer:
             # float values batch through the native key encoder
             num_idx: list[int] = []
             num_vals: list[float] = []
-            for bi, plan in enumerate(plans):
-                if plan.trivial or plan.oracle:
-                    continue
+            for bi, plan in active:
                 v = accessor(plan.input)
                 if v is _MISSING_SENTINEL:
                     continue  # TAG_MISSING zeros already in place
@@ -573,13 +583,12 @@ class Packer:
         # predicate columns
         preds = self.lt.compiler.preds
         if preds:
-            now_key = None
             for spec in preds:
                 vals = np.zeros(B, dtype=bool)
                 errs = np.zeros(B, dtype=bool)
-                for bi, plan in enumerate(plans):
-                    if plan.trivial or plan.oracle:
-                        continue
+                for bi, plan in active:
+                    if plan.oracle:
+                        continue  # may have been flagged during encoding
                     v, e = self._eval_pred(spec, plan, params)
                     vals[bi], errs[bi] = v, e
                 cb.pred_vals[spec.pred_id] = vals
@@ -587,13 +596,27 @@ class Packer:
         return cb
 
 
+    def _pred_key_accessors(self, spec):
+        accs = self._pred_accessors.get(spec.pred_id)
+        if accs is None:
+            accs = [self._path_accessor(p) for p in spec.ref_paths]
+            self._pred_accessors[spec.pred_id] = accs
+        return accs
+
     def _eval_pred(self, spec, plan: InputPlan, params: T.EvalParams) -> tuple[bool, bool]:
-        view = self._input_view(plan.input)
         cache_key = None
         if not spec.time_dependent:
             try:
-                ref_vals = tuple(_freeze(resolve_path(view, p)) for p in spec.ref_paths)
-                cache_key = (spec.pred_id, ref_vals)
+                vals = []
+                for acc in self._pred_key_accessors(spec):
+                    v = acc(plan.input)
+                    # typed scalars pass through (True/1/1.0 must not
+                    # collide); containers freeze
+                    if v is None or type(v) in (str, bool, int, float):
+                        vals.append((type(v), v) if type(v) in (bool, int, float) else v)
+                    else:
+                        vals.append(_freeze(v))
+                cache_key = (spec.pred_id, tuple(vals))
             except TypeError:
                 cache_key = None
         if cache_key is not None:

@@ -32,6 +32,32 @@ def generate_policies(policy_dir: str, n_mods: int) -> None:
     for i, doc in enumerate(docs):
         with open(os.path.join(policy_dir, f"policy_{i:05d}.yaml"), "w") as f:
             f.write(doc)
+    # the policies carry cerbos:/// schema refs; ship the schemas alongside
+    # so schema.enforcement=warn/reject works against this store
+    schema_dir = os.path.join(policy_dir, "_schemas")
+    os.makedirs(schema_dir, exist_ok=True)
+    for name, data in bench_corpus.schemas(n_mods).items():
+        with open(os.path.join(schema_dir, name), "wb") as f:
+            f.write(data)
+
+
+_LOADTEST_SECRET = b"cerbos-tpu-loadtest-secret"
+
+
+def _hs256_token(claims: dict) -> str:
+    """Real signed token so the PDP's JWT verify path is exercised, like the
+    reference loadtest's auxData requests."""
+    import base64
+    import hashlib
+    import hmac as hmac_mod
+
+    def b64(b: bytes) -> bytes:
+        return base64.urlsafe_b64encode(b).rstrip(b"=")
+
+    header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = b64(json.dumps(claims).encode())
+    sig = b64(hmac_mod.new(_LOADTEST_SECRET, header + b"." + payload, hashlib.sha256).digest())
+    return (header + b"." + payload + b"." + sig).decode()
 
 
 def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool) -> dict:
@@ -40,24 +66,49 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
 
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
-    pdp = serve(overrides=[
-        f"storage.disk.directory={tmp}",
-        "server.httpListenAddr=127.0.0.1:0",
-        "server.grpcListenAddr=127.0.0.1:0",
-        f"engine.tpu.enabled={'true' if use_tpu else 'false'}",
-    ], use_tpu=use_tpu if use_tpu else None)
+    import base64
+
+    import yaml
+
+    cfg_path = os.path.join(tmp, ".cerbos.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(
+            {
+                "server": {"httpListenAddr": "127.0.0.1:0", "grpcListenAddr": "127.0.0.1:0"},
+                "storage": {"driver": "disk", "disk": {"directory": tmp}},
+                "engine": {"tpu": {"enabled": bool(use_tpu)}},
+                "auxData": {
+                    "jwt": {
+                        "keySets": [
+                            {
+                                "id": "default",
+                                "algorithm": "HS256",
+                                "local": {"data": base64.b64encode(_LOADTEST_SECRET).decode()},
+                            }
+                        ]
+                    }
+                },
+            },
+            f,
+        )
+    pdp = serve(config_file=cfg_path, use_tpu=use_tpu if use_tpu else None)
 
     inputs = bench_corpus.requests(512, n_mods)
     bodies = []
     for i in inputs:
-        bodies.append(json.dumps({
+        body = {
             "requestId": i.request_id,
             "principal": {"id": i.principal.id, "roles": i.principal.roles,
-                          "policyVersion": i.principal.policy_version, "attr": i.principal.attr},
+                          "policyVersion": i.principal.policy_version,
+                          "scope": i.principal.scope, "attr": i.principal.attr},
             "resources": [{"actions": i.actions,
                            "resource": {"kind": i.resource.kind, "id": i.resource.id,
-                                        "policyVersion": i.resource.policy_version, "attr": i.resource.attr}}],
-        }).encode())
+                                        "policyVersion": i.resource.policy_version,
+                                        "scope": i.resource.scope, "attr": i.resource.attr}}],
+        }
+        if i.aux_data is not None:
+            body["auxData"] = {"jwt": {"token": _hs256_token(i.aux_data.jwt)}}
+        bodies.append(json.dumps(body).encode())
 
     latencies: list[float] = []
     counts = [0] * connections

@@ -92,6 +92,42 @@ def test_lenient_device(device_engine, case_tuple):
     assert not errs, "\n".join(errs)
 
 
+@pytest.fixture(scope="module", params=["warn", "reject"])
+def device_schema_engine(request):
+    """Schema-enforcement golden cases through the device path."""
+    from cerbos_tpu.ruletable import build_rule_table
+    from cerbos_tpu.schema import SchemaManager
+    from cerbos_tpu.tpu import TpuEvaluator
+    from golden_loader import golden_policies
+
+    store, compiled = golden_policies()
+    table = build_rule_table(compiled)
+    schema_mgr = SchemaManager(store, enforcement=request.param)
+    ev = TpuEvaluator(
+        table,
+        globals_=dict(GOLDEN_GLOBALS),
+        schema_mgr=schema_mgr,
+        use_jax=False,
+        min_device_batch=0,
+    )
+    engine = Engine(
+        table,
+        schema_mgr=schema_mgr,
+        eval_params=EvalParams(globals=dict(GOLDEN_GLOBALS)),
+        tpu_evaluator=ev,
+        tpu_batch_threshold=1,
+    )
+    return request.param, engine
+
+
+def test_schema_device(device_schema_engine):
+    enforcement, engine = device_schema_engine
+    cases = WARN_CASES if enforcement == "warn" else REJECT_CASES
+    for name, case in cases:
+        errs = run_case(engine, case)
+        assert not errs, f"{name}: " + "\n".join(errs)
+
+
 @pytest.mark.parametrize("case_tuple", STRICT_CASES, ids=_id)
 def test_strict(strict_engine, case_tuple):
     _, case = case_tuple
