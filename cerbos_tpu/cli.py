@@ -93,7 +93,7 @@ def cmd_compile(args: argparse.Namespace) -> int:
 
     from .verify.runner import discover_and_run
 
-    results = discover_and_run(args.dir, run_filter=args.run)
+    results = discover_and_run(args.dir, run_filter=args.run, verbose=getattr(args, "verbose", False))
     if results is None:
         return 0  # no test suites found
     if args.output == "json":
@@ -114,7 +114,11 @@ def cmd_compilestore(args: argparse.Namespace) -> int:
     try:
         store = DiskStore(args.dir)
         compile_policy_set(store.get_all())  # lint before bundling
-        manifest = build_bundle(store, args.output)
+        key = None
+        if getattr(args, "sign_key", None):
+            with open(args.sign_key, "rb") as kf:
+                key = kf.read().strip()
+        manifest = build_bundle(store, args.output, signing_key=key)
     except (BuildError, CompileError, BundleError) as e:
         for err in getattr(e, "errors", [str(e)]):
             print(f"ERROR: {err}", file=sys.stderr)
@@ -195,12 +199,14 @@ def main(argv: list[str] | None = None) -> int:
     p_compile.add_argument("dir", help="policy directory")
     p_compile.add_argument("--output", choices=("tree", "json", "junit"), default="tree")
     p_compile.add_argument("--run", help="run only tests matching this regex", default="")
+    p_compile.add_argument("--verbose", action="store_true", help="include evaluation traces for failed tests")
     p_compile.add_argument("--skip-tests", action="store_true")
     p_compile.set_defaults(fn=cmd_compile)
 
     p_cs = sub.add_parser("compilestore", help="build a pre-compiled policy bundle")
     p_cs.add_argument("dir", help="policy directory")
     p_cs.add_argument("--output", "-o", default="bundle.crbp")
+    p_cs.add_argument("--sign-key", help="HMAC key file; lets loaders verify the compiled IR without trustCompiled")
     p_cs.set_defaults(fn=cmd_compilestore)
 
     p_hc = sub.add_parser("healthcheck", help="probe a running PDP")

@@ -44,9 +44,118 @@ class Client:
             raise SystemExit(f"error: {e.code} {detail}") from None
 
 
+class GrpcClient:
+    """cerbos.svc.v1.CerbosAdminService transport (the reference cerbosctl's
+    native protocol); exposes the same call(method, path) surface as the
+    HTTP client so the command handlers stay transport-agnostic."""
+
+    def __init__(self, server: str, username: str, password: str):
+        import grpc
+
+        from .api.cerbos.request.v1 import request_pb2
+        from .api.cerbos.response.v1 import response_pb2
+
+        self.req = request_pb2
+        self.resp = response_pb2
+        self.channel = grpc.insecure_channel(server)
+        token = base64.b64encode(f"{username}:{password}".encode()).decode()
+        self.metadata = (("authorization", f"Basic {token}"),)
+
+    def _rpc(self, name: str, request, resp_cls, stream: bool = False):
+        import grpc
+
+        factory = self.channel.unary_stream if stream else self.channel.unary_unary
+        fn = factory(
+            f"/cerbos.svc.v1.CerbosAdminService/{name}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        try:
+            return fn(request, metadata=self.metadata, timeout=30)
+        except grpc.RpcError as e:
+            raise SystemExit(f"error: {e.code().name} {e.details()}") from None
+
+    def call(self, method: str, path: str, body: dict | None = None, params: dict | None = None):
+        from google.protobuf import json_format
+
+        from .api.cerbos.policy.v1 import policy_pb2
+        from .api.cerbos.schema.v1 import schema_pb2
+
+        params = params or {}
+        if path == "/admin/policies":
+            r = self._rpc(
+                "ListPolicies",
+                self.req.ListPoliciesRequest(include_disabled=params.get("includeDisabled") == "true"),
+                self.resp.ListPoliciesResponse,
+            )
+            return {"policyIds": list(r.policy_ids)}
+        if path == "/admin/policy" and method == "GET":
+            r = self._rpc("GetPolicy", self.req.GetPolicyRequest(id=params.get("id", [])), self.resp.GetPolicyResponse)
+            return {"policies": [json_format.MessageToDict(p) for p in r.policies]}
+        if path == "/admin/policy" and method == "POST":
+            req = self.req.AddOrUpdatePolicyRequest()
+            for p in (body or {}).get("policies", []):
+                req.policies.append(json_format.ParseDict(p, policy_pb2.Policy(), ignore_unknown_fields=True))
+            self._rpc("AddOrUpdatePolicy", req, self.resp.AddOrUpdatePolicyResponse)
+            return {"success": {}}
+        if path == "/admin/policy" and method == "DELETE":
+            raise SystemExit("error: the gRPC admin API has no DeletePolicy (match the reference); use disable")
+        if path in ("/admin/policy/enable", "/admin/policy/disable"):
+            enable = path.endswith("enable")
+            name = "EnablePolicy" if enable else "DisablePolicy"
+            req = (self.req.EnablePolicyRequest if enable else self.req.DisablePolicyRequest)(id=params.get("id", []))
+            r = self._rpc(name, req, self.resp.EnablePolicyResponse if enable else self.resp.DisablePolicyResponse)
+            return {"enabledPolicies": r.enabled_policies} if enable else {"disabledPolicies": r.disabled_policies}
+        if path == "/admin/schemas":
+            r = self._rpc("ListSchemas", self.req.ListSchemasRequest(), self.resp.ListSchemasResponse)
+            return {"schemaIds": list(r.schema_ids)}
+        if path == "/admin/schema" and method == "GET":
+            r = self._rpc("GetSchema", self.req.GetSchemaRequest(id=params.get("id", [])), self.resp.GetSchemaResponse)
+            return {"schemas": [{"id": s.id, "definition": json.loads(s.definition or b"{}")} for s in r.schemas]}
+        if path == "/admin/schema" and method == "POST":
+            req = self.req.AddOrUpdateSchemaRequest()
+            for s in (body or {}).get("schemas", []):
+                req.schemas.append(
+                    schema_pb2.Schema(id=s["id"], definition=json.dumps(s["definition"]).encode())
+                )
+            self._rpc("AddOrUpdateSchema", req, self.resp.AddOrUpdateSchemaResponse)
+            return {}
+        if path == "/admin/schema" and method == "DELETE":
+            r = self._rpc("DeleteSchema", self.req.DeleteSchemaRequest(id=params.get("id", [])), self.resp.DeleteSchemaResponse)
+            return {"deletedSchemas": r.deleted_schemas}
+        if path == "/admin/store/reload":
+            self._rpc("ReloadStore", self.req.ReloadStoreRequest(), self.resp.ReloadStoreResponse)
+            return {}
+        if path.startswith("/admin/auditlog/list/"):
+            kind_name = path.rsplit("/", 1)[-1]
+            kind = (
+                self.req.ListAuditLogEntriesRequest.KIND_DECISION
+                if kind_name == "decision_logs"
+                else self.req.ListAuditLogEntriesRequest.KIND_ACCESS
+            )
+            req = self.req.ListAuditLogEntriesRequest(kind=kind, tail=int(params.get("tail", "20")))
+            entries = []
+            import grpc
+
+            try:
+                # stream errors surface on iteration, not on the call itself
+                for msg in self._rpc("ListAuditLogEntries", req, self.resp.ListAuditLogEntriesResponse, stream=True):
+                    field = msg.WhichOneof("entry")
+                    if field:
+                        entries.append(json_format.MessageToDict(getattr(msg, field)))
+            except grpc.RpcError as e:
+                raise SystemExit(f"error: {e.code().name} {e.details()}") from None
+            return {"entries": entries}
+        raise SystemExit(f"error: unsupported admin call {method} {path}")
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="cerbos-tpuctl", description="Admin client for cerbos-tpu PDPs")
     parser.add_argument("--server", default="127.0.0.1:3592")
+    parser.add_argument(
+        "--grpc", action="store_true",
+        help="talk to the gRPC admin API (cerbos.svc.v1.CerbosAdminService) instead of HTTP",
+    )
     parser.add_argument("--username", default="cerbos")
     parser.add_argument("--password", default="cerbosAdmin")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -77,7 +186,10 @@ def main(argv: list[str] | None = None) -> int:
     p_audit.add_argument("--tail", type=int, default=20)
 
     args = parser.parse_args(argv)
-    client = Client(args.server, args.username, args.password)
+    if args.grpc:
+        client: Client | GrpcClient = GrpcClient(args.server, args.username, args.password)
+    else:
+        client = Client(args.server, args.username, args.password)
 
     if args.command == "get":
         if args.kind == "policies" or (args.kind == "policy" and not args.ids):

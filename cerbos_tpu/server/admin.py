@@ -63,7 +63,209 @@ class AdminService:
         app.router.add_post("/admin/policies/inspect", self._h_inspect)
 
     def grpc_handler(self):
-        return None  # gRPC admin surface lands with the full admin proto set
+        """Wire-compatible cerbos.svc.v1.CerbosAdminService (ref:
+        internal/svc/admin_svc.go) over the same store operations as the
+        HTTP surface; basic auth read from request metadata."""
+        import grpc
+
+        from .. import namer
+        from ..api.cerbos.policy.v1 import policy_pb2
+        from ..api.cerbos.request.v1 import request_pb2
+        from ..api.cerbos.response.v1 import response_pb2
+        from ..api.cerbos.schema.v1 import schema_pb2
+        from google.protobuf import json_format
+
+        svc = self
+
+        def guard(ctx: grpc.ServicerContext) -> None:
+            header = dict(ctx.invocation_metadata()).get("authorization", "")
+            if not header.startswith("Basic "):
+                ctx.abort(grpc.StatusCode.UNAUTHENTICATED, "unauthenticated")
+            try:
+                user, _, pw = base64.b64decode(header[6:]).decode("utf-8").partition(":")
+            except Exception:  # noqa: BLE001
+                ctx.abort(grpc.StatusCode.UNAUTHENTICATED, "unauthenticated")
+            ok = secrets.compare_digest(user, svc.username)
+            if svc.password_hash:
+                ok = ok and secrets.compare_digest(
+                    hashlib.sha256(pw.encode()).hexdigest(), svc.password_hash
+                )
+            else:
+                ok = ok and secrets.compare_digest(pw, svc.password)
+            if not ok:
+                ctx.abort(grpc.StatusCode.UNAUTHENTICATED, "unauthenticated")
+
+        def mutable(ctx) -> Any:
+            store = self._mutable_store()
+            if store is None:
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "store is not mutable")
+            return store
+
+        def add_or_update_policy(req: request_pb2.AddOrUpdatePolicyRequest, ctx):
+            guard(ctx)
+            store = mutable(ctx)
+            import yaml as _yaml
+
+            docs = [
+                _yaml.safe_dump(json_format.MessageToDict(p, preserving_proto_field_name=False))
+                for p in req.policies
+            ]
+            try:
+                store.add_or_update(docs)
+            except Exception as e:  # noqa: BLE001
+                ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            resp = response_pb2.AddOrUpdatePolicyResponse()
+            resp.success.SetInParent()
+            return resp
+
+        def list_policies(req: request_pb2.ListPoliciesRequest, ctx):
+            guard(ctx)
+            store = self._mutable_store()
+            if store is not None:
+                ids = store.list_policy_ids(include_disabled=req.include_disabled)
+            else:
+                ids = sorted(p.fqn() for p in self.core.store.get_all())
+            keys = [namer.policy_key_from_fqn(i) for i in ids]
+            import re as _re
+
+            if req.name_regexp:
+                keys = [k for k in keys if _re.search(req.name_regexp, k)]
+            if req.scope_regexp:
+                keys = [k for k in keys if _re.search(req.scope_regexp, k.partition("/")[2])]
+            if req.version_regexp:
+                keys = [k for k in keys if _re.search(req.version_regexp, k)]
+            return response_pb2.ListPoliciesResponse(policy_ids=keys)
+
+        def get_policy(req: request_pb2.GetPolicyRequest, ctx):
+            guard(ctx)
+            import yaml as _yaml
+
+            store = self._mutable_store()
+            resp = response_pb2.GetPolicyResponse()
+            for pid in req.id:
+                fqn = namer.fqn_from_policy_key(pid)
+                raw = store.get_raw(fqn) if store is not None else None
+                if raw is None:
+                    raw_fn = getattr(self.core.store, "get_raw", None)
+                    raw = raw_fn(fqn) if raw_fn is not None else None
+                if raw is not None:
+                    resp.policies.append(
+                        json_format.ParseDict(
+                            _yaml.safe_load(raw), policy_pb2.Policy(), ignore_unknown_fields=True
+                        )
+                    )
+            return resp
+
+        def set_disabled(req, ctx, disabled: bool):
+            guard(ctx)
+            store = mutable(ctx)
+            fqns = [namer.fqn_from_policy_key(pid) for pid in req.id]
+            return store.set_disabled(fqns, disabled)
+
+        def disable_policy(req: request_pb2.DisablePolicyRequest, ctx):
+            return response_pb2.DisablePolicyResponse(disabled_policies=set_disabled(req, ctx, True))
+
+        def enable_policy(req: request_pb2.EnablePolicyRequest, ctx):
+            return response_pb2.EnablePolicyResponse(enabled_policies=set_disabled(req, ctx, False))
+
+        def inspect_policies(req: request_pb2.InspectPoliciesRequest, ctx):
+            guard(ctx)
+            from ..inspect import inspect_policy
+
+            resp = response_pb2.InspectPoliciesResponse()
+            for pol in self.core.store.get_all():
+                insp = inspect_policy(pol)
+                result = {
+                    "actions": insp.actions,
+                    "policyId": insp.policy_id,
+                    "attributes": (
+                        [{"kind": "KIND_PRINCIPAL_ATTRIBUTE", "name": n} for n in insp.principal_attributes]
+                        + [{"kind": "KIND_RESOURCE_ATTRIBUTE", "name": n} for n in insp.resource_attributes]
+                    ),
+                    "variables": [{"name": n, "kind": "KIND_LOCAL"} for n in insp.variables],
+                    "constants": [{"name": n, "kind": "KIND_LOCAL"} for n in insp.constants],
+                    "derivedRoles": (
+                        [{"name": n, "kind": "KIND_EXPORTED"} for n in insp.derived_roles]
+                        + [{"name": n, "kind": "KIND_IMPORTED"} for n in insp.imported_derived_roles]
+                    ),
+                }
+                json_format.ParseDict(result, resp.results[insp.policy_id], ignore_unknown_fields=True)
+            return resp
+
+        def add_or_update_schema(req: request_pb2.AddOrUpdateSchemaRequest, ctx):
+            guard(ctx)
+            store = mutable(ctx)
+            for s in req.schemas:
+                store.add_schema(s.id, bytes(s.definition))
+            return response_pb2.AddOrUpdateSchemaResponse()
+
+        def list_schemas(req: request_pb2.ListSchemasRequest, ctx):
+            guard(ctx)
+            return response_pb2.ListSchemasResponse(schema_ids=self.core.store.list_schema_ids())
+
+        def get_schema(req: request_pb2.GetSchemaRequest, ctx):
+            guard(ctx)
+            resp = response_pb2.GetSchemaResponse()
+            for sid in req.id:
+                data = self.core.store.get_schema(sid)
+                if data is not None:
+                    resp.schemas.append(schema_pb2.Schema(id=sid, definition=data))
+            return resp
+
+        def delete_schema(req: request_pb2.DeleteSchemaRequest, ctx):
+            guard(ctx)
+            store = mutable(ctx)
+            n = 0
+            for sid in req.id:
+                if store.delete_schema(sid):
+                    n += 1
+            return response_pb2.DeleteSchemaResponse(deleted_schemas=n)
+
+        def reload_store(req: request_pb2.ReloadStoreRequest, ctx):
+            guard(ctx)
+            self.core.store.reload()
+            return response_pb2.ReloadStoreResponse()
+
+        def list_audit_entries(req: request_pb2.ListAuditLogEntriesRequest, ctx):
+            guard(ctx)
+            audit_log = self.core.audit_log
+            backend = getattr(audit_log, "backend", None) if audit_log else None
+            if backend is None or not hasattr(backend, "query"):
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "audit log backend is not queryable")
+            kind = "decision" if req.kind == request_pb2.ListAuditLogEntriesRequest.KIND_DECISION else "access"
+            limit = req.tail if req.WhichOneof("filter") == "tail" else 100
+            field = "decision_log_entry" if kind == "decision" else "access_log_entry"
+            for entry in backend.query(kind=kind, limit=limit):
+                resp = response_pb2.ListAuditLogEntriesResponse()
+                json_format.ParseDict({field: entry}, resp, ignore_unknown_fields=True)
+                yield resp
+
+        def unary(fn, req_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        rpcs = {
+            "AddOrUpdatePolicy": unary(add_or_update_policy, request_pb2.AddOrUpdatePolicyRequest),
+            "InspectPolicies": unary(inspect_policies, request_pb2.InspectPoliciesRequest),
+            "ListPolicies": unary(list_policies, request_pb2.ListPoliciesRequest),
+            "GetPolicy": unary(get_policy, request_pb2.GetPolicyRequest),
+            "DisablePolicy": unary(disable_policy, request_pb2.DisablePolicyRequest),
+            "EnablePolicy": unary(enable_policy, request_pb2.EnablePolicyRequest),
+            "AddOrUpdateSchema": unary(add_or_update_schema, request_pb2.AddOrUpdateSchemaRequest),
+            "ListSchemas": unary(list_schemas, request_pb2.ListSchemasRequest),
+            "GetSchema": unary(get_schema, request_pb2.GetSchemaRequest),
+            "DeleteSchema": unary(delete_schema, request_pb2.DeleteSchemaRequest),
+            "ReloadStore": unary(reload_store, request_pb2.ReloadStoreRequest),
+            "ListAuditLogEntries": grpc.unary_stream_rpc_method_handler(
+                list_audit_entries,
+                request_deserializer=request_pb2.ListAuditLogEntriesRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+        }
+        return grpc.method_handlers_generic_handler("cerbos.svc.v1.CerbosAdminService", rpcs)
 
     def _mutable_store(self):
         store = self.core.store

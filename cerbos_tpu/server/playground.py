@@ -83,10 +83,14 @@ class PlaygroundService:
             actions=list(body.get("actions", [])),
         )
         out = engine.check([check_input])[0]
+        from ..tracer import traced_check
+
+        _, recorder = traced_check(engine.rule_table, check_input, engine.eval_params, engine.schema_mgr)
         return web.json_response(
             {
                 "playgroundId": pid,
                 "success": {
+                    "traces": recorder.to_json(),
                     "results": [
                         {"action": a, "effect": e.effect, "policy": e.policy} for a, e in out.actions.items()
                     ],

@@ -35,6 +35,7 @@ class ServerConfig:
     max_workers: int = 16
     tls_cert: str = ""
     tls_key: str = ""
+    tls_watch_interval_s: float = 5.0  # certinel-style rotation poll
 
     def ssl_context(self):
         if not (self.tls_cert and self.tls_key):
@@ -44,6 +45,66 @@ class ServerConfig:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ctx.load_cert_chain(self.tls_cert, self.tls_key)
         return ctx
+
+
+class _CertWatcher:
+    """Hot cert rotation without restart (ref: server.go:219-268, certinel
+    fswatcher): polls the cert/key mtimes; on change reloads the chain into
+    the live SSLContext (new HTTP handshakes pick it up immediately) and
+    bumps a generation counter the gRPC credential fetcher reads."""
+
+    def __init__(self, cert: str, key: str, ssl_ctx, interval: float):
+        self.cert = cert
+        self.key = key
+        self.ssl_ctx = ssl_ctx
+        self.interval = interval
+        self.generation = 0
+        self._stamp = self._mtimes()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="cert-watcher")
+
+    def _mtimes(self):
+        import os
+
+        try:
+            return (os.stat(self.cert).st_mtime_ns, os.stat(self.key).st_mtime_ns)
+        except OSError:
+            return self._stamp if hasattr(self, "_stamp") else (0, 0)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            stamp = self._mtimes()
+            if stamp == self._stamp:
+                continue
+            self._stamp = stamp
+            try:
+                if self.ssl_ctx is not None:
+                    self.ssl_ctx.load_cert_chain(self.cert, self.key)
+                self.generation += 1
+            except Exception:  # noqa: BLE001  (mid-rotation partial write: retry next tick)
+                pass
+
+    def grpc_credentials(self):
+        """Server credentials whose cert configuration re-reads the files
+        whenever the watcher has seen a rotation."""
+        seen = -1
+        config = [None]
+
+        def fetch():
+            nonlocal seen
+            if self.generation != seen or config[0] is None:
+                seen = self.generation
+                with open(self.key, "rb") as kf, open(self.cert, "rb") as cf:
+                    config[0] = grpc.ssl_server_certificate_configuration(((kf.read(), cf.read()),))
+            return config[0]
+
+        return grpc.dynamic_ssl_server_credentials(fetch(), fetch)
 
 
 def _grpc_handlers(svc: CerbosService):
@@ -268,6 +329,7 @@ class Server:
         self._thread: Optional[threading.Thread] = None
         self.http_port: int = 0
         self.grpc_port: int = 0
+        self._cert_watcher: Optional[_CertWatcher] = None
 
     # -- gRPC --------------------------------------------------------------
 
@@ -279,10 +341,8 @@ class Server:
             if handler is not None:
                 server.add_generic_rpc_handlers((handler,))
         addr = self.config.grpc_listen_addr  # "host:port" or "unix:/path"
-        if self.config.tls_cert and self.config.tls_key:
-            with open(self.config.tls_key, "rb") as kf, open(self.config.tls_cert, "rb") as cf:
-                creds = grpc.ssl_server_credentials(((kf.read(), cf.read()),))
-            port = server.add_secure_port(addr, creds)
+        if self._cert_watcher is not None:
+            port = server.add_secure_port(addr, self._cert_watcher.grpc_credentials())
         else:
             port = server.add_insecure_port(addr)
         self.grpc_port = port
@@ -478,6 +538,14 @@ class Server:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        if self.config.tls_cert and self.config.tls_key:
+            self._cert_watcher = _CertWatcher(
+                self.config.tls_cert,
+                self.config.tls_key,
+                self.config.ssl_context(),
+                self.config.tls_watch_interval_s,
+            )
+            self._cert_watcher.start()
         self._start_grpc()
         started = threading.Event()
 
@@ -488,7 +556,8 @@ class Server:
             runner = web.AppRunner(self._http_app())
             loop.run_until_complete(runner.setup())
             addr = self.config.http_listen_addr
-            ssl_ctx = self.config.ssl_context()
+            # share the watcher's context so rotations apply to new handshakes
+            ssl_ctx = self._cert_watcher.ssl_ctx if self._cert_watcher is not None else None
             if addr.startswith("unix:"):
                 site: web.BaseSite = web.UnixSite(runner, addr[len("unix:"):], ssl_context=ssl_ctx)
             else:
@@ -507,6 +576,8 @@ class Server:
         started.wait(timeout=10)
 
     def stop(self) -> None:
+        if self._cert_watcher is not None:
+            self._cert_watcher.stop()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1).wait()
         if self._loop is not None:

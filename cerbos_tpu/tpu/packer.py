@@ -669,10 +669,13 @@ def _pow2(n: int) -> int:
 
 
 def _freeze(v: Any):
-    if isinstance(v, tuple):
-        return tuple(_freeze(x) for x in v)
-    if isinstance(v, list):
+    """Hashable cache key preserving CEL type distinctions: True/1/1.0 are
+    equal as Python dict keys but NOT as CEL values, so scalars carry a type
+    tag at every nesting level."""
+    if isinstance(v, (tuple, list)):
         return tuple(_freeze(x) for x in v)
     if isinstance(v, dict):
-        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+        return tuple(sorted((str(k), _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (bool, int, float)):
+        return (type(v).__name__, v)
     return v

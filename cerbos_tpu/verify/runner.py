@@ -33,6 +33,9 @@ class TestResult:
     passed: bool
     skipped: bool = False
     failures: list[str] = field(default_factory=list)
+    # rendered engine trace for failed tests under --verbose
+    # (ref: internal/engine/tracer/sink.go surfaced in verify results)
+    traces: list[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -57,6 +60,12 @@ class SuiteResults:
                     lines.append(f"  FAIL {r.name} [{r.principal} / {r.resource}]")
                     for f in r.failures:
                         lines.append(f"    {f}")
+                    for t in r.traces:
+                        comps = " > ".join(c.get("id", "") for c in t.get("components", []))
+                        ev = t.get("event", {})
+                        detail = ev.get("effect") or ev.get("status") or ""
+                        msg = ev.get("message", "")
+                        lines.append(f"      trace: {comps}: {detail} {msg}".rstrip())
         status = "FAILED" if self.failed else "OK"
         lines.append(status)
         return "\n".join(lines)
@@ -73,6 +82,7 @@ class SuiteResults:
                     "passed": r.passed,
                     "skipped": r.skipped,
                     "failures": r.failures,
+                    "traces": r.traces,
                 }
                 for r in self.results
             ],
@@ -149,7 +159,7 @@ def _expand_names(names: list[str], groups: dict[str, Any]) -> list[str]:
     return out
 
 
-def run_suite(path: str, engine: Engine, run_filter: str = "") -> SuiteResults:
+def run_suite(path: str, engine: Engine, run_filter: str = "", verbose: bool = False) -> SuiteResults:
     with open(path, encoding="utf-8") as f:
         suite = yaml.safe_load(f) or {}
     testdata_dir = os.path.join(os.path.dirname(path), "testdata")
@@ -243,13 +253,24 @@ def run_suite(path: str, engine: Engine, run_filter: str = "") -> SuiteResults:
                             failures.append(
                                 f"output {src!r} for action {action!r}: expected {want_val!r}, got {got_entries[0].val!r}"
                             )
+                traces: list[dict] = []
+                if failures and verbose:
+                    from ..tracer import traced_check
+
+                    _, recorder = traced_check(
+                        engine.rule_table,
+                        CheckInput(principal=_principal_from(p_doc), resource=_resource_from(r_doc), actions=actions, aux_data=aux),
+                        params,
+                        engine.schema_mgr,
+                    )
+                    traces = recorder.to_json()
                 results.results.append(
-                    TestResult(suite=suite_name, name=name, principal=p_name, resource=r_name, passed=not failures, failures=failures)
+                    TestResult(suite=suite_name, name=name, principal=p_name, resource=r_name, passed=not failures, failures=failures, traces=traces)
                 )
     return results
 
 
-def discover_and_run(policy_dir: str, run_filter: str = "") -> Optional[SuiteResults]:
+def discover_and_run(policy_dir: str, run_filter: str = "", verbose: bool = False) -> Optional[SuiteResults]:
     """Find *_test.yaml suites under the policy dir and run them against a
     fresh engine built from the same dir (ref: cmd/cerbos/compile)."""
     suite_paths = []
@@ -264,5 +285,5 @@ def discover_and_run(policy_dir: str, run_filter: str = "") -> Optional[SuiteRes
     engine = Engine.from_policies(compile_policy_set(store.get_all()))
     all_results = SuiteResults()
     for path in sorted(suite_paths):
-        all_results.results.extend(run_suite(path, engine, run_filter).results)
+        all_results.results.extend(run_suite(path, engine, run_filter, verbose=verbose).results)
     return all_results

@@ -158,7 +158,7 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         "p95_ms": round(pct(0.95), 2),
         "p99_ms": round(pct(0.99), 2),
         "connections": connections,
-        "policies": n_mods * 4,
+        "policies": n_mods * 9,  # 9 policy documents per name-mod
         "duration_s": round(elapsed, 1),
     }
 

@@ -78,6 +78,59 @@ class TestBundle:
         s = new_store({"driver": "bundle", "bundle": {"path": out}})
         assert len(s.get_all()) == 1
 
+    def test_compiled_ir_fast_path(self, policy_dir, tmp_path):
+        """v2 bundles carry the compiled IR; the manager skips recompiling."""
+        from cerbos_tpu.ruletable.manager import RuleTableManager
+
+        store = DiskStore(str(policy_dir))
+        out = str(tmp_path / "b.crbp")
+        manifest = build_bundle(store, out)
+        assert manifest.compiled_checksum
+
+        # untrusted by default: the pickled IR must NOT be deserialized
+        assert BundleStore(out).get_compiled() is None
+
+        bstore = BundleStore(out, trust_compiled=True)
+        compiled = bstore.get_compiled()
+        assert compiled is not None and len(compiled) == 1
+
+        mgr = RuleTableManager(bstore)
+        eng = Engine(mgr.rule_table)
+        r = eng.check([CheckInput(principal=Principal(id="u", roles=["user"]),
+                                  resource=Resource(kind="doc", id="d", attr={"owner": "u"}),
+                                  actions=["view"])])[0]
+        assert r.actions["view"].effect == "EFFECT_ALLOW"
+
+    def test_compiled_ir_version_gate(self, policy_dir, tmp_path, monkeypatch):
+        """Compiler-version mismatch ignores the IR and recompiles sources."""
+        import cerbos_tpu.bundle as bundle_mod
+
+        store = DiskStore(str(policy_dir))
+        out = str(tmp_path / "b.crbp")
+        build_bundle(store, out)
+        monkeypatch.setattr(bundle_mod, "COMPILER_VERSION", "cerbos-tpu-ir-999")
+        bstore = BundleStore(out, trust_compiled=True)
+        assert bstore.get_compiled() is None  # gated out
+        assert len(bstore.get_all()) == 1  # sources still serve
+
+    def test_signed_bundle(self, policy_dir, tmp_path):
+        """A signing key authenticates the compiled IR without trustCompiled."""
+        store = DiskStore(str(policy_dir))
+        out = str(tmp_path / "b.crbp")
+        build_bundle(store, out, signing_key=b"k1")
+        assert BundleStore(out, signing_key=b"k1").get_compiled() is not None
+        assert BundleStore(out, signing_key=b"wrong").get_compiled() is None
+        assert BundleStore(out).get_compiled() is None
+
+    def test_source_only_bundle(self, policy_dir, tmp_path):
+        store = DiskStore(str(policy_dir))
+        out = str(tmp_path / "b.crbp")
+        manifest = build_bundle(store, out, include_compiled=False)
+        assert manifest.compiled_checksum == ""
+        bstore = BundleStore(out)
+        assert bstore.get_compiled() is None
+        assert len(bstore.get_all()) == 1
+
 
 class TestBlobStore:
     def test_file_bucket(self, policy_dir, tmp_path_factory):

@@ -214,6 +214,56 @@ class TestAdmin:
     def test_reload_store(self, server):
         assert json.loads(http_get(server, "/admin/store/reload", auth=self.AUTH)) == {}
 
+    GRPC_AUTH = [("authorization", "Basic " + __import__("base64").b64encode(b"cerbos:cerbosAdmin").decode())]
+
+    def _admin_call(self, server, method, req, resp_cls, metadata=None):
+        import grpc
+
+        with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}") as ch:
+            fn = ch.unary_unary(
+                f"/cerbos.svc.v1.CerbosAdminService/{method}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+            return fn(req, metadata=metadata or self.GRPC_AUTH, timeout=10)
+
+    def test_grpc_admin_unauthenticated(self, server):
+        import grpc
+
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+
+        with pytest.raises(grpc.RpcError) as e:
+            self._admin_call(server, "ListPolicies", request_pb2.ListPoliciesRequest(),
+                             response_pb2.ListPoliciesResponse, metadata=[("authorization", "Basic bad")])
+        assert e.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+    def test_grpc_admin_list_and_get(self, server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+
+        resp = self._admin_call(server, "ListPolicies", request_pb2.ListPoliciesRequest(),
+                                response_pb2.ListPoliciesResponse)
+        assert "resource.album.vdefault" in resp.policy_ids
+
+        got = self._admin_call(server, "GetPolicy",
+                               request_pb2.GetPolicyRequest(id=["resource.album.vdefault"]),
+                               response_pb2.GetPolicyResponse)
+        assert len(got.policies) == 1
+        assert got.policies[0].resource_policy.resource == "album"
+        assert got.policies[0].resource_policy.rules[0].actions == ["view"]
+
+    def test_grpc_admin_inspect_and_reload(self, server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+
+        resp = self._admin_call(server, "InspectPolicies", request_pb2.InspectPoliciesRequest(),
+                                response_pb2.InspectPoliciesResponse)
+        result = resp.results["resource.album.vdefault"]
+        assert "view" in result.actions
+        self._admin_call(server, "ReloadStore", request_pb2.ReloadStoreRequest(),
+                         response_pb2.ReloadStoreResponse)
+
     def test_audit_log(self, server):
         # ensure at least one decision exists, then wait for the async writer
         http_post(server, "/api/check/resources", CHECK_BODY)
@@ -420,3 +470,114 @@ class TestDeprecatedGRPC:
         assert resp.results[0].resource_id == "a1"
         assert resp.results[0].actions["view"] == 1
         channel.close()
+
+
+class TestTLSHotReload:
+    @staticmethod
+    def _self_signed(cn: str):
+        import datetime
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=1))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName([x509.DNSName("localhost")]), critical=False)
+            .sign(key, hashes.SHA256())
+        )
+        return (
+            cert.public_bytes(serialization.Encoding.PEM),
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ),
+        )
+
+    def test_cert_rotation_without_restart(self, tmp_path):
+        import ssl as ssl_mod
+
+        from cerbos_tpu.compile import compile_policy_set
+        from cerbos_tpu.engine import Engine
+        from cerbos_tpu.policy.parser import parse_policies
+        from cerbos_tpu.server.service import CerbosService
+        from cerbos_tpu.server.server import Server, ServerConfig
+
+        cert_path, key_path = tmp_path / "tls.crt", tmp_path / "tls.key"
+        pem1, key1 = self._self_signed("cerbos-one")
+        cert_path.write_bytes(pem1)
+        key_path.write_bytes(key1)
+
+        engine = Engine.from_policies(compile_policy_set(list(parse_policies(POLICY))))
+        srv = Server(
+            CerbosService(engine),
+            ServerConfig(
+                http_listen_addr="127.0.0.1:0",
+                grpc_listen_addr="127.0.0.1:0",
+                tls_cert=str(cert_path),
+                tls_key=str(key_path),
+                tls_watch_interval_s=0.1,
+            ),
+        )
+        srv.start()
+        try:
+            def served_cn() -> str:
+                pem = ssl_mod.get_server_certificate(("127.0.0.1", srv.http_port))
+                from cryptography import x509
+
+                cert = x509.load_pem_x509_certificate(pem.encode())
+                return cert.subject.rfc4514_string()
+
+            assert "cerbos-one" in served_cn()
+
+            pem2, key2 = self._self_signed("cerbos-two")
+            cert_path.write_bytes(pem2)
+            key_path.write_bytes(key2)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if "cerbos-two" in served_cn():
+                    break
+                time.sleep(0.1)
+            assert "cerbos-two" in served_cn(), "rotated cert never served"
+
+            # gRPC side also serves the rotated cert
+            import grpc as grpc_mod
+
+            creds = grpc_mod.ssl_channel_credentials(root_certificates=pem2)
+            with grpc_mod.secure_channel(
+                f"localhost:{srv.grpc_port}", creds,
+                options=(("grpc.ssl_target_name_override", "localhost"),),
+            ) as ch:
+                grpc_mod.channel_ready_future(ch).result(timeout=10)
+        finally:
+            srv.stop()
+
+
+class TestCtlGrpc:
+    def test_ctl_grpc_roundtrip(self, server, capsys):
+        from cerbos_tpu import ctl
+
+        addr = f"127.0.0.1:{server.grpc_port}"
+        rc = ctl.main(["--server", addr, "--grpc", "get", "policies"])
+        assert rc in (0, None)
+        out = capsys.readouterr().out
+        assert "resource.album.vdefault" in out
+
+        rc = ctl.main(["--server", addr, "--grpc", "get", "policy", "resource.album.vdefault"])
+        assert rc in (0, None)
+        out = capsys.readouterr().out
+        assert "resourcePolicy" in out
+
+        rc = ctl.main(["--server", addr, "--grpc", "store", "reload"])
+        assert rc in (0, None)
