@@ -1,3 +1,4 @@
 from .log import AuditLog, DecisionFilter, new_audit_log  # noqa: F401
 from .file import FileBackend  # noqa: F401
 from .local import LocalBackend  # noqa: F401
+from .kafka import FileTransport, InMemoryTransport, KafkaBackend  # noqa: F401

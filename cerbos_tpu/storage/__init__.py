@@ -1,5 +1,6 @@
 from .store import Event, EVENT_ADD_UPDATE, EVENT_DELETE, EVENT_RELOAD, Store, SubscriptionManager, new_store  # noqa: F401
 from .disk import DiskStore  # noqa: F401
+from .db import DBStore, MySQLDialect, PostgresDialect, Sqlite3Dialect  # noqa: F401
 from .sqlite import SqliteStore  # noqa: F401
 from .git import GitStore  # noqa: F401
 from .overlay import OverlayStore  # noqa: F401

@@ -1,0 +1,345 @@
+"""Dialect-parameterized SQL policy store.
+
+Behavioral reference: internal/storage/db/store.go — one store core (policy
+rows + schema rows, mutations emit targeted events) shared by the sqlite3,
+mysql and postgres drivers, with per-dialect SQL differences isolated in a
+small interface (the goqu dialect analogue). Only sqlite3 is runnable in
+this environment (no mysql/postgres client libraries); the other dialects
+carry the correct SQL and fail at connect time with a clear error, and the
+core is exercised against sqlite in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Protocol
+
+import yaml
+
+from ..policy import model
+from ..policy.parser import parse_policy
+from .store import EVENT_ADD_UPDATE, EVENT_DELETE, Event, Store, register_driver
+
+
+class Dialect(Protocol):
+    name: str
+    placeholder: str  # DB-API parameter marker: "?" or "%s"
+
+    def bool_value(self, b: bool) -> Any:
+        """Python bool → the dialect's `disabled` column representation."""
+        ...
+
+    def connect(self, conf: dict) -> Any: ...
+
+    def ddl(self) -> list[str]: ...
+
+    def upsert_policy(self) -> str: ...
+
+    def upsert_schema(self) -> str: ...
+
+
+class Sqlite3Dialect:
+    name = "sqlite3"
+    placeholder = "?"
+
+    def bool_value(self, b: bool) -> int:
+        return int(b)
+
+    def connect(self, conf: dict) -> Any:
+        import sqlite3
+
+        dsn = conf.get("dsn", ":memory:")
+        if dsn.startswith("file:") and "?" not in dsn:
+            dsn = dsn.replace("file:", "", 1)
+        return sqlite3.connect(dsn, check_same_thread=False)
+
+    def ddl(self) -> list[str]:
+        return [
+            """CREATE TABLE IF NOT EXISTS policy (
+                fqn TEXT PRIMARY KEY,
+                kind TEXT NOT NULL,
+                definition TEXT NOT NULL,
+                disabled INTEGER NOT NULL DEFAULT 0,
+                updated_at TEXT NOT NULL DEFAULT (datetime('now'))
+            )""",
+            """CREATE TABLE IF NOT EXISTS schema_defs (
+                id TEXT PRIMARY KEY,
+                definition BLOB NOT NULL
+            )""",
+        ]
+
+    def upsert_policy(self) -> str:
+        return (
+            "INSERT INTO policy (fqn, kind, definition, disabled) VALUES (?, ?, ?, ?) "
+            "ON CONFLICT(fqn) DO UPDATE SET definition = excluded.definition, "
+            "kind = excluded.kind, disabled = excluded.disabled, updated_at = datetime('now')"
+        )
+
+    def upsert_schema(self) -> str:
+        return (
+            "INSERT INTO schema_defs (id, definition) VALUES (?, ?) "
+            "ON CONFLICT(id) DO UPDATE SET definition = excluded.definition"
+        )
+
+
+class MySQLDialect:
+    """Ref: internal/storage/db/mysql — runnable once a DB-API driver
+    (mysql.connector / pymysql) is installed."""
+
+    name = "mysql"
+    placeholder = "%s"
+
+    def bool_value(self, b: bool) -> int:
+        return int(b)
+
+    def connect(self, conf: dict) -> Any:
+        try:
+            import mysql.connector  # type: ignore[import-not-found]
+        except ImportError:
+            try:
+                import pymysql as mysql_driver  # type: ignore[import-not-found]
+            except ImportError:
+                raise RuntimeError(
+                    "mysql storage driver requires mysql-connector-python or "
+                    "pymysql, neither of which is installed in this environment"
+                ) from None
+            return mysql_driver.connect(**_mysql_conn_args(conf))
+        return mysql.connector.connect(**_mysql_conn_args(conf))
+
+    def ddl(self) -> list[str]:
+        return [
+            """CREATE TABLE IF NOT EXISTS policy (
+                fqn VARCHAR(1024) PRIMARY KEY,
+                kind VARCHAR(64) NOT NULL,
+                definition MEDIUMTEXT NOT NULL,
+                disabled TINYINT NOT NULL DEFAULT 0,
+                updated_at TIMESTAMP NOT NULL DEFAULT CURRENT_TIMESTAMP
+            )""",
+            """CREATE TABLE IF NOT EXISTS schema_defs (
+                id VARCHAR(1024) PRIMARY KEY,
+                definition MEDIUMBLOB NOT NULL
+            )""",
+        ]
+
+    def upsert_policy(self) -> str:
+        return (
+            "INSERT INTO policy (fqn, kind, definition, disabled) VALUES (%s, %s, %s, %s) "
+            "ON DUPLICATE KEY UPDATE definition = VALUES(definition), "
+            "kind = VALUES(kind), disabled = VALUES(disabled), updated_at = NOW()"
+        )
+
+    def upsert_schema(self) -> str:
+        return (
+            "INSERT INTO schema_defs (id, definition) VALUES (%s, %s) "
+            "ON DUPLICATE KEY UPDATE definition = VALUES(definition)"
+        )
+
+
+def _mysql_conn_args(conf: dict) -> dict:
+    return {
+        "host": conf.get("host", "127.0.0.1"),
+        "port": int(conf.get("port", 3306)),
+        "user": conf.get("user", "cerbos"),
+        "password": conf.get("password", ""),
+        "database": conf.get("database", "cerbos"),
+    }
+
+
+class PostgresDialect:
+    """Ref: internal/storage/db/postgres — runnable once psycopg is installed."""
+
+    name = "postgres"
+    placeholder = "%s"
+
+    def bool_value(self, b: bool) -> bool:
+        # the column is BOOLEAN; integers don't coerce in Postgres
+        return b
+
+    def connect(self, conf: dict) -> Any:
+        try:
+            import psycopg  # type: ignore[import-not-found]
+        except ImportError:
+            raise RuntimeError(
+                "postgres storage driver requires psycopg, which is not "
+                "installed in this environment"
+            ) from None
+        return psycopg.connect(conf.get("url") or _pg_dsn(conf))
+
+    def ddl(self) -> list[str]:
+        return [
+            """CREATE TABLE IF NOT EXISTS policy (
+                fqn TEXT PRIMARY KEY,
+                kind TEXT NOT NULL,
+                definition TEXT NOT NULL,
+                disabled BOOLEAN NOT NULL DEFAULT FALSE,
+                updated_at TIMESTAMPTZ NOT NULL DEFAULT NOW()
+            )""",
+            """CREATE TABLE IF NOT EXISTS schema_defs (
+                id TEXT PRIMARY KEY,
+                definition BYTEA NOT NULL
+            )""",
+        ]
+
+    def upsert_policy(self) -> str:
+        return (
+            "INSERT INTO policy (fqn, kind, definition, disabled) VALUES (%s, %s, %s, %s) "
+            "ON CONFLICT(fqn) DO UPDATE SET definition = excluded.definition, "
+            "kind = excluded.kind, disabled = excluded.disabled, updated_at = NOW()"
+        )
+
+    def upsert_schema(self) -> str:
+        return (
+            "INSERT INTO schema_defs (id, definition) VALUES (%s, %s) "
+            "ON CONFLICT(id) DO UPDATE SET definition = excluded.definition"
+        )
+
+
+def _pg_dsn(conf: dict) -> str:
+    return (
+        f"host={conf.get('host', '127.0.0.1')} port={conf.get('port', 5432)} "
+        f"user={conf.get('user', 'cerbos')} password={conf.get('password', '')} "
+        f"dbname={conf.get('database', 'cerbos')}"
+    )
+
+
+class DBStore(Store):
+    """SourceStore + MutableStore over any Dialect."""
+
+    def __init__(self, dialect: Dialect, conf: Optional[dict] = None):
+        super().__init__()
+        self.dialect = dialect
+        self._lock = threading.Lock()
+        self._conn = dialect.connect(conf or {})
+        with self._lock:
+            cur = self._conn.cursor()
+            for stmt in dialect.ddl():
+                cur.execute(stmt)
+            self._conn.commit()
+
+    def _q(self, sql: str) -> str:
+        """Rewrite '?' markers to the dialect's placeholder."""
+        return sql if self.dialect.placeholder == "?" else sql.replace("?", self.dialect.placeholder)
+
+    def _fetchall(self, sql: str, args: tuple = ()) -> list:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(self._q(sql), args)
+            return cur.fetchall()
+
+    def _fetchone(self, sql: str, args: tuple = ()):
+        rows = self._fetchall(sql, args)
+        return rows[0] if rows else None
+
+    # -- SourceStore -------------------------------------------------------
+
+    def get_all(self) -> list[model.Policy]:
+        rows = self._fetchall(
+            "SELECT definition FROM policy WHERE disabled = ?", (self.dialect.bool_value(False),)
+        )
+        return [parse_policy(yaml.safe_load(r[0])) for r in rows]
+
+    def get(self, fqn: str) -> Optional[model.Policy]:
+        row = self._fetchone(
+            "SELECT definition FROM policy WHERE fqn = ? AND disabled = ?",
+            (fqn, self.dialect.bool_value(False)),
+        )
+        return parse_policy(yaml.safe_load(row[0])) if row else None
+
+    def get_schema(self, schema_id: str) -> Optional[bytes]:
+        row = self._fetchone("SELECT definition FROM schema_defs WHERE id = ?", (schema_id,))
+        return row[0] if row else None
+
+    def list_schema_ids(self) -> list[str]:
+        return [r[0] for r in self._fetchall("SELECT id FROM schema_defs ORDER BY id")]
+
+    # -- MutableStore (Admin API surface) ----------------------------------
+
+    def add_or_update(self, documents: list[str]) -> list[str]:
+        """Store raw policy YAML documents; returns their FQNs."""
+        fqns = []
+        events = []
+        with self._lock:
+            cur = self._conn.cursor()
+            for doc in documents:
+                pol = parse_policy(yaml.safe_load(doc))
+                fqn = pol.fqn()
+                cur.execute(
+                    self.dialect.upsert_policy(),
+                    (fqn, pol.kind, doc, self.dialect.bool_value(pol.disabled)),
+                )
+                fqns.append(fqn)
+                events.append(Event(EVENT_ADD_UPDATE, policy_fqn=fqn))
+            self._conn.commit()
+        self.subscriptions.notify(events)
+        return fqns
+
+    def delete(self, fqns: list[str]) -> int:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.executemany(self._q("DELETE FROM policy WHERE fqn = ?"), [(f,) for f in fqns])
+            self._conn.commit()
+        self.subscriptions.notify([Event(EVENT_DELETE, policy_fqn=f) for f in fqns])
+        return len(fqns)
+
+    def set_disabled(self, fqns: list[str], disabled: bool) -> int:
+        """Counts policies that EXIST (idempotent re-disable still counts):
+        UPDATE rowcount semantics differ across engines (MySQL reports
+        changed rows, sqlite/postgres matched rows), so existence is checked
+        explicitly instead."""
+        count = 0
+        events = []
+        with self._lock:
+            cur = self._conn.cursor()
+            for fqn in fqns:
+                cur.execute(self._q("SELECT 1 FROM policy WHERE fqn = ?"), (fqn,))
+                if not cur.fetchone():
+                    continue
+                cur.execute(
+                    self._q("UPDATE policy SET disabled = ? WHERE fqn = ?"),
+                    (self.dialect.bool_value(disabled), fqn),
+                )
+                count += 1
+                events.append(Event(EVENT_DELETE if disabled else EVENT_ADD_UPDATE, policy_fqn=fqn))
+            self._conn.commit()
+        self.subscriptions.notify(events)
+        return count
+
+    def list_policy_ids(self, include_disabled: bool = False) -> list[str]:
+        if include_disabled:
+            return [r[0] for r in self._fetchall("SELECT fqn FROM policy ORDER BY fqn")]
+        return [
+            r[0]
+            for r in self._fetchall(
+                "SELECT fqn FROM policy WHERE disabled = ? ORDER BY fqn",
+                (self.dialect.bool_value(False),),
+            )
+        ]
+
+    def get_raw(self, fqn: str) -> Optional[str]:
+        row = self._fetchone("SELECT definition FROM policy WHERE fqn = ?", (fqn,))
+        return row[0] if row else None
+
+    def add_schema(self, schema_id: str, definition: bytes) -> None:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(self.dialect.upsert_schema(), (schema_id, definition))
+            self._conn.commit()
+        self.subscriptions.notify([Event(EVENT_ADD_UPDATE, schema_id=schema_id)])
+
+    def delete_schema(self, schema_id: str) -> bool:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(self._q("DELETE FROM schema_defs WHERE id = ?"), (schema_id,))
+            ok = cur.rowcount > 0
+            self._conn.commit()
+        if ok:
+            self.subscriptions.notify([Event(EVENT_DELETE, schema_id=schema_id)])
+        return ok
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+register_driver("mysql", lambda conf: DBStore(MySQLDialect(), conf))
+register_driver("postgres", lambda conf: DBStore(PostgresDialect(), conf))

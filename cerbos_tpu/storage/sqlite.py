@@ -1,158 +1,20 @@
-"""SQLite store: mutable policy storage for the Admin API.
+"""SQLite store: the sqlite3 dialect of the shared DB store core.
 
-Behavioral reference: internal/storage/db (policy rows + dependency
-bookkeeping; mutations emit targeted events). Uses the stdlib sqlite3
-driver; policy definitions are stored as YAML documents.
+Behavioral reference: internal/storage/db/sqlite3 — see storage/db.py for
+the dialect-parameterized core (store.go analogue).
 """
 
 from __future__ import annotations
 
-import sqlite3
-import threading
-from typing import Optional
-
-import yaml
-
-from ..policy import model
-from ..policy.parser import parse_policy
-from .store import EVENT_ADD_UPDATE, EVENT_DELETE, Event, Store, register_driver
-
-_SCHEMA = """
-CREATE TABLE IF NOT EXISTS policy (
-    fqn TEXT PRIMARY KEY,
-    kind TEXT NOT NULL,
-    definition TEXT NOT NULL,
-    disabled INTEGER NOT NULL DEFAULT 0,
-    updated_at TEXT NOT NULL DEFAULT (datetime('now'))
-);
-CREATE TABLE IF NOT EXISTS schema_defs (
-    id TEXT PRIMARY KEY,
-    definition BLOB NOT NULL
-);
-"""
+from .db import DBStore, Sqlite3Dialect
+from .store import register_driver
 
 
-def _policy_to_yaml(pol: model.Policy, raw: Optional[str]) -> str:
-    if raw is not None:
-        return raw
-    # minimal serialization: reconstructable enough for reload
-    raise ValueError("SqliteStore requires the raw policy document")
-
-
-class SqliteStore(Store):
+class SqliteStore(DBStore):
     driver = "sqlite3"
 
     def __init__(self, dsn: str = ":memory:"):
-        super().__init__()
-        self.dsn = dsn.replace("file:", "", 1) if dsn.startswith("file:") and "?" not in dsn else dsn
-        self._lock = threading.Lock()
-        self._conn = sqlite3.connect(self.dsn, check_same_thread=False)
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
-
-    # -- SourceStore -------------------------------------------------------
-
-    def get_all(self) -> list[model.Policy]:
-        with self._lock:
-            rows = self._conn.execute("SELECT definition FROM policy WHERE disabled = 0").fetchall()
-        return [parse_policy(yaml.safe_load(r[0])) for r in rows]
-
-    def get(self, fqn: str) -> Optional[model.Policy]:
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT definition FROM policy WHERE fqn = ? AND disabled = 0", (fqn,)
-            ).fetchone()
-        if row is None:
-            return None
-        return parse_policy(yaml.safe_load(row[0]))
-
-    def get_schema(self, schema_id: str) -> Optional[bytes]:
-        with self._lock:
-            row = self._conn.execute("SELECT definition FROM schema_defs WHERE id = ?", (schema_id,)).fetchone()
-        return row[0] if row else None
-
-    def list_schema_ids(self) -> list[str]:
-        with self._lock:
-            rows = self._conn.execute("SELECT id FROM schema_defs ORDER BY id").fetchall()
-        return [r[0] for r in rows]
-
-    # -- MutableStore (Admin API surface) ----------------------------------
-
-    def add_or_update(self, documents: list[str]) -> list[str]:
-        """Store raw policy YAML documents; returns their FQNs."""
-        fqns = []
-        events = []
-        with self._lock:
-            for doc in documents:
-                pol = parse_policy(yaml.safe_load(doc))
-                fqn = pol.fqn()
-                self._conn.execute(
-                    "INSERT INTO policy (fqn, kind, definition, disabled) VALUES (?, ?, ?, ?) "
-                    "ON CONFLICT(fqn) DO UPDATE SET definition = excluded.definition, "
-                    "kind = excluded.kind, disabled = excluded.disabled, updated_at = datetime('now')",
-                    (fqn, pol.kind, doc, 1 if pol.disabled else 0),
-                )
-                fqns.append(fqn)
-                events.append(Event(EVENT_ADD_UPDATE, policy_fqn=fqn))
-            self._conn.commit()
-        self.subscriptions.notify(events)
-        return fqns
-
-    def delete(self, fqns: list[str]) -> int:
-        with self._lock:
-            cur = self._conn.executemany("DELETE FROM policy WHERE fqn = ?", [(f,) for f in fqns])
-            self._conn.commit()
-            n = self._conn.total_changes
-        self.subscriptions.notify([Event(EVENT_DELETE, policy_fqn=f) for f in fqns])
-        return len(fqns)
-
-    def set_disabled(self, fqns: list[str], disabled: bool) -> int:
-        count = 0
-        events = []
-        with self._lock:
-            for fqn in fqns:
-                cur = self._conn.execute("UPDATE policy SET disabled = ? WHERE fqn = ?", (1 if disabled else 0, fqn))
-                if cur.rowcount:
-                    count += 1
-                    events.append(Event(EVENT_DELETE if disabled else EVENT_ADD_UPDATE, policy_fqn=fqn))
-            self._conn.commit()
-        self.subscriptions.notify(events)
-        return count
-
-    def list_policy_ids(self, include_disabled: bool = False) -> list[str]:
-        q = "SELECT fqn FROM policy" + ("" if include_disabled else " WHERE disabled = 0")
-        with self._lock:
-            rows = self._conn.execute(q + " ORDER BY fqn").fetchall()
-        return [r[0] for r in rows]
-
-    def get_raw(self, fqn: str) -> Optional[str]:
-        with self._lock:
-            row = self._conn.execute("SELECT definition FROM policy WHERE fqn = ?", (fqn,)).fetchone()
-        return row[0] if row else None
-
-    def add_schema(self, schema_id: str, definition: bytes) -> None:
-        with self._lock:
-            self._conn.execute(
-                "INSERT INTO schema_defs (id, definition) VALUES (?, ?) "
-                "ON CONFLICT(id) DO UPDATE SET definition = excluded.definition",
-                (schema_id, definition),
-            )
-            self._conn.commit()
-        self.subscriptions.notify([Event(EVENT_ADD_UPDATE, schema_id=schema_id)])
-
-    def delete_schema(self, schema_id: str) -> bool:
-        with self._lock:
-            cur = self._conn.execute("DELETE FROM schema_defs WHERE id = ?", (schema_id,))
-            self._conn.commit()
-            ok = cur.rowcount > 0
-        if ok:
-            self.subscriptions.notify([Event(EVENT_DELETE, schema_id=schema_id)])
-        return ok
-
-    def close(self) -> None:
-        with self._lock:
-            self._conn.close()
+        super().__init__(Sqlite3Dialect(), {"dsn": dsn})
 
 
 register_driver("sqlite3", lambda conf: SqliteStore(dsn=conf.get("dsn", ":memory:")))

@@ -258,3 +258,115 @@ class TestVerifyFramework:
         assert results.failed
         assert "expected EFFECT_DENY, got EFFECT_ALLOW" in results.results[0].failures[0]
         assert "<testsuites>" in results.to_junit() or "testsuite" in results.to_junit()
+
+
+class TestDBDialects:
+    """The dialect-parameterized core (internal/storage/db analogue): the
+    shared store logic runs against sqlite; the mysql/postgres dialects carry
+    their SQL and fail with a clear error when no driver is installed."""
+
+    def test_core_roundtrip_via_dialect(self):
+        from cerbos_tpu.storage.db import DBStore, Sqlite3Dialect
+
+        store = DBStore(Sqlite3Dialect(), {"dsn": ":memory:"})
+        fqns = store.add_or_update([POLICY_A])
+        assert fqns == ["cerbos.resource.doc.vdefault"]
+        assert store.list_policy_ids() == fqns
+        assert store.get(fqns[0]) is not None
+        store.add_schema("s.json", b"{}")
+        assert store.get_schema("s.json") == b"{}"
+        assert store.set_disabled(fqns, True) == 1
+        assert store.list_policy_ids() == []
+        assert store.list_policy_ids(include_disabled=True) == fqns
+        assert store.delete_schema("s.json")
+        store.close()
+
+    def test_dialect_sql_differences(self):
+        from cerbos_tpu.storage.db import MySQLDialect, PostgresDialect, Sqlite3Dialect
+
+        assert "ON CONFLICT(fqn)" in Sqlite3Dialect().upsert_policy()
+        assert "ON DUPLICATE KEY UPDATE" in MySQLDialect().upsert_policy()
+        assert "ON CONFLICT(fqn)" in PostgresDialect().upsert_policy()
+        assert MySQLDialect().placeholder == "%s"
+        # every dialect creates the same two tables
+        for d in (Sqlite3Dialect(), MySQLDialect(), PostgresDialect()):
+            ddl = " ".join(d.ddl())
+            assert "policy" in ddl and "schema_defs" in ddl
+
+    def test_missing_driver_errors(self):
+        from cerbos_tpu.storage import new_store
+
+        for driver in ("mysql", "postgres"):
+            with pytest.raises(RuntimeError, match="requires"):
+                new_store({"driver": driver, driver: {}})
+
+
+class TestKafkaAuditBackend:
+    """Partitioning/encoding semantics (internal/audit/kafka/publisher.go)
+    unit-tested through an injected producer."""
+
+    def _entry(self, call_id="01HCALL", kind="decision"):
+        return {"callId": call_id, "kind": kind, "timestamp": "2026-01-01T00:00:00Z",
+                "checkResources": {"inputs": []}}
+
+    def test_headers_key_and_encoding(self):
+        from cerbos_tpu.audit import InMemoryTransport, KafkaBackend
+
+        producer = InMemoryTransport()
+        backend = KafkaBackend(topic="cerbos.audit.log", producer=producer)
+        backend.write(self._entry(kind="decision"))
+        backend.write(self._entry(call_id="01HOTHER", kind="access"))
+        backend.close()
+
+        assert len(producer.records) == 2
+        dec, acc = producer.records
+        assert dec.topic == "cerbos.audit.log"
+        assert dec.key == b"01HCALL"  # partition key = call id
+        assert dict(dec.headers)["cerbos.audit.kind"] == b"decision"
+        assert dict(acc.headers)["cerbos.audit.kind"] == b"access"
+        assert dict(dec.headers)["cerbos.audit.encoding"] == b"json"
+        assert json.loads(dec.value)["callId"] == "01HCALL"
+
+    def test_same_call_same_partition_key(self):
+        from cerbos_tpu.audit import InMemoryTransport, KafkaBackend
+
+        producer = InMemoryTransport()
+        backend = KafkaBackend(topic="t", producer=producer)
+        backend.write(self._entry(call_id="X", kind="access"))
+        backend.write(self._entry(call_id="X", kind="decision"))
+        assert producer.records[0].key == producer.records[1].key
+
+    def test_invalid_config(self):
+        from cerbos_tpu.audit import InMemoryTransport, KafkaBackend
+
+        with pytest.raises(ValueError, match="invalid topic"):
+            KafkaBackend(topic="", producer=InMemoryTransport())
+        with pytest.raises(ValueError, match="invalid encoding"):
+            KafkaBackend(topic="t", producer=InMemoryTransport(), encoding="xml")
+
+    def test_error_callback(self):
+        from cerbos_tpu.audit import KafkaBackend
+
+        class Failing:
+            def produce(self, record):
+                raise ConnectionError("broker down")
+
+        seen = []
+        backend = KafkaBackend(topic="t", producer=Failing(), on_error=lambda e, r: seen.append((e, r)))
+        backend.write(self._entry())
+        assert len(seen) == 1 and isinstance(seen[0][0], ConnectionError)
+
+    def test_file_transport_end_to_end(self, tmp_path):
+        from cerbos_tpu.audit import new_audit_log
+
+        out = tmp_path / "kafka.jsonl"
+        log = new_audit_log({
+            "enabled": True, "accessLogsEnabled": True, "decisionLogsEnabled": True,
+            "backend": "kafka", "kafka": {"topic": "cerbos.audit.log", "file": str(out)},
+        })
+        log.write_access("01HCALL", method="/cerbos.svc.v1.CerbosService/CheckResources")
+        log.close()
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert lines and lines[0]["topic"] == "cerbos.audit.log"
+        assert lines[0]["headers"]["cerbos.audit.kind"] == "access"
+        assert lines[0]["key"] == "01HCALL"
