@@ -65,14 +65,14 @@ class CheckInput:
     aux_data: Optional[AuxData] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class ActionEffect:
     effect: str
     policy: str
     scope: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class OutputEntry:
     src: str
     action: str = ""
@@ -80,14 +80,14 @@ class OutputEntry:
     error: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class ValidationError:
     path: str
     message: str
     source: str  # SOURCE_PRINCIPAL | SOURCE_RESOURCE
 
 
-@dataclass
+@dataclass(slots=True)
 class CheckOutput:
     request_id: str
     resource_id: str

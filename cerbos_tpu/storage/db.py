@@ -224,7 +224,13 @@ class DBStore(Store):
         with self._lock:
             cur = self._conn.cursor()
             cur.execute(self._q(sql), args)
-            return cur.fetchall()
+            rows = cur.fetchall()
+            if self.dialect.name != "sqlite3":
+                # close the implicit read transaction: postgres/mysql default
+                # isolation would otherwise pin every later read to the first
+                # snapshot (and hold 'idle in transaction' on the server)
+                self._conn.rollback()
+            return rows
 
     def _fetchone(self, sql: str, args: tuple = ()):
         rows = self._fetchall(sql, args)

@@ -530,6 +530,10 @@ class Packer:
         native = native_mod.get()
         # filter once, not once per path
         active = [(bi, plan) for bi, plan in enumerate(plans) if not (plan.trivial or plan.oracle)]
+        if native is not None and hasattr(native, "encode_column"):
+            self._encode_columns_native(cb, plans, active, paths, native)
+            self._encode_preds(cb, plans, active, params)
+            return cb
         for p in paths:
             t = np.zeros(B, dtype=np.int8)
             h = np.zeros(B, dtype=np.int32)
@@ -580,20 +584,58 @@ class Packer:
                 nn[idx] = np.frombuffer(nan_b, dtype=np.uint8).astype(bool)
             cb.tags[p], cb.his[p], cb.los[p], cb.sids[p], cb.nans[p] = t, h, l, s, nn
 
-        # predicate columns
-        preds = self.lt.compiler.preds
-        if preds:
-            for spec in preds:
-                vals = np.zeros(B, dtype=bool)
-                errs = np.zeros(B, dtype=bool)
-                for bi, plan in active:
-                    if plan.oracle:
-                        continue  # may have been flagged during encoding
-                    v, e = self._eval_pred(spec, plan, params)
-                    vals[bi], errs[bi] = v, e
-                cb.pred_vals[spec.pred_id] = vals
-                cb.pred_errs[spec.pred_id] = errs
+        self._encode_preds(cb, plans, active, params)
         return cb
+
+    def _encode_preds(self, cb: ColumnBatch, plans, active, params) -> None:
+        B = cb.size
+        preds = self.lt.compiler.preds
+        for spec in preds:
+            vals = np.zeros(B, dtype=bool)
+            errs = np.zeros(B, dtype=bool)
+            for bi, plan in active:
+                if plan.oracle:
+                    continue  # may have been flagged during encoding
+                v, e = self._eval_pred(spec, plan, params)
+                vals[bi], errs[bi] = v, e
+            cb.pred_vals[spec.pred_id] = vals
+            cb.pred_errs[spec.pred_id] = errs
+
+    def _encode_columns_native(self, cb: ColumnBatch, plans, active, paths, native) -> None:
+        """Whole-column encoding in C (native encode_column): values gather
+        stays in Python (attribute access on input objects), the type
+        dispatch + key/interning loop runs natively."""
+        B = cb.size
+        interner = self.lt.interner
+        all_active = len(active) == B
+        for p in paths:
+            t = np.zeros(B, dtype=np.uint8)
+            h = np.zeros(B, dtype=np.int32)
+            l = np.zeros(B, dtype=np.int32)
+            s = np.zeros(B, dtype=np.int32)
+            nn = np.zeros(B, dtype=np.uint8)
+            accessor = self._path_accessor(p)
+            if all_active:
+                values = [accessor(plan.input) for plan in plans]
+            else:
+                values = [_MISSING_SENTINEL] * B
+                for bi, plan in active:
+                    values[bi] = accessor(plan.input)
+            native.encode_column(
+                values, interner.ids, _MISSING_SENTINEL, _ERR_SENTINEL,
+                memoryview(t), memoryview(h), memoryview(l), memoryview(s), memoryview(nn),
+            )
+            trig = self.lt.fallback_tags.get(p)
+            if trig:
+                bad = np.isin(t, np.fromiter(trig, dtype=np.uint8))
+                if bad.any():
+                    for bi in np.nonzero(bad)[0]:
+                        plan = plans[int(bi)]
+                        if not (plan.trivial or plan.oracle):
+                            plan.oracle = True
+            cb.tags[p] = t.astype(np.int8)
+            cb.his[p], cb.los[p], cb.sids[p] = h, l, s
+            cb.nans[p] = nn.astype(bool)
 
 
     def _pred_key_accessors(self, spec):

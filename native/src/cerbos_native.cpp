@@ -262,6 +262,128 @@ PyObject* PyEncodeDoubleKeys(PyObject*, PyObject* args) {
   return result;
 }
 
+// encode_column(values, interner_dict, missing, err,
+//               tags_u8, hi_i32, lo_i32, sid_i32, nan_u8) -> None
+//
+// One column's batch encoding (columns.py encode_value semantics over a
+// whole [B] list): per element writes (tag, hi, lo, sid, nan) into the
+// writable buffers. String ids come from / are added to interner_dict
+// (str -> int, ids start at 1 — StringInterner). `missing` / `err` are the
+// packer's sentinel objects compared by identity.
+PyObject* PyEncodeColumn(PyObject*, PyObject* args) {
+  PyObject* values;
+  PyObject* interner;
+  PyObject* missing;
+  PyObject* err;
+  Py_buffer tags_b, hi_b, lo_b, sid_b, nan_b;
+  if (!PyArg_ParseTuple(args, "OO!OOw*w*w*w*w*", &values, &PyDict_Type,
+                        &interner, &missing, &err, &tags_b, &hi_b, &lo_b,
+                        &sid_b, &nan_b)) {
+    return nullptr;
+  }
+  struct Bufs {
+    Py_buffer *a, *b, *c, *d, *e;
+    ~Bufs() {
+      PyBuffer_Release(a);
+      PyBuffer_Release(b);
+      PyBuffer_Release(c);
+      PyBuffer_Release(d);
+      PyBuffer_Release(e);
+    }
+  } release{&tags_b, &hi_b, &lo_b, &sid_b, &nan_b};
+
+  PyObject* seq = PySequence_Fast(values, "values must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (tags_b.len < n || nan_b.len < n ||
+      hi_b.len < static_cast<Py_ssize_t>(n * 4) ||
+      lo_b.len < static_cast<Py_ssize_t>(n * 4) ||
+      sid_b.len < static_cast<Py_ssize_t>(n * 4)) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "output buffers too small");
+    return nullptr;
+  }
+  uint8_t* tags = static_cast<uint8_t*>(tags_b.buf);
+  int32_t* hi = static_cast<int32_t*>(hi_b.buf);
+  int32_t* lo = static_cast<int32_t*>(lo_b.buf);
+  int32_t* sid = static_cast<int32_t*>(sid_b.buf);
+  uint8_t* nan = static_cast<uint8_t*>(nan_b.buf);
+
+  // TAG codes (columns.py): MISSING=0 NULL=1 BOOL=2 NUM=3 STR=4 OTHER=5 ERR=6
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* v = PySequence_Fast_GET_ITEM(seq, i);
+    tags[i] = 0;
+    hi[i] = 0;
+    lo[i] = 0;
+    sid[i] = 0;
+    nan[i] = 0;
+    if (v == missing) {
+      continue;  // TAG_MISSING zeros
+    }
+    if (v == err) {
+      tags[i] = 6;
+      continue;
+    }
+    if (v == Py_None) {
+      tags[i] = 1;
+      continue;
+    }
+    if (PyBool_Check(v)) {
+      tags[i] = 2;
+      hi[i] = (v == Py_True) ? 1 : 0;
+      continue;
+    }
+    double d;
+    // subtype-tolerant (np.float64, IntEnum...) to match encode_value's
+    // isinstance checks; bool was already handled above
+    if (PyFloat_Check(v)) {
+      d = PyFloat_AS_DOUBLE(v);
+    } else if (PyLong_Check(v)) {
+      d = PyLong_AsDouble(v);
+      if (d == -1.0 && PyErr_Occurred()) {
+        PyErr_Clear();
+        tags[i] = 5;  // magnitude beyond double: host/oracle territory
+        continue;
+      }
+    } else if (PyUnicode_Check(v)) {
+      tags[i] = 4;
+      PyObject* id_obj = PyDict_GetItem(interner, v);  // borrowed
+      long id;
+      if (id_obj != nullptr) {
+        id = PyLong_AsLong(id_obj);
+      } else {
+        id = static_cast<long>(PyDict_Size(interner)) + 1;
+        PyObject* new_id = PyLong_FromLong(id);
+        if (!new_id || PyDict_SetItem(interner, v, new_id) < 0) {
+          Py_XDECREF(new_id);
+          Py_DECREF(seq);
+          return nullptr;
+        }
+        Py_DECREF(new_id);
+      }
+      sid[i] = static_cast<int32_t>(id);
+      continue;
+    } else {
+      tags[i] = 5;  // lists/dicts/other
+      continue;
+    }
+    // numeric path (float or in-range int)
+    tags[i] = 3;
+    if (d != d) {
+      nan[i] = 1;
+      continue;
+    }
+    if (d == 0.0) d = 0.0;  // collapse -0.0
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    uint64_t key = (bits & (1ULL << 63)) ? ~bits : (bits | (1ULL << 63));
+    hi[i] = static_cast<int32_t>(static_cast<uint32_t>(key >> 32) ^ 0x80000000u);
+    lo[i] = static_cast<int32_t>(static_cast<uint32_t>(key) ^ 0x80000000u);
+  }
+  Py_DECREF(seq);
+  Py_RETURN_NONE;
+}
+
 PyMethodDef kMethods[] = {
     {"glob_match", PyGlobMatch, METH_VARARGS,
      "glob_match(pattern, value) -> bool — gobwas-style glob with ':' separator"},
@@ -269,6 +391,8 @@ PyMethodDef kMethods[] = {
      "glob_match_many(patterns, value) -> list[int] of matching indices"},
     {"encode_double_keys", PyEncodeDoubleKeys, METH_VARARGS,
      "encode_double_keys(f64 buffer) -> (hi_i32_bytes, lo_i32_bytes, nan_u8_bytes)"},
+    {"encode_column", PyEncodeColumn, METH_VARARGS,
+     "encode_column(values, interner, missing, err, tags, hi, lo, sid, nan)"},
     {nullptr, nullptr, 0, nullptr},
 };
 
