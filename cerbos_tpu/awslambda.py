@@ -1,0 +1,98 @@
+"""AWS Lambda entry point: the PDP as a Lambda function.
+
+Behavioral reference: cmd/awslambda/function + internal/server/awslambda —
+the PDP initializes once per execution environment and serves the HTTP API
+surface from API Gateway (v2 HTTP API / function URL) events. Configure via
+the CERBOS_CONFIG env var (path to the YAML config; storage typically a
+bundle shipped in the deployment package).
+
+    # serverless handler setting
+    handler: cerbos_tpu.awslambda.lambda_handler
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any, Optional
+
+_core = None
+
+
+def _get_core():
+    global _core
+    if _core is None:
+        from .bootstrap import initialize
+        from .config import Config
+
+        config = Config.load(os.environ.get("CERBOS_CONFIG") or None)
+        _core = initialize(config)
+    return _core
+
+
+def _body_of(event: dict) -> dict:
+    body = event.get("body") or ""
+    if event.get("isBase64Encoded"):
+        body = base64.b64decode(body).decode("utf-8")
+    return json.loads(body) if body else {}
+
+
+def _response(status: int, payload: dict) -> dict:
+    return {
+        "statusCode": status,
+        "headers": {"Content-Type": "application/json"},
+        "body": json.dumps(payload),
+    }
+
+
+def lambda_handler(event: dict, context: Any = None) -> dict:
+    """API Gateway v2 (and function URL) event → PDP response."""
+    from .server import convert
+    from .server.service import RequestLimitExceeded
+
+    core = _get_core()
+    path = (event.get("rawPath") or event.get("path") or "").rstrip("/")
+    method = (
+        event.get("requestContext", {}).get("http", {}).get("method")
+        or event.get("httpMethod")
+        or "GET"
+    )
+
+    try:
+        if path == "/_cerbos/health":
+            return _response(200, {"status": "SERVING"})
+        if path == "/api/check/resources" and method == "POST":
+            body = _body_of(event)
+            aux = None
+            aux_j = (body.get("auxData") or {}).get("jwt") or {}
+            if aux_j.get("token"):
+                aux = core.service._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
+            inputs, request_id, include_meta = convert.json_to_check_inputs(body, aux)
+            outputs, call_id = core.service.check_resources(inputs)
+            return _response(200, convert.outputs_to_json(body, outputs, request_id, include_meta, call_id))
+        if path == "/api/plan/resources" and method == "POST":
+            from .server.server import _plan_from_json
+
+            body = _body_of(event)
+            aux = None
+            aux_j = (body.get("auxData") or {}).get("jwt") or {}
+            if aux_j.get("token"):
+                aux = core.service._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
+            resp_json, _call_id = _plan_from_json(core.service, body, aux)
+            return _response(200, resp_json)
+        return _response(404, {"code": 5, "message": f"unknown path {path!r}"})
+    except RequestLimitExceeded as e:
+        return _response(400, {"code": 3, "message": str(e)})
+    except json.JSONDecodeError:
+        return _response(400, {"code": 3, "message": "invalid JSON payload"})
+    except Exception as e:  # noqa: BLE001
+        return _response(500, {"code": 13, "message": f"check failed: {e}"})
+
+
+def reset() -> None:
+    """Drop the cached core (tests / config rotation)."""
+    global _core
+    if _core is not None:
+        _core.close()
+    _core = None

@@ -12,11 +12,31 @@ import os
 import sys
 
 
+def _parse_duration_s(v) -> int:
+    """Go-style duration ("10s", "1m30s", "1h") or bare seconds → seconds."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    import re as _re
+
+    total = 0.0
+    for num, unit in _re.findall(r"([0-9.]+)(ms|s|m|h)", str(v)):
+        total += float(num) * {"ms": 0.001, "s": 1, "m": 60, "h": 3600}[unit]
+    if total == 0 and str(v).strip():
+        try:
+            total = float(str(v))
+        except ValueError:
+            pass
+    return int(total)
+
+
 def cmd_server(args: argparse.Namespace) -> int:
     from .bootstrap import initialize
     from .config import Config
     from .server.server import Server, ServerConfig
 
+    from .observability import init_otlp_from_env
+
+    init_otlp_from_env()  # OTEL_EXPORTER_OTLP_ENDPOINT et al (ref: otel.go)
     config = Config.load(args.config, overrides=args.set or [])
     core = initialize(config)
     server_conf = config.section("server")
@@ -38,6 +58,10 @@ def cmd_server(args: argparse.Namespace) -> int:
             grpc_listen_addr=server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
             tls_cert=tls.get("cert", ""),
             tls_key=tls.get("key", ""),
+            cors_disabled=bool((server_conf.get("cors") or {}).get("disabled", False)),
+            cors_allowed_origins=tuple((server_conf.get("cors") or {}).get("allowedOrigins", []) or []),
+            cors_allowed_headers=tuple((server_conf.get("cors") or {}).get("allowedHeaders", []) or []),
+            cors_max_age_s=_parse_duration_s((server_conf.get("cors") or {}).get("maxAge", 0)),
         ),
         admin_service=_admin(core, server_conf),
         extra_services=extra,

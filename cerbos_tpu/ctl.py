@@ -149,6 +149,50 @@ class GrpcClient:
         raise SystemExit(f"error: unsupported admin call {method} {path}")
 
 
+def _render_decision(e: dict) -> str:
+    ts = e.get("timestamp", "")[:19]
+    cr = e.get("checkResources") or {}
+    parts = []
+    for out in cr.get("outputs", []) or []:
+        pid = ""
+        for inp in cr.get("inputs", []) or []:
+            if inp.get("requestId") == out.get("requestId"):
+                pid = (inp.get("principal") or {}).get("id", "")
+        for action, res in (out.get("actions") or {}).items():
+            effect = res.get("effect", "")
+            mark = "ALLOW" if effect == "EFFECT_ALLOW" else "DENY "
+            parts.append(f"{ts}  {mark}  {pid:<12} {action:<20} {res.get('policy', '')}")
+    pr = e.get("planResources") or {}
+    if pr:
+        parts.append(f"{ts}  PLAN   {','.join(pr.get('actions', [])):<20} {pr.get('resourceKind', '')} -> {pr.get('kind', '')}")
+    return "\n".join(parts) or f"{ts}  (empty decision entry)"
+
+
+def _decisions_browser(client, tail: int, follow: bool, interval: float) -> int:
+    """Streaming decision browser: renders ALLOW/DENY per action; with
+    --follow keeps polling and prints only unseen call ids."""
+    import time as _time
+
+    seen: set[str] = set()
+    try:
+        while True:
+            resp = client.call("GET", "/admin/auditlog/list/decision_logs", params={"tail": str(tail)})
+            for e in resp.get("entries", []):
+                # entries without a callId dedup on content
+                key = e.get("callId") or str(hash(json.dumps(e, sort_keys=True, default=str)))
+                if key in seen:
+                    continue
+                if len(seen) > 65536:
+                    seen.clear()
+                seen.add(key)
+                print(_render_decision(e))
+            if not follow:
+                return 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="cerbos-tpuctl", description="Admin client for cerbos-tpu PDPs")
     parser.add_argument("--server", default="127.0.0.1:3592")
@@ -184,6 +228,11 @@ def main(argv: list[str] | None = None) -> int:
     p_audit = sub.add_parser("audit", help="browse audit log entries")
     p_audit.add_argument("--kind", choices=["access", "decision"], default="decision")
     p_audit.add_argument("--tail", type=int, default=20)
+
+    p_dec = sub.add_parser("decisions", help="interactive decision log browser (ref: cerbosctl decisions)")
+    p_dec.add_argument("--tail", type=int, default=30)
+    p_dec.add_argument("--follow", action="store_true", help="poll for new entries")
+    p_dec.add_argument("--interval", type=float, default=2.0)
 
     args = parser.parse_args(argv)
     if args.grpc:
@@ -237,6 +286,8 @@ def main(argv: list[str] | None = None) -> int:
     elif args.command == "store":
         client.call("GET", "/admin/store/reload")
         print("store reload triggered")
+    elif args.command == "decisions":
+        return _decisions_browser(client, tail=args.tail, follow=args.follow, interval=args.interval)
     elif args.command == "audit":
         kind = {"access": "access_logs", "decision": "decision_logs"}[args.kind]
         resp = client.call("GET", f"/admin/auditlog/list/{kind}", params={"tail": str(args.tail)})

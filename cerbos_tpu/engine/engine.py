@@ -45,13 +45,18 @@ class Engine:
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
     ) -> list[T.CheckOutput]:
-        params = params or self.eval_params
-        if self.tpu_evaluator is not None and len(inputs) >= self.tpu_batch_threshold:
-            outputs = self.tpu_evaluator.check(list(inputs), params)
-        else:
-            from ..ruletable import check_input
+        from ..observability import start_span
 
-            outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
+        params = params or self.eval_params
+        with start_span("engine.Check", batch_size=len(inputs)) as span:
+            if self.tpu_evaluator is not None and len(inputs) >= self.tpu_batch_threshold:
+                span.set_attribute("path", "device")
+                outputs = self.tpu_evaluator.check(list(inputs), params)
+            else:
+                from ..ruletable import check_input
+
+                span.set_attribute("path", "serial")
+                outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
         if self.on_decision is not None:
             self.on_decision(list(inputs), outputs)
         return outputs

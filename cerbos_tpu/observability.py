@@ -17,6 +17,7 @@ import logging
 import os
 import signal
 import sys
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -94,6 +95,108 @@ class SpanExporter:
         )
 
 
+class OTLPSpanExporter(SpanExporter):
+    """OTLP/HTTP JSON exporter (ref: internal/observability/otel/{otel,traces}.go
+    — the reference configures OTLP from standard OTEL_* env vars; same here:
+    OTEL_EXPORTER_OTLP_ENDPOINT, OTEL_SERVICE_NAME). Spans batch in memory
+    and flush to {endpoint}/v1/traces on a background thread; export failures
+    drop the batch (observability must never block the request path)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        service_name: str = "cerbos-tpu",
+        flush_interval_s: float = 5.0,
+        max_batch: int = 512,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.max_batch = max_batch
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._interval = flush_interval_s
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="otlp-exporter")
+        self._thread.start()
+
+    def export(self, span: Span, duration_ms: float) -> None:
+        now_ns = time.time_ns()
+        otlp_span = {
+            "traceId": span.trace_id[:32].ljust(32, "0"),
+            "spanId": span.span_id[:16].ljust(16, "0"),
+            "parentSpanId": span.parent_id[:16].ljust(16, "0") if span.parent_id else "",
+            "name": span.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(now_ns - int(duration_ms * 1e6)),
+            "endTimeUnixNano": str(now_ns),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}} for k, v in span.attributes.items()
+            ],
+        }
+        with self._lock:
+            self._buf.append(otlp_span)
+            if len(self._buf) > self.max_batch * 4:
+                del self._buf[: -self.max_batch]  # bounded: drop oldest
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._buf:
+                return
+            batch, self._buf = self._buf[: self.max_batch], self._buf[self.max_batch:]
+        payload = json.dumps(
+            {
+                "resourceSpans": [
+                    {
+                        "resource": {
+                            "attributes": [
+                                {"key": "service.name", "value": {"stringValue": self.service_name}}
+                            ]
+                        },
+                        "scopeSpans": [{"scope": {"name": "cerbos_tpu"}, "spans": batch}],
+                    }
+                ]
+            }
+        ).encode()
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.endpoint}/v1/traces",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception as e:  # noqa: BLE001  (collector down: drop, don't block)
+            logging.getLogger("cerbos_tpu.tracing").debug("otlp export failed: %s", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain everything still buffered, one batch per flush
+        while True:
+            with self._lock:
+                if not self._buf:
+                    return
+            self.flush()
+
+
+def init_otlp_from_env() -> bool:
+    """Ref: otel.go — standard env wiring. Returns True when enabled."""
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT") or os.environ.get(
+        "OTEL_EXPORTER_OTLP_ENDPOINT"
+    )
+    if not endpoint:
+        return False
+    set_exporter(
+        OTLPSpanExporter(endpoint, service_name=os.environ.get("OTEL_SERVICE_NAME", "cerbos-tpu"))
+    )
+    return True
+
+
 _exporter: SpanExporter = SpanExporter()
 _current: dict[int, Span] = {}  # thread id -> active span
 
@@ -105,8 +208,6 @@ def set_exporter(exporter: SpanExporter) -> None:
 
 @contextlib.contextmanager
 def start_span(name: str, **attributes: Any) -> Iterator[Span]:
-    import threading
-
     tid = threading.get_ident()
     parent = _current.get(tid)
     span = Span(

@@ -86,6 +86,12 @@ class Planner:
         self.schema_mgr = schema_mgr
 
     def plan(self, input: PlanInput, params: Optional[T.EvalParams] = None) -> PlanOutput:
+        from ..observability import start_span
+
+        with start_span("engine.Plan", resource_kind=input.resource_kind):
+            return self._plan(input, params)
+
+    def _plan(self, input: PlanInput, params: Optional[T.EvalParams] = None) -> PlanOutput:
         params = params or T.EvalParams()
         rt = self.rt
 

@@ -35,6 +35,11 @@ class ServerConfig:
     max_workers: int = 16
     tls_cert: str = ""
     tls_key: str = ""
+    # CORS (ref: server/conf.go:90-99, middleware.go:150-186)
+    cors_disabled: bool = False
+    cors_allowed_origins: tuple = ()
+    cors_allowed_headers: tuple = ()
+    cors_max_age_s: int = 0
     tls_watch_interval_s: float = 5.0  # certinel-style rotation poll
 
     def ssl_context(self):
@@ -351,8 +356,32 @@ class Server:
 
     # -- HTTP --------------------------------------------------------------
 
+    @web.middleware
+    async def _cors_middleware(self, request: web.Request, handler):
+        """Ref: middleware.go:150-186 (rs/cors defaults + user-agent header)."""
+        conf = self.config
+        origin = request.headers.get("Origin", "")
+        allowed = "*"
+        if conf.cors_allowed_origins and "*" not in conf.cors_allowed_origins:
+            allowed = origin if origin in conf.cors_allowed_origins else ""
+        headers = conf.cors_allowed_headers or ("accept", "content-type", "user-agent", "x-requested-with")
+        if request.method == "OPTIONS" and "Access-Control-Request-Method" in request.headers:
+            resp = web.Response(status=204)
+            if allowed:
+                resp.headers["Access-Control-Allow-Origin"] = allowed
+                resp.headers["Access-Control-Allow-Methods"] = "HEAD, GET, POST, PUT, PATCH, DELETE"
+                resp.headers["Access-Control-Allow-Headers"] = ", ".join(headers)
+                if conf.cors_max_age_s:
+                    resp.headers["Access-Control-Max-Age"] = str(conf.cors_max_age_s)
+            return resp
+        resp = await handler(request)
+        if allowed and origin:
+            resp.headers["Access-Control-Allow-Origin"] = allowed
+        return resp
+
     def _http_app(self) -> web.Application:
-        app = web.Application(client_max_size=16 * 1024 * 1024)
+        middlewares = [] if self.config.cors_disabled else [self._cors_middleware]
+        app = web.Application(client_max_size=16 * 1024 * 1024, middlewares=middlewares)
         app.router.add_post("/api/check/resources", self._h_check_resources)
         app.router.add_post("/api/plan/resources", self._h_plan_resources)
         # deprecated APIs kept for older SDKs (ref: cerbos_svc.go:123-252)

@@ -1,0 +1,2 @@
+#!/usr/bin/env node
+require("child_process").spawn("python3", ["-m", "cerbos_tpu.cli", ...process.argv.slice(2)], { stdio: "inherit" }).on("exit", (c) => process.exit(c ?? 1));

@@ -344,3 +344,95 @@ class TestEmbeddingSDK:
                 assert json.loads(resp.read())["status"] == "SERVING"
         finally:
             pdp.close()
+
+
+class TestAwsLambda:
+    def test_check_via_lambda_event(self, policy_dir, tmp_path_factory, monkeypatch):
+        import yaml as _yaml
+
+        from cerbos_tpu import awslambda
+
+        # separate dir: policy_dir recursively scans its own tmp_path
+        cfg = tmp_path_factory.mktemp("lambda-cfg") / "cfg.yaml"
+        cfg.write_text(_yaml.safe_dump({
+            "storage": {"driver": "disk", "disk": {"directory": str(policy_dir)}},
+            "engine": {"tpu": {"enabled": False}},
+        }))
+        monkeypatch.setenv("CERBOS_CONFIG", str(cfg))
+        awslambda.reset()
+        try:
+            event = {
+                "rawPath": "/api/check/resources",
+                "requestContext": {"http": {"method": "POST"}},
+                "body": json.dumps({
+                    "requestId": "l1",
+                    "principal": {"id": "u", "roles": ["user"]},
+                    "resources": [{"actions": ["view"],
+                                   "resource": {"kind": "doc", "id": "d", "attr": {"owner": "u"}}}],
+                }),
+            }
+            resp = awslambda.lambda_handler(event)
+            assert resp["statusCode"] == 200
+            body = json.loads(resp["body"])
+            assert body["results"][0]["actions"]["view"] == "EFFECT_ALLOW"
+
+            health = awslambda.lambda_handler({"rawPath": "/_cerbos/health"})
+            assert json.loads(health["body"]) == {"status": "SERVING"}
+
+            bad = awslambda.lambda_handler({
+                "rawPath": "/api/check/resources",
+                "requestContext": {"http": {"method": "POST"}},
+                "body": "{broken",
+            })
+            assert bad["statusCode"] == 400
+        finally:
+            awslambda.reset()
+
+
+class TestOTLPExporter:
+    def test_spans_flush_to_collector(self):
+        import http.server
+        import threading as th
+
+        from cerbos_tpu import observability as obs
+
+        received = []
+
+        class Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append((self.path, json.loads(self.rfile.read(n))))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            exp = obs.OTLPSpanExporter(
+                f"http://127.0.0.1:{srv.server_port}", service_name="t", flush_interval_s=60
+            )
+            old = obs._exporter
+            obs.set_exporter(exp)
+            try:
+                with obs.start_span("engine.Check", batch=3):
+                    with obs.start_span("ruletable.Check"):
+                        pass
+            finally:
+                obs.set_exporter(old)
+            exp.close()
+            assert received, "no OTLP batch received"
+            path, body = received[0]
+            assert path == "/v1/traces"
+            spans = body["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            names = {s["name"] for s in spans}
+            assert names == {"engine.Check", "ruletable.Check"}
+            child = next(s for s in spans if s["name"] == "ruletable.Check")
+            parent = next(s for s in spans if s["name"] == "engine.Check")
+            assert child["parentSpanId"] == parent["spanId"]
+            assert child["traceId"] == parent["traceId"]
+        finally:
+            srv.shutdown()

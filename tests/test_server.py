@@ -581,3 +581,25 @@ class TestCtlGrpc:
 
         rc = ctl.main(["--server", addr, "--grpc", "store", "reload"])
         assert rc in (0, None)
+
+
+class TestCORS:
+    def test_preflight_and_origin_header(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.http_port}/api/check/resources",
+            method="OPTIONS",
+            headers={"Origin": "https://app.example", "Access-Control-Request-Method": "POST"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 204
+            assert resp.headers["Access-Control-Allow-Origin"] == "*"
+            assert "POST" in resp.headers["Access-Control-Allow-Methods"]
+            assert "user-agent" in resp.headers["Access-Control-Allow-Headers"]
+
+    def test_simple_request_gets_origin(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.http_port}/_cerbos/health",
+            headers={"Origin": "https://app.example"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["Access-Control-Allow-Origin"] == "*"
