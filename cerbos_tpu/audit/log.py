@@ -46,28 +46,80 @@ def _now_iso() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
 
+def _drop_empty(d: dict) -> dict:
+    """Proto-JSON convention: default/empty fields are omitted."""
+    return {k: v for k, v in d.items() if v not in ("", [], {}, None)}
+
+
+def _input_json(i: T.CheckInput) -> dict:
+    return _drop_empty(
+        {
+            "requestId": i.request_id,
+            "resource": _drop_empty(
+                {
+                    "kind": i.resource.kind,
+                    "policyVersion": i.resource.policy_version,
+                    "id": i.resource.id,
+                    "attr": i.resource.attr,
+                    "scope": i.resource.scope,
+                }
+            ),
+            "principal": _drop_empty(
+                {
+                    "id": i.principal.id,
+                    "policyVersion": i.principal.policy_version,
+                    "roles": list(i.principal.roles),
+                    "attr": i.principal.attr,
+                    "scope": i.principal.scope,
+                }
+            ),
+            "actions": list(i.actions),
+            "auxData": _drop_empty({"jwt": i.aux_data.jwt}) if i.aux_data else {},
+        }
+    )
+
+
+def _output_json(o: T.CheckOutput) -> dict:
+    return _drop_empty(
+        {
+            "requestId": o.request_id,
+            "resourceId": o.resource_id,
+            "actions": {
+                a: _drop_empty({"effect": e.effect, "policy": e.policy, "scope": e.scope})
+                for a, e in o.actions.items()
+            },
+            "effectiveDerivedRoles": list(o.effective_derived_roles),
+            "outputs": [
+                _drop_empty({"src": x.src, "action": x.action, "val": x.val, "error": x.error})
+                for x in o.outputs
+            ],
+            "validationErrors": [
+                {"path": v.path, "message": v.message, "source": v.source}
+                for v in o.validation_errors
+            ],
+        }
+    )
+
+
 def _entry_from_decision(call_id: str, inputs: list[T.CheckInput], outputs: list[T.CheckOutput]) -> dict:
-    return {
-        "callId": call_id,
-        "timestamp": _now_iso(),
-        "kind": "decision",
-        "inputs": [
-            {
-                "requestId": i.request_id,
-                "principal": {"id": i.principal.id, "roles": i.principal.roles},
-                "resource": {"kind": i.resource.kind, "id": i.resource.id},
-                "actions": i.actions,
-            }
-            for i in inputs
-        ],
-        "outputs": [
-            {
-                "resourceId": o.resource_id,
-                "actions": {a: {"effect": e.effect, "policy": e.policy, "scope": e.scope} for a, e in o.actions.items()},
-            }
-            for o in outputs
-        ],
-    }
+    """Ref: auditv1.DecisionLogEntry (checkResources + auditTrail shape as
+    compared by engine_test.go's wantDecisionLogs)."""
+    effective: dict[str, dict] = {}
+    for o in outputs:
+        for key, attrs in o.effective_policies.items():
+            effective.setdefault(key, {"attributes": dict(attrs)})
+    return _drop_empty(
+        {
+            "callId": call_id,
+            "timestamp": _now_iso(),
+            "kind": "decision",
+            "checkResources": {
+                "inputs": [_input_json(i) for i in inputs],
+                "outputs": [_output_json(o) for o in outputs],
+            },
+            "auditTrail": {"effectivePolicies": effective} if effective else {},
+        }
+    )
 
 
 class AuditLog:

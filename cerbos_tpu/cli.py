@@ -19,7 +19,7 @@ def _parse_duration_s(v) -> int:
     import re as _re
 
     total = 0.0
-    for num, unit in _re.findall(r"([0-9.]+)(ms|s|m|h)", str(v)):
+    for num, unit in _re.findall(r"(\d+(?:\.\d+)?)(ms|s|m|h)", str(v)):
         total += float(num) * {"ms": 0.001, "s": 1, "m": 60, "h": 3600}[unit]
     if total == 0 and str(v).strip():
         try:
@@ -34,7 +34,7 @@ def cmd_server(args: argparse.Namespace) -> int:
     from .config import Config
     from .server.server import Server, ServerConfig
 
-    from .observability import init_otlp_from_env
+    from .observability import close_exporter, init_otlp_from_env
 
     init_otlp_from_env()  # OTEL_EXPORTER_OTLP_ENDPOINT et al (ref: otel.go)
     config = Config.load(args.config, overrides=args.set or [])
@@ -51,6 +51,7 @@ def cmd_server(args: argparse.Namespace) -> int:
         extra.append(PlaygroundService())
 
     tls = server_conf.get("tls", {}) or {}
+    cors_conf = server_conf.get("cors") or {}
     server = Server(
         core.service,
         ServerConfig(
@@ -58,10 +59,10 @@ def cmd_server(args: argparse.Namespace) -> int:
             grpc_listen_addr=server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
             tls_cert=tls.get("cert", ""),
             tls_key=tls.get("key", ""),
-            cors_disabled=bool((server_conf.get("cors") or {}).get("disabled", False)),
-            cors_allowed_origins=tuple((server_conf.get("cors") or {}).get("allowedOrigins", []) or []),
-            cors_allowed_headers=tuple((server_conf.get("cors") or {}).get("allowedHeaders", []) or []),
-            cors_max_age_s=_parse_duration_s((server_conf.get("cors") or {}).get("maxAge", 0)),
+            cors_disabled=bool(cors_conf.get("disabled", False)),
+            cors_allowed_origins=tuple(cors_conf.get("allowedOrigins", []) or []),
+            cors_allowed_headers=tuple(cors_conf.get("allowedHeaders", []) or []),
+            cors_max_age_s=_parse_duration_s(cors_conf.get("maxAge", 0)),
         ),
         admin_service=_admin(core, server_conf),
         extra_services=extra,
@@ -75,6 +76,7 @@ def cmd_server(args: argparse.Namespace) -> int:
     finally:
         server.stop()
         core.close()
+        close_exporter()  # drain buffered OTLP spans
     return 0
 
 

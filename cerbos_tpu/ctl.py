@@ -173,7 +173,7 @@ def _decisions_browser(client, tail: int, follow: bool, interval: float) -> int:
     --follow keeps polling and prints only unseen call ids."""
     import time as _time
 
-    seen: set[str] = set()
+    seen: dict[str, None] = {}  # insertion-ordered set
     try:
         while True:
             resp = client.call("GET", "/admin/auditlog/list/decision_logs", params={"tail": str(tail)})
@@ -183,8 +183,10 @@ def _decisions_browser(client, tail: int, follow: bool, interval: float) -> int:
                 if key in seen:
                     continue
                 if len(seen) > 65536:
-                    seen.clear()
-                seen.add(key)
+                    # drop the oldest half; recent keys keep deduping
+                    for old in list(seen)[:32768]:
+                        del seen[old]
+                seen[key] = None
                 print(_render_decision(e))
             if not follow:
                 return 0

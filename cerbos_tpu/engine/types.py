@@ -95,6 +95,10 @@ class CheckOutput:
     effective_derived_roles: list[str] = field(default_factory=list)
     validation_errors: list[ValidationError] = field(default_factory=list)
     outputs: list[OutputEntry] = field(default_factory=list)
+    # audit-trail provenance (policy key → source attributes); not part of
+    # the API response, consumed by the decision log
+    # (auditv1.AuditTrail.effectivePolicies)
+    effective_policies: dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

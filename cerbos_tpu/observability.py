@@ -206,6 +206,13 @@ def set_exporter(exporter: SpanExporter) -> None:
     _exporter = exporter
 
 
+def close_exporter() -> None:
+    """Drain + stop the active exporter if it supports it (shutdown path)."""
+    close = getattr(_exporter, "close", None)
+    if close is not None:
+        close()
+
+
 @contextlib.contextmanager
 def start_span(name: str, **attributes: Any) -> Iterator[Span]:
     tid = threading.get_ident()

@@ -227,6 +227,9 @@ def check_input(
     output.effective_derived_roles = sorted(result.effective_derived_roles)
     output.validation_errors = result.validation_errors
     output.outputs = result.outputs
+    output.effective_policies = {
+        namer.policy_key_from_fqn(fqn): attrs for fqn, attrs in result.effective_policies.items()
+    }
     return output
 
 
@@ -359,8 +362,8 @@ def _check(rt: RuleTable, input: T.CheckInput, params: T.EvalParams, schema_mgr:
                         resource_version, sanitized_resource, scope, action, parent_roles, pt, pid
                     )
                     for b in bindings:
-                        if (meta := rt.get_meta(b.origin_fqn)) is not None and meta.source_attributes:
-                            result.effective_policies[b.origin_fqn] = dict(meta.source_attributes)
+                        for f, attrs in rt.get_chain_source_attributes(b.origin_fqn).items():
+                            result.effective_policies[f] = dict(attrs)
 
                         constants, variables = cached_variables(b.params)
 

@@ -125,6 +125,25 @@ class RuleTable:
         root = fqn.partition("/")[0]
         return self.schemas.get(namer.module_id(root))
 
+    def get_chain_source_attributes(self, fqn: str) -> dict[str, dict]:
+        """Source attributes for a policy AND its scope ancestors — compiled
+        policy sets carry the whole ancestor chain's SourceAttributes
+        (compile.go:153-165), so one binding attributes every policy in its
+        chain."""
+        out: dict[str, dict] = {}
+        root, sep, scope = fqn.partition("/")
+        chain = [fqn]
+        if sep:
+            segs = scope.split(".")
+            for i in range(len(segs) - 1, 0, -1):
+                chain.append(f"{root}/{'.'.join(segs[:i])}")
+            chain.append(root)
+        for f in chain:
+            meta = self.meta.get(namer.module_id(f))
+            if meta is not None and meta.source_attributes:
+                out[f] = meta.source_attributes
+        return out
+
     def get_meta(self, fqn: str) -> Optional[PolicyMeta]:
         return self.meta.get(namer.module_id(fqn))
 

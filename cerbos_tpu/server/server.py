@@ -367,6 +367,7 @@ class Server:
         headers = conf.cors_allowed_headers or ("accept", "content-type", "user-agent", "x-requested-with")
         if request.method == "OPTIONS" and "Access-Control-Request-Method" in request.headers:
             resp = web.Response(status=204)
+            resp.headers["Vary"] = "Origin"
             if allowed:
                 resp.headers["Access-Control-Allow-Origin"] = allowed
                 resp.headers["Access-Control-Allow-Methods"] = "HEAD, GET, POST, PUT, PATCH, DELETE"
@@ -377,6 +378,7 @@ class Server:
         resp = await handler(request)
         if allowed and origin:
             resp.headers["Access-Control-Allow-Origin"] = allowed
+            resp.headers["Vary"] = "Origin"
         return resp
 
     def _http_app(self) -> web.Application:

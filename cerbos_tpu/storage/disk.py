@@ -74,6 +74,14 @@ class DiskStore(Store):
             if fqn in policies:
                 errors.append(f"duplicate policy definition {fqn} in {path}")
                 continue
+            # provenance for audit trails (ref: the disk driver stamps
+            # SourceAttributes{driver, source-relpath} on every policy)
+            if pol.metadata is None:
+                pol.metadata = model.Metadata()
+            pol.metadata.source_attributes.setdefault("driver", "disk")
+            pol.metadata.source_attributes.setdefault(
+                "source", os.path.relpath(path, self.directory)
+            )
             policies[fqn] = pol
             files[path] = (fqn, os.path.getmtime(path))
         if errors and strict:

@@ -44,6 +44,7 @@ def _clone_output(template: "T.CheckOutput", inp: "T.CheckInput") -> "T.CheckOut
             for a, e in template.actions.items()
         },
         effective_derived_roles=list(template.effective_derived_roles),
+        effective_policies=dict(template.effective_policies),
     )
 
 
@@ -440,6 +441,7 @@ class TpuEvaluator:
 
         processed_scopes: set[int] = set()  # resource-chain depths processed
         output_entries: list[T.OutputEntry] = []
+        effective_policies: dict[str, Any] = {}
         ec_cache: dict[Any, Any] = {}
 
         def eval_ctx():
@@ -495,6 +497,7 @@ class TpuEvaluator:
             self._reconstruct(
                 plan, bi, batch, ci, role_results, win_j, sat_cond,
                 output_entries, eval_ctx, bookkeep_depth, current_ctx,
+                effective_policies,
             )
 
         # effective derived roles for processed resource scopes
@@ -503,6 +506,9 @@ class TpuEvaluator:
                 plan, bi, sorted(processed_scopes), params, eval_ctx, sat_cond
             )
         out.outputs = output_entries
+        out.effective_policies = {
+            namer.policy_key_from_fqn(fqn): attrs for fqn, attrs in effective_policies.items()
+        }
         return out
 
     def _entry_at(self, batch: PackedBatch, ci: int, k: int, j: int):
@@ -511,7 +517,7 @@ class TpuEvaluator:
             return per_k[k][j]
         return None
 
-    def _reconstruct(self, plan, bi, batch, ci, role_results, win_j, sat_cond, output_entries, eval_ctx, bookkeep_depth, current_ctx):
+    def _reconstruct(self, plan, bi, batch, ci, role_results, win_j, sat_cond, output_entries, eval_ctx, bookkeep_depth, current_ctx, effective_policies):
         """Mirror the visit order: per role, walk resource-chain depths in
         order, bookkeeping each newly visited scope's derived roles BEFORE
         evaluating that scope's rule outputs, so outputs see the same
@@ -530,16 +536,25 @@ class TpuEvaluator:
             chain = plan.principal_scopes if pt == PT_PRINCIPAL else plan.resource_scopes
             if not emit_outputs and pt == PT_RESOURCE:
                 # no outputs anywhere in the table: only the processed-depth
-                # bookkeeping matters, and the max depth over roles covers it
+                # bookkeeping and policy provenance matter; the max depth
+                # over roles covers both
                 overall = -1
+                last_k = 0
                 for k in ks:
                     code = int(role_results[ci, k, pt, 0])
                     depth = int(role_results[ci, k, pt, 1])
                     overall = max(overall, min(depth, len(chain) - 1) if code != CODE_NO_MATCH else len(chain) - 1)
+                    last_k = k
                     if code == CODE_ALLOW:
                         break
                 for d in range(0, overall + 1):
                     bookkeep_depth(d)
+                for k in ks[: last_k + 1]:
+                    entries = batch.cand_entries[ci][k] if k < len(batch.cand_entries[ci]) else []
+                    code = int(role_results[ci, k, pt, 0])
+                    depth = int(role_results[ci, k, pt, 1])
+                    maxd = min(depth, len(chain) - 1) if code != CODE_NO_MATCH else len(chain) - 1
+                    self._collect_effective(entries, pt, maxd, effective_policies)
                 continue
             for k in ks:
                 code = int(role_results[ci, k, pt, 0])
@@ -547,6 +562,7 @@ class TpuEvaluator:
                 max_depth = min(depth, len(chain) - 1) if code != CODE_NO_MATCH else len(chain) - 1
                 entries = batch.cand_entries[ci][k] if k < len(batch.cand_entries[ci]) else []
                 wj = int(win_j[ci, k, pt]) if code == CODE_DENY else -1
+                self._collect_effective(entries, pt, max_depth, effective_policies)
                 for d in range(0, max_depth + 1):
                     if pt == PT_RESOURCE:
                         bookkeep_depth(d)
@@ -580,6 +596,19 @@ class TpuEvaluator:
                 # stop visiting further roles if this role allowed
                 if code == CODE_ALLOW:
                     break
+
+    def _collect_effective(self, entries, pt, max_depth, effective_policies) -> None:
+        """Policy provenance for every binding in a visited scope — the
+        oracle records source attributes for all QUERIED bindings, satisfied
+        or not (check.py:356-358 / check.go effectivePolicies)."""
+        rt = self.rule_table
+        for e in entries:
+            if e is None or e.pt != pt or e.depth > max_depth:
+                continue
+            if e.origin_fqn in effective_policies:
+                continue
+            for f, attrs in rt.get_chain_source_attributes(e.origin_fqn).items():
+                effective_policies.setdefault(f, dict(attrs))
 
     def _rule_src(self, e) -> str:
         meta = self.rule_table.get_meta(e.origin_fqn)

@@ -48,6 +48,9 @@ def assert_parity(rule_table, inputs, params=None, use_jax=False, mode=None):
             a: (e.effect, e.policy, e.scope) for a, e in w.actions.items()
         }, f"effect mismatch for input {i}: {inputs[i]}"
         assert g.effective_derived_roles == w.effective_derived_roles, f"edr mismatch for input {i}"
+        assert g.effective_policies == w.effective_policies, (
+            f"effective_policies mismatch for input {i}: {g.effective_policies} vs {w.effective_policies}"
+        )
         assert sorted((o.src, o.action, repr(o.val)) for o in g.outputs) == sorted(
             (o.src, o.action, repr(o.val)) for o in w.outputs
         ), f"outputs mismatch for input {i}"
