@@ -81,6 +81,10 @@ class ColumnBatch:
     # host-evaluated predicate columns: pred_id -> (val[B], err[B])
     pred_vals: dict[int, np.ndarray] = field(default_factory=dict)
     pred_errs: dict[int, np.ndarray] = field(default_factory=dict)
+    # string-list membership columns: path -> sids [B, L] / state [B]
+    # (state 0=missing, 1=ok, 2=error)
+    list_sids: dict[tuple, np.ndarray] = field(default_factory=dict)
+    list_states: dict[tuple, np.ndarray] = field(default_factory=dict)
 
 
 def resolve_path(input_obj: Any, path: tuple[str, ...]) -> tuple[bool, Any]:

@@ -70,13 +70,16 @@ class CondKernel:
     emit: Optional[Callable[["Refs"], Any]] = None
     # tags that force CPU fallback when seen at a path in a batch
     fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
+    # paths needing string-list membership columns
+    list_paths: set[tuple[str, ...]] = field(default_factory=set)
     references_runtime: bool = False
 
 
 class Refs:
     """Accessors handed to kernel emit functions (jnp or np arrays)."""
 
-    def __init__(self, xp, tags, his, los, sids, nans, pred_vals, pred_errs):
+    def __init__(self, xp, tags, his, los, sids, nans, pred_vals, pred_errs,
+                 list_sids=None, list_states=None):
         self.xp = xp
         self._tags = tags
         self._his = his
@@ -85,6 +88,8 @@ class Refs:
         self._nans = nans
         self._pred_vals = pred_vals
         self._pred_errs = pred_errs
+        self._list_sids = list_sids or {}
+        self._list_states = list_states or {}
 
     def tag(self, path):
         return self._tags[path]
@@ -103,6 +108,11 @@ class Refs:
 
     def pred(self, pred_id):
         return self._pred_vals[pred_id], self._pred_errs[pred_id]
+
+    def list_col(self, path):
+        """(sids [B, L], state [B]) for a string-list membership column;
+        state: 0=missing, 1=ok list, 2=error (non-list / bad element)."""
+        return self._list_sids[path], self._list_states[path]
 
 
 # ---------------------------------------------------------------------------
@@ -501,6 +511,22 @@ class _Compiler:
                 return val & ~err, err
 
             return BoolExpr(emit)
+        if isinstance(rhs, PathOp) and isinstance(lhs, ConstOp) and isinstance(lhs.value, str):
+            # `"x" in R.attr.list`: membership over a string-list column
+            # (sid comparison per padded slot; non-list values error, which
+            # collapses to false at the condition boundary like the oracle)
+            self.k.list_paths.add(rhs.path)
+            sid = self.interner.intern(lhs.value)
+
+            def emit_in_list(refs, p=rhs.path, sid=sid):
+                sids, state = refs.list_col(p)
+                # anything but a well-formed list (missing attr, wrong type)
+                # is a CEL error, which matters under ! / && / || absorption
+                err = state != 1
+                val = (sids == sid).any(axis=1) & ~err
+                return val, err
+
+            return BoolExpr(emit_in_list)
         raise Unsupported("in over attribute lists")
 
     def _add_fallback(self, path: tuple[str, ...], tags: set[int]) -> None:

@@ -76,13 +76,16 @@ def _compute(
     cand_depth,
     cand_valid,
     scope_sp,
+    list_sids=None,
+    list_states=None,
 ):
     """Pure array computation: jittable with `xp=jnp`, testable with numpy.
 
     Returns (final [BA,4], role_results [BA,K,2,2], win_j [BA,K,2],
     sat_cond [B,C]) — see module docstring for the lattice.
     """
-    refs = Refs(xp, tags, his, los, sids, nans, pred_vals, pred_errs)
+    refs = Refs(xp, tags, his, los, sids, nans, pred_vals, pred_errs,
+                list_sids=list_sids, list_states=list_states)
     # scope_sp is always [B, 2, D]; column dicts can all be empty when the
     # policy set has only unconditional rules, so B must not come from them
     B = scope_sp.shape[0]
@@ -219,6 +222,7 @@ def _device_eval(
         ba_input=batch.ba_input, cand_cond=batch.cand_cond, cand_drcond=batch.cand_drcond,
         cand_effect=batch.cand_effect, cand_pt=batch.cand_pt, cand_depth=batch.cand_depth,
         cand_valid=batch.cand_valid, scope_sp=batch.scope_sp,
+        list_sids=cols.list_sids, list_states=cols.list_states,
     )
 
     if not use_jax:
@@ -244,6 +248,8 @@ def _device_eval(
         return np.concatenate([a, pad])
 
     padded = dict(
+        list_sids={p: pad_b(a) for p, a in cols.list_sids.items()},
+        list_states={p: pad_b(a) for p, a in cols.list_states.items()},
         tags={p: pad_b(a) for p, a in cols.tags.items()},
         his={p: pad_b(a) for p, a in cols.his.items()},
         los={p: pad_b(a) for p, a in cols.los.items()},

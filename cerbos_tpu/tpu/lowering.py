@@ -60,6 +60,7 @@ class LoweredTable:
     interner: StringInterner
     rows: dict[int, LoweredRow] = field(default_factory=dict)  # by RuleRow.id
     paths: set[tuple[str, ...]] = field(default_factory=set)
+    list_paths: set[tuple[str, ...]] = field(default_factory=set)
     fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
     dr_cond_ids: dict[int, int] = field(default_factory=dict)  # id(CompiledDerivedRole) -> cond id
     has_outputs: bool = False
@@ -110,9 +111,11 @@ class LoweredTable:
 
     def _collect_paths(self) -> None:
         self.paths.clear()
+        self.list_paths.clear()
         self.fallback_tags.clear()
         for k in self.compiler.kernels:
             self.paths |= k.paths
+            self.list_paths |= k.list_paths
             for p, tags in k.fallback_tags.items():
                 self.fallback_tags[p] = self.fallback_tags.get(p, frozenset()) | tags
             for spec in k.preds:

@@ -532,6 +532,7 @@ class Packer:
         active = [(bi, plan) for bi, plan in enumerate(plans) if not (plan.trivial or plan.oracle)]
         if native is not None and hasattr(native, "encode_column"):
             self._encode_columns_native(cb, plans, active, paths, native)
+            self._encode_list_columns(cb, plans, active)
             self._encode_preds(cb, plans, active, params)
             return cb
         for p in paths:
@@ -584,8 +585,56 @@ class Packer:
                 nn[idx] = np.frombuffer(nan_b, dtype=np.uint8).astype(bool)
             cb.tags[p], cb.his[p], cb.los[p], cb.sids[p], cb.nans[p] = t, h, l, s, nn
 
+        self._encode_list_columns(cb, plans, active)
         self._encode_preds(cb, plans, active, params)
         return cb
+
+    def _encode_list_columns(self, cb: ColumnBatch, plans, active) -> None:
+        """String-list membership columns: per path, pad each input's list of
+        interned sids to the batch max length; non-lists / non-string
+        elements error (state 2), missing attrs are state 0."""
+        B = cb.size
+        interner = self.lt.interner
+        for p in sorted(self.lt.list_paths):
+            accessor = self._path_accessor(p)
+            per_input: list[list[int]] = [[] for _ in range(B)]
+            state = np.zeros(B, dtype=np.int8)
+            max_len = 1
+            for bi, plan in active:
+                if plan.oracle:
+                    continue
+                v = accessor(plan.input)
+                if v is _MISSING_SENTINEL:
+                    continue  # state 0
+                if isinstance(v, dict):
+                    # CEL `in` over a map is KEY membership — different
+                    # semantics; route to the oracle like scalar-path
+                    # fallback tags do
+                    plan.oracle = True
+                    continue
+                if not isinstance(v, list):
+                    state[bi] = 2
+                    continue
+                sids = []
+                for el in v:
+                    if isinstance(el, str):
+                        sids.append(interner.intern(el))
+                    else:
+                        # a non-string element can never equal the string
+                        # constant; slot 0 (reserved) never matches
+                        sids.append(0)
+                state[bi] = 1
+                per_input[bi] = sids
+                max_len = max(max_len, len(sids))
+            # bucket the list axis so jit traces are reused across batches
+            # with different max lengths
+            max_len = _pow2(max(max_len, 4))
+            arr = np.zeros((B, max_len), dtype=np.int32)
+            for bi, sids in enumerate(per_input):
+                if sids:
+                    arr[bi, : len(sids)] = sids
+            cb.list_sids[p] = arr
+            cb.list_states[p] = state
 
     def _encode_preds(self, cb: ColumnBatch, plans, active, params) -> None:
         B = cb.size

@@ -165,15 +165,18 @@ class TestGoldenDecisionLogs:
     insensitive, empty fields omitted. policySource (a store-driver marker
     rewritten by the reference harness) is not modeled in entries here."""
 
-    def _norm(self, v, sort_keys=()):
+    def _norm(self, v, sort_keys=(), top=True):
         from golden_loader import _norm_val
 
         if isinstance(v, dict):
             out = {}
             for k, x in v.items():
-                if k in ("callId", "timestamp", "peer", "policySource", "kind"):
+                skip = ("callId", "timestamp", "peer", "policySource")
+                # "kind" is the entry discriminator only at the TOP level;
+                # nested kinds (resource.kind) must compare
+                if k in skip or (top and k == "kind"):
                     continue
-                n = self._norm(x, sort_keys)
+                n = self._norm(x, sort_keys, top=False)
                 if k in ("effectiveDerivedRoles", "effective_derived_roles", "roles"):
                     n = sorted(n, key=str)
                     k = "effectiveDerivedRoles" if k.startswith("effective") else k
@@ -184,7 +187,7 @@ class TestGoldenDecisionLogs:
                 out[k] = n
             return out
         if isinstance(v, list):
-            return [self._norm(x, sort_keys) for x in v]
+            return [self._norm(x, sort_keys, top=False) for x in v]
         return _norm_val(v)
 
     @pytest.mark.parametrize(

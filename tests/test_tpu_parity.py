@@ -294,3 +294,85 @@ def test_no_condition_table_parity(mode):
         for i in range(20)
     ]
     assert_parity(rt, inputs, mode=mode)
+
+
+LIST_MEMBERSHIP_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: article
+  version: default
+  rules:
+    - actions: ["publish"]
+      effect: EFFECT_ALLOW
+      roles: [author]
+      condition:
+        match:
+          all:
+            of:
+              - expr: '"cerbos-jwt-tests" in request.aux_data.jwt.aud'
+              - expr: '"A" in request.aux_data.jwt.customArray'
+    - actions: ["tag"]
+      effect: EFFECT_ALLOW
+      roles: [author]
+      condition:
+        match:
+          expr: '"featured" in R.attr.labels'
+    - actions: ["untag"]
+      effect: EFFECT_ALLOW
+      roles: [author]
+      condition:
+        match:
+          expr: '!("locked" in R.attr.labels)'
+"""
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_list_membership_device(mode):
+    """`const in attr-list` runs on device via sid-list columns — including
+    error semantics for missing attrs and non-list values under negation."""
+    from cerbos_tpu.engine import AuxData
+
+    rt = table_for(LIST_MEMBERSHIP_POLICIES)
+    inputs = []
+    label_variants = [
+        ["featured", "locked"], ["featured"], ["locked"], [], ["other", 3, True],
+        "not-a-list", None, 42,
+    ]
+    aud_variants = [["cerbos-jwt-tests"], ["other"], [], None]
+    for i, labels in enumerate(label_variants):
+        for j, aud in enumerate(aud_variants):
+            attr = {} if labels is None else {"labels": labels}
+            aux = None
+            if aud is not None:
+                aux = AuxData(jwt={"aud": aud, "customArray": ["A"] if j % 2 == 0 else ["B"]})
+            inputs.append(CheckInput(
+                principal=Principal(id=f"a{i}{j}", roles=["author"], attr={}),
+                resource=Resource(kind="article", id=f"r{i}{j}", attr=attr),
+                actions=["publish", "tag", "untag"],
+                aux_data=aux,
+            ))
+    ev = assert_parity(rt, inputs, mode=mode)
+    assert ev.stats["device_inputs"] == len(inputs), ev.stats
+    # the membership conditions must be device kernels, not host predicates
+    assert len(ev.lowered.compiler.preds) == 0, "list membership fell back to predicate columns"
+
+
+@pytest.mark.parametrize("mode", ["numpy", "jax"])
+def test_list_membership_over_map_routes_to_oracle(mode):
+    # CEL `in` over a MAP is key membership; the device list column can't
+    # express it, so such inputs must take the oracle and still match
+    rt = table_for(LIST_MEMBERSHIP_POLICIES)
+    inputs = [
+        CheckInput(
+            principal=Principal(id="m", roles=["author"], attr={}),
+            resource=Resource(kind="article", id="m1", attr={"labels": {"featured": 1}}),
+            actions=["tag", "untag"],
+        ),
+        CheckInput(
+            principal=Principal(id="m2", roles=["author"], attr={}),
+            resource=Resource(kind="article", id="m2", attr={"labels": {"locked": True}}),
+            actions=["tag", "untag"],
+        ),
+    ]
+    ev = assert_parity(rt, inputs, mode=mode)
+    assert ev.stats["oracle_inputs"] == len(inputs), ev.stats
