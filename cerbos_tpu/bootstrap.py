@@ -42,7 +42,65 @@ class Core:
         self.store.close()
 
 
-def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
+@dataclass
+class Prebuilt:
+    """Expensive artifacts built once before forking worker processes.
+
+    The parent builds the rule table (and, if enabled, the lowered device
+    tables inside a TpuEvaluator) with no background threads running, then
+    forks; children adopt these via ``initialize(..., prebuilt=...)`` so the
+    big read-only structures are COW-shared instead of rebuilt per worker
+    (ref: the reference loads once and shares across its goroutine pool,
+    engine.go:74-88 — processes + COW are the Python analogue).
+    """
+
+    rule_table: Any
+    tpu_evaluator: Any = None
+
+
+def _make_evaluator(rule_table: Any, engine_conf: dict, schema_mgr: Any = None) -> Any:
+    """The single construction site for TpuEvaluator config wiring, shared
+    by single-process initialize() and the pre-fork prebuild() path."""
+    import os as _os
+
+    from .tpu import TpuEvaluator
+
+    tpu_conf = engine_conf.get("tpu", {})
+    backend = _os.environ.get("CERBOS_TPU_BACKEND", tpu_conf.get("backend", "jax"))
+    return TpuEvaluator(
+        rule_table,
+        globals_=engine_conf.get("globals", {}) or {},
+        schema_mgr=schema_mgr,
+        max_roles=int(tpu_conf.get("maxRoles", 8)),
+        max_candidates=int(tpu_conf.get("maxCandidates", 32)),
+        max_depth=int(tpu_conf.get("maxDepth", 8)),
+        use_jax=backend != "numpy",
+        min_device_batch=int(tpu_conf.get("minDeviceBatch", 16)),
+    )
+
+
+def prebuild(config: Config, use_tpu: Optional[bool] = None) -> Prebuilt:
+    """Parse → compile → build → lower, with no threads or listeners."""
+    store = new_store(config.section("storage"))
+    try:
+        manager = RuleTableManager(store)
+        rule_table = manager.rule_table
+        engine_conf = config.section("engine")
+        tpu_conf = engine_conf.get("tpu", {})
+        tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
+        tpu_evaluator = None
+        if tpu_enabled:
+            tpu_evaluator = _make_evaluator(rule_table, engine_conf)
+        return Prebuilt(rule_table=rule_table, tpu_evaluator=tpu_evaluator)
+    finally:
+        store.close()
+
+
+def initialize(
+    config: Config,
+    use_tpu: Optional[bool] = None,
+    prebuilt: Optional[Prebuilt] = None,
+) -> Core:
     audit_log = new_audit_log(config.section("audit"))
     store = new_store(config.section("storage"))
 
@@ -56,7 +114,7 @@ def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
         lenient_scope_search=bool(engine_conf.get("lenientScopeSearch", False)),
     )
 
-    manager = RuleTableManager(store)
+    manager = RuleTableManager(store, prebuilt_table=prebuilt.rule_table if prebuilt else None)
 
     tpu_conf = engine_conf.get("tpu", {})
     tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
@@ -64,21 +122,13 @@ def initialize(config: Config, use_tpu: Optional[bool] = None) -> Core:
     dispatch_evaluator = None
     batcher = None
     if tpu_enabled:
-        from .tpu import TpuEvaluator
-
-        import os as _os
-
-        backend = _os.environ.get("CERBOS_TPU_BACKEND", tpu_conf.get("backend", "jax"))
-        tpu_evaluator = TpuEvaluator(
-            manager.rule_table,
-            globals_=eval_params.globals,
-            schema_mgr=schema_mgr,
-            max_roles=int(tpu_conf.get("maxRoles", 8)),
-            max_candidates=int(tpu_conf.get("maxCandidates", 32)),
-            max_depth=int(tpu_conf.get("maxDepth", 8)),
-            use_jax=backend != "numpy",
-            min_device_batch=int(tpu_conf.get("minDeviceBatch", 16)),
-        )
+        if prebuilt is not None and prebuilt.tpu_evaluator is not None:
+            # adopt the pre-lowered evaluator (COW-shared across forked
+            # workers); only the per-process schema manager needs rewiring
+            tpu_evaluator = prebuilt.tpu_evaluator
+            tpu_evaluator.schema_mgr = schema_mgr
+        else:
+            tpu_evaluator = _make_evaluator(manager.rule_table, engine_conf, schema_mgr)
         manager.evaluator_refresh_hook(tpu_evaluator)
         dispatch_evaluator = tpu_evaluator
         if tpu_conf.get("requestBatching", True):

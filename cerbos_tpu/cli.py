@@ -29,18 +29,12 @@ def _parse_duration_s(v) -> int:
     return int(total)
 
 
-def cmd_server(args: argparse.Namespace) -> int:
-    from .bootstrap import initialize
-    from .config import Config
+def _build_server(core, config, http_addr=None, grpc_addr=None, reuse_port=False):
+    """One construction site for the full server wiring (admin, authzen,
+    playground, TLS, CORS) shared by single-process serve and worker pools."""
     from .server.server import Server, ServerConfig
 
-    from .observability import close_exporter, init_otlp_from_env
-
-    init_otlp_from_env()  # OTEL_EXPORTER_OTLP_ENDPOINT et al (ref: otel.go)
-    config = Config.load(args.config, overrides=args.set or [])
-    core = initialize(config)
     server_conf = config.section("server")
-
     extra = []
     from .server.authzen import AuthZenService
 
@@ -52,21 +46,64 @@ def cmd_server(args: argparse.Namespace) -> int:
 
     tls = server_conf.get("tls", {}) or {}
     cors_conf = server_conf.get("cors") or {}
-    server = Server(
+    return Server(
         core.service,
         ServerConfig(
-            http_listen_addr=server_conf.get("httpListenAddr", "0.0.0.0:3592"),
-            grpc_listen_addr=server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
+            http_listen_addr=http_addr or server_conf.get("httpListenAddr", "0.0.0.0:3592"),
+            grpc_listen_addr=grpc_addr or server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
             tls_cert=tls.get("cert", ""),
             tls_key=tls.get("key", ""),
             cors_disabled=bool(cors_conf.get("disabled", False)),
             cors_allowed_origins=tuple(cors_conf.get("allowedOrigins", []) or []),
             cors_allowed_headers=tuple(cors_conf.get("allowedHeaders", []) or []),
             cors_max_age_s=_parse_duration_s(cors_conf.get("maxAge", 0)),
+            reuse_port=reuse_port,
+            # inline dispatch is only safe without the cross-request batcher
+            # (which needs concurrent requests in flight to fill batches)
+            direct_dispatch=core.batcher is None,
         ),
         admin_service=_admin(core, server_conf),
         extra_services=extra,
     )
+
+
+def cmd_server(args: argparse.Namespace) -> int:
+    from .bootstrap import initialize
+    from .config import Config
+
+    from .observability import close_exporter, init_otlp_from_env
+
+    config = Config.load(args.config, overrides=args.set or [])
+    server_conf = config.section("server")
+
+    n_workers = int(getattr(args, "workers", 0) or server_conf.get("workers", 1) or 1)
+    if n_workers > 1:
+        # fork-after-load worker pool (engine.go:74-144 analogue): the pool
+        # prints the serving line itself once ports are resolved. The OTLP
+        # exporter thread must start POST-fork (each worker exports its own
+        # spans; a pre-fork thread would not exist in the children)
+        from .server.workers import run_server_pool
+
+        def announce(http_addr: str, grpc_addr: str) -> None:
+            http_port = http_addr.rpartition(":")[2]
+            grpc_port = grpc_addr.rpartition(":")[2]
+            print(
+                f"cerbos-tpu serving: http={http_port} grpc={grpc_port} workers={n_workers}",
+                flush=True,
+            )
+
+        return run_server_pool(
+            config,
+            n_workers,
+            _build_server,
+            announce=announce,
+            post_fork=init_otlp_from_env,
+            pre_exit=close_exporter,
+        )
+
+    init_otlp_from_env()  # OTEL_EXPORTER_OTLP_ENDPOINT et al (ref: otel.go)
+    core = initialize(config)
+    server = _build_server(core, config)
     server.start()
     print(f"cerbos-tpu serving: http={server.http_port} grpc={server.grpc_port}", flush=True)
     try:
@@ -219,6 +256,12 @@ def main(argv: list[str] | None = None) -> int:
     p_server = sub.add_parser("server", help="start the PDP server")
     p_server.add_argument("--config", help="path to config YAML")
     p_server.add_argument("--set", action="append", help="config overrides (key=value)")
+    p_server.add_argument(
+        "--workers",
+        type=int,
+        default=0,
+        help="serving worker processes (SO_REUSEPORT pool; default: server.workers config or 1)",
+    )
     p_server.set_defaults(fn=cmd_server)
 
     p_compile = sub.add_parser("compile", help="compile policies and run policy tests")

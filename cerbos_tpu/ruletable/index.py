@@ -90,10 +90,22 @@ class Index:
         self.parent_roles: dict[str, dict[str, list[str]]] = {}
         self._raw_parent_roles: dict[str, dict[str, list[str]]] = {}
         self._parent_roles_dirty = False
+        # request-shape memos: the serving path repeats a small set of
+        # (version, resource, scope, action, roles, ...) tuples; the index is
+        # immutable between mutations, so results cache until the next
+        # index_rules/delete_policy (the reference gets the same effect from
+        # bitmap ANDs being cheap; Python set ops are not, so memoize)
+        self._query_cache: dict[tuple, list] = {}
+        self._exists_cache: dict[tuple, bool] = {}
+
+    def _invalidate_memos(self) -> None:
+        self._query_cache.clear()
+        self._exists_cache.clear()
 
     # -- building ---------------------------------------------------------
 
     def index_rules(self, rules: list[RuleRow]) -> None:
+        self._invalidate_memos()
         for row in rules:
             rid = self._free_ids.pop() if self._free_ids else len(self.rows)
             row.id = rid
@@ -120,6 +132,7 @@ class Index:
         ids = self.fqn_ids.pop(fqn, None)
         if not ids:
             return
+        self._invalidate_memos()
         for rid in ids:
             row = self.rows[rid]
             if row is None:
@@ -200,18 +213,38 @@ class Index:
     def scoped_principal_exists(self, version: str, scopes: list[str]) -> bool:
         if not scopes:
             return False
+        key = (KIND_PRINCIPAL, version, tuple(scopes))
+        hit = self._exists_cache.get(key)
+        if hit is not None:
+            return hit
         v = self.version.get(version)
         k = self.policy_kind.get(KIND_PRINCIPAL)
         if not v or not k:
-            return False
-        s: set[int] = set()
-        for sc in scopes:
-            s |= self.scope.get(sc, set())
-        return bool(v & k & s)
+            res = False
+        else:
+            s: set[int] = set()
+            for sc in scopes:
+                s |= self.scope.get(sc, set())
+            res = bool(v & k & s)
+        if len(self._exists_cache) > 65536:
+            self._exists_cache.clear()
+        self._exists_cache[key] = res
+        return res
 
     def scoped_resource_exists(self, version: str, resource: str, scopes: list[str]) -> bool:
         if not scopes:
             return False
+        key = (KIND_RESOURCE, version, resource, tuple(scopes))
+        hit = self._exists_cache.get(key)
+        if hit is not None:
+            return hit
+        res = self._scoped_resource_exists(version, resource, scopes)
+        if len(self._exists_cache) > 65536:
+            self._exists_cache.clear()
+        self._exists_cache[key] = res
+        return res
+
+    def _scoped_resource_exists(self, version: str, resource: str, scopes: list[str]) -> bool:
         v = self.version.get(version)
         k = self.policy_kind.get(KIND_RESOURCE)
         if not v or not k:
@@ -235,10 +268,33 @@ class Index:
         principal_id: str,
     ) -> list[RuleRow]:
         """Rows matching all dimensions, with role-policy synthetic DENYs
-        prepended (ref: index.go:199-321). Empty/zero args mean match-all."""
+        prepended (ref: index.go:199-321). Empty/zero args mean match-all.
+
+        Results are memoized per argument tuple until the next index
+        mutation; callers receive a shared list and must not mutate it."""
         if len(self._free_ids) == len(self.rows):  # O(1) empty check
             return []
+        memo_key = (version, resource, scope, action, tuple(roles), policy_kind, principal_id)
+        cached = self._query_cache.get(memo_key)
+        if cached is not None:
+            return cached
 
+        out = self._query_uncached(version, resource, scope, action, roles, policy_kind, principal_id)
+        if len(self._query_cache) > 65536:
+            self._query_cache.clear()
+        self._query_cache[memo_key] = out
+        return out
+
+    def _query_uncached(
+        self,
+        version: str,
+        resource: str,
+        scope: str,
+        action: str,
+        roles: list[str],
+        policy_kind: str,
+        principal_id: str,
+    ) -> list[RuleRow]:
         principal_ids: Optional[frozenset[int] | set[int]] = None
         if principal_id:
             p = self.principal.get(principal_id)

@@ -21,11 +21,19 @@ log = logging.getLogger("cerbos_tpu.ruletable")
 
 
 class RuleTableManager:
-    def __init__(self, store: Store, on_swap: Optional[Callable[[RuleTable], None]] = None):
+    def __init__(
+        self,
+        store: Store,
+        on_swap: Optional[Callable[[RuleTable], None]] = None,
+        prebuilt_table: Optional[RuleTable] = None,
+    ):
         self.store = store
         self.on_swap = on_swap
         self._lock = threading.RLock()
-        self.rule_table = self._build()
+        # a prebuilt table (bootstrap.prebuild, COW-shared across forked
+        # workers) skips the parse+compile+build pipeline; storage events
+        # still rebuild from this process's own store
+        self.rule_table = prebuilt_table if prebuilt_table is not None else self._build()
         store.subscribe(self.on_storage_event)
 
     def _build(self) -> RuleTable:

@@ -41,6 +41,15 @@ class ServerConfig:
     cors_allowed_headers: tuple = ()
     cors_max_age_s: int = 0
     tls_watch_interval_s: float = 5.0  # certinel-style rotation poll
+    # multi-process worker pools bind every worker's listeners to the same
+    # ports; the kernel load-balances accepted connections (SO_REUSEPORT)
+    reuse_port: bool = False
+    # run check/plan handlers inline on the event loop instead of hopping to
+    # the thread pool. Correct (and faster: the hop costs ~100µs + GIL churn)
+    # when evaluation is the short serial path; MUST stay False when the
+    # engine blocks on the cross-request batcher, which needs concurrent
+    # requests in flight to fill a batch
+    direct_dispatch: bool = False
 
     def ssl_context(self):
         if not (self.tls_cert and self.tls_key):
@@ -339,7 +348,10 @@ class Server:
     # -- gRPC --------------------------------------------------------------
 
     def _start_grpc(self) -> None:
-        server = grpc.server(futures.ThreadPoolExecutor(max_workers=self.config.max_workers))
+        options = [("grpc.so_reuseport", 1 if self.config.reuse_port else 0)]
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.config.max_workers), options=options
+        )
         server.add_generic_rpc_handlers((_grpc_handlers(self.svc),))
         if self.admin_service is not None:
             handler = self.admin_service.grpc_handler()
@@ -438,9 +450,12 @@ class Server:
             if aux_j.get("token"):
                 aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
             inputs, request_id, include_meta = convert.json_to_check_inputs(body, aux)
-            outputs, call_id = await asyncio.get_running_loop().run_in_executor(
-                None, self.svc.check_resources, inputs
-            )
+            if self.config.direct_dispatch:
+                outputs, call_id = self.svc.check_resources(inputs)
+            else:
+                outputs, call_id = await asyncio.get_running_loop().run_in_executor(
+                    None, self.svc.check_resources, inputs
+                )
             return web.json_response(convert.outputs_to_json(body, outputs, request_id, include_meta, call_id))
         except RequestLimitExceeded as e:
             return web.json_response({"code": 3, "message": str(e)}, status=400)
@@ -593,7 +608,13 @@ class Server:
                 site: web.BaseSite = web.UnixSite(runner, addr[len("unix:"):], ssl_context=ssl_ctx)
             else:
                 host, _, port = addr.rpartition(":")
-                site = web.TCPSite(runner, host or "0.0.0.0", int(port), ssl_context=ssl_ctx)
+                site = web.TCPSite(
+                    runner,
+                    host or "0.0.0.0",
+                    int(port),
+                    ssl_context=ssl_ctx,
+                    reuse_port=self.config.reuse_port or None,
+                )
             loop.run_until_complete(site.start())
             if not addr.startswith("unix:"):
                 for s in runner.sites:

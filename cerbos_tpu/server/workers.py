@@ -1,0 +1,190 @@
+"""Multi-process worker pool: fork-after-load serving.
+
+Behavioral reference: internal/engine/engine.go:74-144 — the reference
+saturates its CPUs with a NumCPU+4 goroutine pool behind one listener.
+Goroutines have no Python analogue under the GIL, so the equivalent here is
+processes: the parent builds the expensive artifacts once (parse → compile →
+rule table → lowered device tables, ``bootstrap.prebuild``), calls
+``gc.freeze()`` so refcount churn doesn't dirty the shared pages, then forks
+N workers. Each worker finishes its own initialization (store watcher, audit
+writer, batcher threads — threads must start *after* fork) and binds its own
+gRPC + HTTP listeners on the SAME ports with ``SO_REUSEPORT``; the kernel
+load-balances accepted connections across workers.
+
+The parent is a supervisor: it restarts crashed workers (preserving the
+prebuilt artifacts, so a restart is cheap) and fans SIGTERM/SIGINT out to
+the pool for graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import signal
+import socket
+import sys
+import time
+from typing import Callable, Optional
+
+_RESTART_LIMIT = 10  # per worker slot; a crash-looping config must not spin forever
+_RESTART_WINDOW_S = 60.0
+
+
+def resolve_listen_addr(addr: str) -> str:
+    """Resolve ":0" to a concrete ephemeral port for the pool.
+
+    SO_REUSEPORT workers must all bind the SAME port, so a wildcard port is
+    chosen once by the parent. The reserving socket is bound with REUSEPORT
+    but never listens — bind-only sockets take no part in the kernel's
+    accept distribution — and stays open so the port cannot be claimed by
+    an unrelated process between worker restarts.
+
+    ``unix:`` addresses pass through untouched: per-worker SO_REUSEPORT
+    does not apply to unix sockets, so a pooled config should use TCP (a
+    single worker binding the socket path still works).
+    """
+    if addr.startswith("unix:"):
+        return addr
+    host, _, port = addr.rpartition(":")
+    host = host or "0.0.0.0"
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind((host, int(port)))
+    chosen = s.getsockname()[1]
+    _reservations.append(s)  # keep alive for the pool's lifetime
+    return f"{host}:{chosen}"
+
+
+_reservations: list[socket.socket] = []
+
+
+class WorkerPool:
+    """Fork N serving workers and supervise them.
+
+    ``worker_main(worker_idx)`` runs in each child; it must block until the
+    process receives SIGTERM (the child's own signal handling) and then
+    return for a clean exit. Exceptions exit the child non-zero, triggering
+    a supervised restart.
+    """
+
+    def __init__(self, n_workers: int, worker_main: Callable[[int], None], log=None):
+        self.n = n_workers
+        self.worker_main = worker_main
+        self.log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+        self._children: dict[int, int] = {}  # pid -> worker idx
+        self._restarts: dict[int, list[float]] = {}  # idx -> restart stamps
+        self._shutdown = False
+
+    def _spawn(self, idx: int) -> None:
+        pid = os.fork()
+        if pid == 0:
+            # child: default signal dispositions; worker_main installs its own
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent fans out SIGTERM
+            try:
+                self.worker_main(idx)
+                os._exit(0)
+            except BaseException as e:  # noqa: BLE001
+                print(f"worker {idx} crashed: {type(e).__name__}: {e}", file=sys.stderr, flush=True)
+                os._exit(1)
+        self._children[pid] = idx
+
+    def run(self) -> int:
+        """Blocking supervisor loop; returns the pool's exit code."""
+        # the prebuilt artifacts are effectively immutable from here on:
+        # freeze them out of gc so child refcount updates touch fewer pages
+        gc.freeze()
+
+        def handle_term(signum, frame):
+            self._shutdown = True
+            for pid in list(self._children):
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+
+        signal.signal(signal.SIGTERM, handle_term)
+        signal.signal(signal.SIGINT, handle_term)
+
+        for i in range(self.n):
+            self._spawn(i)
+        self.log(f"worker pool: {self.n} workers {sorted(self._children)}")
+
+        exit_code = 0
+        while self._children:
+            try:
+                pid, status = os.wait()
+            except ChildProcessError:
+                break
+            except InterruptedError:
+                continue
+            idx = self._children.pop(pid, None)
+            if idx is None:
+                continue
+            if self._shutdown:
+                continue
+            code = os.waitstatus_to_exitcode(status)
+            stamps = self._restarts.setdefault(idx, [])
+            now = time.monotonic()
+            stamps[:] = [t for t in stamps if now - t < _RESTART_WINDOW_S] + [now]
+            if len(stamps) > _RESTART_LIMIT:
+                self.log(f"worker {idx} crash-looping (exit {code}); shutting pool down")
+                exit_code = 1
+                handle_term(signal.SIGTERM, None)
+                continue
+            self.log(f"worker {idx} (pid {pid}) exited {code}; restarting")
+            self._spawn(idx)
+        return exit_code
+
+
+def run_server_pool(
+    config,
+    n_workers: int,
+    build_server: Callable[..., object],
+    use_tpu: Optional[bool] = None,
+    announce=None,
+    post_fork: Optional[Callable[[], None]] = None,
+    pre_exit: Optional[Callable[[], None]] = None,
+) -> int:
+    """Boot a pool of full PDP servers from one prebuilt core.
+
+    ``build_server(core, config, http_addr, grpc_addr, reuse_port)`` must
+    return a started-able Server (cli wires admin/authzen/playground the
+    same way for 1 or N workers).
+    """
+    from ..bootstrap import initialize, prebuild
+
+    server_conf = config.section("server")
+    http_addr = resolve_listen_addr(server_conf.get("httpListenAddr", "0.0.0.0:3592"))
+    grpc_addr = resolve_listen_addr(server_conf.get("grpcListenAddr", "0.0.0.0:3593"))
+
+    prebuilt = prebuild(config, use_tpu=use_tpu)
+
+    def worker_main(idx: int) -> None:
+        # install the handler BEFORE the (slow) init so a pool-wide SIGTERM
+        # during startup still exits through the graceful path
+        stop = {"flag": False}
+
+        def on_term(signum, frame):
+            stop["flag"] = True
+
+        signal.signal(signal.SIGTERM, on_term)
+        if post_fork is not None:
+            post_fork()
+        core = initialize(config, use_tpu=use_tpu, prebuilt=prebuilt)
+        server = build_server(core, config, http_addr, grpc_addr, True)
+        try:
+            if not stop["flag"]:
+                server.start()
+            while not stop["flag"]:
+                time.sleep(0.2)
+        finally:
+            server.stop()
+            core.close()
+            if pre_exit is not None:
+                pre_exit()
+
+    if announce is not None:
+        announce(http_addr, grpc_addr)
+    pool = WorkerPool(n_workers, worker_main)
+    return pool.run()

@@ -1,0 +1,50 @@
+"""TPU probe: subprocess isolation, evidence capture, summary shape."""
+
+import json
+
+from cerbos_tpu.util import tpu_probe
+
+
+def test_run_probe_succeeds_on_cpu_env():
+    # under the test conftest the axon plugin is scrubbed and
+    # JAX_PLATFORMS=cpu, so the probe subprocess initializes jax quickly
+    r = tpu_probe._run_probe({}, timeout_s=120.0, hang_after=110.0)
+    assert r["ok"] is True
+    assert r["rc"] == 0
+    assert "PLATFORM cpu" in r["stdout_tail"]
+    assert tpu_probe._parse_platform(r["stdout_tail"]) == "cpu"
+
+
+def test_run_probe_captures_failure_evidence():
+    # an impossible platform fails fast with a captured error message
+    r = tpu_probe._run_probe({"JAX_PLATFORMS": "nonexistent"}, timeout_s=120.0, hang_after=110.0)
+    assert r["ok"] is False
+    assert r["rc"] not in (0, None)
+    assert r["stderr_tail"]  # the why is recorded, not swallowed
+
+
+def test_summarize_classifies_rungs():
+    result = {
+        "available": False,
+        "platform": None,
+        "rungs": [
+            {"rung": "axon-attempt-1", "ok": False, "rc": None, "timed_out": True,
+             "duration_s": 90.0, "stdout_tail": "", "stderr_tail": ""},
+            {"rung": "axon-attempt-2", "ok": False, "rc": 1, "timed_out": False,
+             "duration_s": 60.0, "stdout_tail": "",
+             "stderr_tail": "Timeout (0:01:00)!\nThread ..."},
+            {"rung": "libtpu-direct", "ok": False, "rc": 1, "timed_out": False,
+             "duration_s": 2.0, "stdout_tail": "", "stderr_tail": "RuntimeError: no device"},
+        ],
+    }
+    s = tpu_probe.summarize(result)
+    assert s["available"] is False
+    kinds = [r["result"] for r in s["rungs"]]
+    assert kinds == ["hang", "hang", "exit-1"]
+
+
+def test_artifact_roundtrip(tmp_path):
+    result = {"available": True, "platform": "cpu", "rungs": []}
+    path = tmp_path / "probe.json"
+    tpu_probe.write_artifact(result, str(path))
+    assert json.loads(path.read_text()) == result

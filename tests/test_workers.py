@@ -1,0 +1,149 @@
+"""Multi-process worker pool: SO_REUSEPORT serving, crash restart, shutdown.
+
+Boots the real CLI (``cerbos_tpu.cli server --workers 2``) as a subprocess —
+the same entry a production pool uses — and drives it over HTTP.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: album
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: request.resource.attr.public == true
+    - actions: ["*"]
+      effect: EFFECT_ALLOW
+      roles: [admin]
+"""
+
+CHECK_BODY = {
+    "requestId": "w1",
+    "principal": {"id": "alice", "roles": ["user"]},
+    "resources": [
+        {"actions": ["view", "delete"], "resource": {"kind": "album", "id": "a1", "attr": {"public": True}}}
+    ],
+}
+
+
+def _check(port: int, timeout: float = 5.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/check/resources",
+        data=json.dumps(CHECK_BODY).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _worker_pids(pool_pid: int) -> list[int]:
+    out = subprocess.run(
+        ["ps", "-o", "pid=", "--ppid", str(pool_pid)], capture_output=True, text=True
+    )
+    return [int(p) for p in out.stdout.split()]
+
+
+@pytest.fixture(scope="module")
+def pool(tmp_path_factory):
+    policy_dir = tmp_path_factory.mktemp("policies")
+    (policy_dir / "album.yaml").write_text(POLICY)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "cerbos_tpu.cli", "server",
+            "--workers", "2",
+            "--set", f"storage.disk.directory={policy_dir}",
+            "--set", "server.httpListenAddr=127.0.0.1:0",
+            "--set", "server.grpcListenAddr=127.0.0.1:0",
+            "--set", "engine.tpu.enabled=false",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    http_port = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("cerbos-tpu serving:"):
+            for tok in line.split():
+                if tok.startswith("http="):
+                    http_port = int(tok.split("=")[1])
+            break
+    assert http_port, "pool never announced its ports"
+    # wait until a worker actually serves
+    deadline = time.time() + 60
+    last_err = None
+    while time.time() < deadline:
+        try:
+            _check(http_port)
+            break
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            time.sleep(0.25)
+    else:
+        proc.terminate()
+        raise AssertionError(f"pool never became ready: {last_err}")
+    yield proc, http_port
+    if proc.poll() is None:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
+def test_pool_serves_decisions(pool):
+    proc, port = pool
+    for _ in range(10):
+        resp = _check(port)
+        actions = resp["results"][0]["actions"]
+        assert actions["view"] == "EFFECT_ALLOW"
+        assert actions["delete"] == "EFFECT_DENY"
+
+
+def test_pool_has_n_workers(pool):
+    proc, port = pool
+    assert len(_worker_pids(proc.pid)) == 2
+
+
+def test_pool_restarts_crashed_worker(pool):
+    proc, port = pool
+    before = _worker_pids(proc.pid)
+    os.kill(before[0], signal.SIGKILL)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pids = _worker_pids(proc.pid)
+        if len(pids) == 2 and pids != before:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("killed worker was not replaced")
+    # the pool keeps serving throughout (the surviving worker + the new one)
+    resp = _check(port)
+    assert resp["results"][0]["actions"]["view"] == "EFFECT_ALLOW"
+
+
+def test_pool_shuts_down_cleanly(pool):
+    proc, port = pool
+    proc.terminate()
+    assert proc.wait(timeout=20) == 0
