@@ -172,20 +172,49 @@ class AuditLog:
         self._submit(_entry_from_decision(call_id, inputs, outputs))
 
     def write_plan(self, call_id: str, plan_input: Any, plan_output: Any) -> None:
+        """Plan decision entry mirroring DecisionLogEntry.PlanResources
+        (api/public/cerbos/audit/v1/audit.proto: input {requestId, action(s),
+        principal, resource}, output {filter, filterDebug}) plus
+        auditTrail.effectivePolicies (engine.go:186-200)."""
         if not self.decision_logs_enabled or self.backend is None:
             return
-        self._submit(
-            {
-                "callId": call_id,
-                "timestamp": _now_iso(),
-                "kind": "decision",
-                "planResources": {
+        principal = getattr(plan_input, "principal", None)
+        cond = getattr(plan_output, "condition", None)
+        entry = {
+            "callId": call_id,
+            "timestamp": _now_iso(),
+            "kind": "decision",
+            "planResources": {
+                "input": {
+                    "requestId": getattr(plan_input, "request_id", ""),
                     "actions": list(getattr(plan_input, "actions", [])),
-                    "kind": getattr(plan_output, "kind", ""),
-                    "resourceKind": getattr(plan_input, "resource_kind", ""),
+                    "principal": {
+                        "id": getattr(principal, "id", ""),
+                        "roles": list(getattr(principal, "roles", [])),
+                        "policyVersion": getattr(principal, "policy_version", ""),
+                        "scope": getattr(principal, "scope", ""),
+                    },
+                    "resource": {
+                        "kind": getattr(plan_input, "resource_kind", ""),
+                        "policyVersion": getattr(plan_input, "resource_policy_version", ""),
+                        "scope": getattr(plan_input, "resource_scope", ""),
+                    },
                 },
+                "output": {
+                    "requestId": getattr(plan_input, "request_id", ""),
+                    "kind": getattr(plan_output, "kind", ""),
+                    "filterDebug": cond.debug_str() if cond is not None else getattr(plan_output, "kind", ""),
+                },
+            },
+        }
+        effective = getattr(plan_output, "effective_policies", None)
+        if effective:
+            # same SourceAttributes wrapping as the check path, so log
+            # consumers read one shape (audit.proto AuditTrail)
+            entry["auditTrail"] = {
+                "effectivePolicies": {k: {"attributes": v} for k, v in effective.items()}
             }
-        )
+        self._submit(entry)
 
     def close(self) -> None:
         self._queue.put(None)

@@ -1,0 +1,142 @@
+"""Remote audit ingest backend: batched POSTs to a generic HTTPS endpoint.
+
+Behavioral reference: internal/audit/hub/hub.go (1-604) — the hub backend
+buffers entries, flushes them in size- or time-bounded batches to a remote
+ingest API, retries with backoff, and spills/drops oldest under sustained
+failure instead of blocking the decision path. This is the same mechanism
+against a generic endpoint (JSON array POST + optional bearer token)
+instead of the proprietary hub RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from collections import deque
+from typing import Optional
+
+from .log import register_backend
+
+log = logging.getLogger("cerbos_tpu.audit.remote")
+
+
+class RemoteIngestBackend:
+    """Buffer + batch + flush loop.
+
+    - ``write(entry)`` never blocks the caller: entries append to a bounded
+      deque (oldest dropped past ``max_buffer``, hub.go's spill behavior).
+    - A flusher thread sends up to ``batch_size`` entries per POST when the
+      batch fills or ``flush_interval`` elapses.
+    - Failures back off exponentially (capped) and the batch is retried;
+      entries are only discarded on success or buffer overflow.
+    """
+
+    def __init__(
+        self,
+        endpoint: str,
+        auth_token: str = "",
+        batch_size: int = 64,
+        flush_interval_s: float = 2.0,
+        max_buffer: int = 4096,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 60.0,
+        timeout_s: float = 10.0,
+    ):
+        self.endpoint = endpoint
+        self.auth_token = auth_token
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval_s
+        self.max_buffer = max_buffer
+        self.backoff_base = backoff_base_s
+        self.backoff_max = backoff_max_s
+        self.timeout = timeout_s
+        self._buf: deque[dict] = deque()
+        self._lock = threading.Lock()
+        self._kick = threading.Event()
+        self._stop = False
+        self._failures = 0
+        self.stats = {"posted": 0, "batches": 0, "failures": 0, "dropped": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="audit-remote-ingest")
+        self._thread.start()
+
+    def write(self, entry: dict) -> None:
+        with self._lock:
+            if len(self._buf) >= self.max_buffer:
+                self._buf.popleft()
+                self.stats["dropped"] += 1
+            self._buf.append(entry)
+            full = len(self._buf) >= self.batch_size
+        if full:
+            self._kick.set()
+
+    def _take_batch(self) -> list[dict]:
+        with self._lock:
+            n = min(len(self._buf), self.batch_size)
+            return [self._buf[i] for i in range(n)]
+
+    def _commit_batch(self, batch: list[dict]) -> None:
+        """Remove exactly the posted entries (by identity): an overflow drop
+        during the in-flight POST shifts the deque head, so popping a count
+        would destroy newer, never-posted entries."""
+        sent = {id(e) for e in batch}
+        with self._lock:
+            while self._buf and id(self._buf[0]) in sent:
+                self._buf.popleft()
+
+    def _post(self, batch: list[dict]) -> None:
+        body = json.dumps({"entries": batch}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+        req = urllib.request.Request(self.endpoint, data=body, headers=headers, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            resp.read()
+
+    def _loop(self) -> None:
+        from ..util.retry import backoff_delay
+
+        while True:
+            if not self._stop:
+                wait = backoff_delay(self._failures, self.backoff_base, self.backoff_max) or self.flush_interval
+                self._kick.wait(timeout=wait)
+                self._kick.clear()
+            batch = self._take_batch()
+            if not batch:
+                if self._stop:
+                    return
+                continue
+            try:
+                self._post(batch)
+            except Exception as e:  # noqa: BLE001
+                self._failures += 1
+                self.stats["failures"] += 1
+                log.warning("audit ingest POST failed (%s); will retry (failure #%d)", e, self._failures)
+                if self._stop:
+                    # shutting down against a dead endpoint: don't spin
+                    return
+                continue
+            self._failures = 0
+            self._commit_batch(batch)
+            self.stats["batches"] += 1
+            self.stats["posted"] += len(batch)
+            # when stopping, keep draining back-to-back (no interval wait)
+
+    def flush(self) -> None:
+        self._kick.set()
+
+    def close(self) -> None:
+        self._stop = True
+        self._kick.set()
+        self._thread.join(timeout=10)
+
+
+register_backend("remote", lambda conf: RemoteIngestBackend(
+    endpoint=conf["endpoint"],
+    auth_token=conf.get("authToken", ""),
+    batch_size=int(conf.get("batchSize", 64)),
+    flush_interval_s=float(conf.get("flushIntervalSeconds", 2.0)),
+    max_buffer=int(conf.get("maxBuffer", 4096)),
+))

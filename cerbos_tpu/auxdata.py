@@ -27,11 +27,89 @@ def _b64url(data: str) -> bytes:
     return base64.urlsafe_b64decode(data + "=" * (-len(data) % 4))
 
 
+class RemoteJWKS:
+    """JWKS fetched over HTTP(S) with time-based refresh and keep-cached-on-
+    failure (ref: jwt.go:40-242 — jwk.Cache with RefreshInterval; a fetch
+    error keeps serving the last good keyset).
+
+    Forced refreshes (signature miss → maybe the signer rotated) are rate-
+    limited by ``min_refresh_interval_s``, so a flood of garbage-signature
+    tokens cannot hammer the JWKS endpoint (jwk.Cache's refresh-on-miss
+    throttle). The HTTP fetch happens OUTSIDE the key lock — concurrent
+    verifications keep using the cached keys while one thread refreshes."""
+
+    def __init__(
+        self,
+        url: str,
+        refresh_interval_s: float = 3600.0,
+        timeout_s: float = 10.0,
+        min_refresh_interval_s: float = 15.0,
+    ):
+        import threading
+
+        self.url = url
+        self.refresh_interval = refresh_interval_s
+        self.min_refresh_interval = min_refresh_interval_s
+        self.timeout = timeout_s
+        self._keys: list[Any] = []
+        self._fetched_at = 0.0
+        self._attempted_at = 0.0
+        self._lock = threading.Lock()
+        self._fetching = False
+        self.stats = {"fetches": 0, "failures": 0, "throttled": 0}
+
+    def keys(self, force: bool = False) -> list[Any]:
+        now = time.time()
+        with self._lock:
+            stale = now - self._fetched_at >= self.refresh_interval
+            throttled = now - self._attempted_at < self.min_refresh_interval
+            need = (not self._keys) or stale or force
+            if not need or (throttled and self._keys):
+                if need and throttled:
+                    self.stats["throttled"] += 1
+                return list(self._keys)
+            if self._fetching:
+                # another thread is refreshing: serve what we have (or fail
+                # if nothing cached yet)
+                if self._keys:
+                    return list(self._keys)
+            self._fetching = True
+            self._attempted_at = now
+        try:
+            fetched = self._fetch()
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self._fetching = False
+                self.stats["failures"] += 1
+                if not self._keys:
+                    raise JWTError(f"remote JWKS fetch failed and no cached keys: {e}") from e
+                return list(self._keys)  # keep serving cached
+        with self._lock:
+            self._fetching = False
+            self._keys = fetched
+            self._fetched_at = time.time()
+            self.stats["fetches"] += 1
+            return list(self._keys)
+
+    def _fetch(self) -> list[Any]:
+        import urllib.request
+
+        with urllib.request.urlopen(self.url, timeout=self.timeout) as resp:
+            data = json.loads(resp.read())
+        return _load_jwks(data)
+
+
 @dataclass
 class KeySet:
     id: str
     keys: list[Any] = field(default_factory=list)  # public key objects or (b"secret", alg)
     insecure_no_verification: bool = False
+    remote: Optional[RemoteJWKS] = None
+
+    def current_keys(self, force_refresh: bool = False) -> list[Any]:
+        if self.remote is not None:
+            return self.remote.keys(force=force_refresh)
+        return self.keys
 
 
 def _load_jwks(data: dict) -> list[Any]:
@@ -62,6 +140,14 @@ def load_keyset(conf: dict) -> KeySet:
     if conf.get("insecure", {}).get("disableVerification") or conf.get("disableVerification"):
         ks.insecure_no_verification = True
         return ks
+    remote = conf.get("remote", {})
+    if remote.get("url"):
+        ks.remote = RemoteJWKS(
+            url=remote["url"],
+            refresh_interval_s=float(remote.get("refreshInterval", 3600.0)),
+            min_refresh_interval_s=float(remote.get("minRefreshInterval", 15.0)),
+        )
+        return ks
     local = conf.get("local", {})
     raw: Optional[bytes] = None
     if local.get("file"):
@@ -70,7 +156,7 @@ def load_keyset(conf: dict) -> KeySet:
     elif local.get("data"):
         raw = base64.b64decode(local["data"])
     if raw is None:
-        raise JWTError(f"keyset {ks.id!r} has no local key material (remote fetch requires egress)")
+        raise JWTError(f"keyset {ks.id!r} has neither local key material nor a remote JWKS url")
     text = raw.decode("utf-8", errors="ignore").strip()
     if text.startswith("{"):
         ks.keys = _load_jwks(json.loads(text))
@@ -160,7 +246,17 @@ class AuxDataManager:
             if alg not in ("RS256", "RS384", "RS512", "ES256", "ES384", "HS256", "HS384", "HS512"):
                 raise JWTError(f"unsupported JWT algorithm {alg!r}")
             signing_input = f"{parts[0]}.{parts[1]}".encode("ascii")
-            if not any(_verify_signature(alg, key, signing_input, sig) for key in ks.keys):
+            verified = any(
+                _verify_signature(alg, key, signing_input, sig) for key in ks.current_keys()
+            )
+            if not verified and ks.remote is not None:
+                # the signer may have rotated since the last fetch: refresh
+                # once and retry (jwk.Cache's refresh-on-miss behavior)
+                verified = any(
+                    _verify_signature(alg, key, signing_input, sig)
+                    for key in ks.current_keys(force_refresh=True)
+                )
+            if not verified:
                 raise JWTError("JWT signature verification failed")
             now = time.time()
             if "exp" in payload and now > float(payload["exp"]):

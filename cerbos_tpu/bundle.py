@@ -12,18 +12,17 @@ Two payload versions:
   parse, import/variable resolution) — the analogue of the reference's
   serialized rule table. Loading it skips the parse+compile pipeline
   entirely: at the 900-doc classic corpus cold start drops ~2.0s → ~0.06s,
-  at 8k docs ~12.6s → ~0.5s.
+  at 8k docs ~12.6s → ~0.8s.
 
-Trust model: the IR is a pickle, so deserializing it is code execution.
-The in-archive sha256 only detects corruption, not tampering — an attacker
-who controls the archive controls the checksum too. The loader therefore
-ignores ``compiled.bin`` unless the operator either (a) passes
-``trust_compiled=True`` (config ``bundle.trustCompiled``) asserting the
-artifact came from their own ``compilestore`` run, or (b) configures a
-``signing_key`` (config ``bundle.signingKey``) whose HMAC-SHA256 over the
-blob matches the detached signature recorded at build time (the encrypted
-hub-bundle analogue, storage/hub/ruletable_bundle.go:35). On any mismatch
-the bundled sources recompile instead — never less safe, only slower.
+The compiled IR is a structured, versioned encoding
+(``cerbos_tpu.bundle_codec``: tagged JSON over a closed node vocabulary) —
+decoding is pure dataclass construction with NO code execution, so bundles
+are safe to load from untrusted sources, exactly like the reference's
+marshaled proto (index/marshal.go:20,240). An optional ``signing_key``
+(config ``bundle.signingKey``) still provides supply-chain authenticity via
+detached HMAC-SHA256 (the encrypted hub-bundle analogue,
+storage/hub/ruletable_bundle.go:35): when configured, an IR whose signature
+does not verify is ignored and the bundled sources recompile instead.
 """
 
 from __future__ import annotations
@@ -34,7 +33,6 @@ import hmac
 import io
 import json
 import os
-import pickle
 import tarfile
 import time
 from dataclasses import dataclass, field
@@ -42,6 +40,7 @@ from typing import Optional
 
 import yaml
 
+from .bundle_codec import CodecError, decode_compiled, encode_compiled
 from .policy import model
 from .policy.parser import parse_policies
 from .storage.store import Store, register_driver
@@ -49,7 +48,7 @@ from .storage.store import Store, register_driver
 BUNDLE_VERSION = 2
 # bump when the compiled-IR shape changes; mismatched IR is ignored and the
 # bundled sources recompile instead (ruletable.go:935-970's migration analogue)
-COMPILER_VERSION = "cerbos-tpu-ir-1"
+COMPILER_VERSION = "cerbos-tpu-ir-2"
 MANIFEST_NAME = "manifest.json"
 COMPILED_NAME = "compiled.bin"
 
@@ -99,7 +98,7 @@ def build_bundle(
         from .compile import compile_policy_set
 
         compiled = compile_policy_set(policies)
-        compiled_blob = pickle.dumps(compiled, protocol=5)
+        compiled_blob = encode_compiled(compiled)
 
     manifest = BundleManifest(
         version=BUNDLE_VERSION,
@@ -154,12 +153,10 @@ class BundleStore(Store):
         self,
         path: str,
         verify_checksum: bool = True,
-        trust_compiled: bool = False,
         signing_key: Optional[bytes] = None,
     ):
         super().__init__()
         self.path = path
-        self.trust_compiled = trust_compiled
         self.signing_key = signing_key
         self._policies: dict[str, model.Policy] = {}
         self._schemas: dict[str, bytes] = {}
@@ -203,23 +200,24 @@ class BundleStore(Store):
                     self._policies[pol.fqn()] = pol
             elif name.startswith("_schemas/"):
                 self._schemas[name[len("_schemas/"):]] = content
-        # compiled IR: only deserialized when trusted (see module docstring's
-        # trust model) AND integrity + compiler-version checks pass; on any
-        # mismatch the bundled sources above simply recompile (migration
-        # analogue of ruletable.go:935-970)
-        trusted = self.trust_compiled
-        if not trusted and self.signing_key and compiled_blob is not None:
+        # compiled IR: structured decode (no code execution — safe for
+        # untrusted bundles). Gates: integrity checksum, compiler version
+        # (migration analogue of ruletable.go:935-970), and — when a signing
+        # key is configured — HMAC authenticity. On any mismatch the bundled
+        # sources above simply recompile.
+        authentic = True
+        if self.signing_key and compiled_blob is not None:
             want = hmac.new(self.signing_key, compiled_blob, hashlib.sha256).hexdigest()
-            trusted = hmac.compare_digest(want, self.manifest.compiled_signature or "")
+            authentic = hmac.compare_digest(want, self.manifest.compiled_signature or "")
         if (
-            trusted
+            authentic
             and compiled_blob is not None
             and self.manifest.compiler_version == COMPILER_VERSION
             and hashlib.sha256(compiled_blob).hexdigest() == self.manifest.compiled_checksum
         ):
             try:
-                self._compiled = pickle.loads(compiled_blob)
-            except Exception:  # noqa: BLE001  (shape drift: fall back to sources)
+                self._compiled = decode_compiled(compiled_blob)
+            except CodecError:  # shape drift: fall back to sources
                 self._compiled = None
 
     def get_compiled(self) -> Optional[list]:
@@ -243,6 +241,5 @@ class BundleStore(Store):
 register_driver("bundle", lambda conf: BundleStore(
     path=conf.get("path", "bundle.crbp"),
     verify_checksum=bool(conf.get("verifyChecksum", True)),
-    trust_compiled=bool(conf.get("trustCompiled", False)),
     signing_key=conf["signingKey"].encode() if conf.get("signingKey") else None,
 ))

@@ -1,0 +1,459 @@
+"""Structured, versioned serialization of the compiled policy IR.
+
+Replaces the pickle payload of v2 bundles: the encoding is pure data (JSON
+with tagged nodes + a structural intern table), so decoding untrusted
+bundles is safe — no code execution, only dataclass construction from a
+closed vocabulary. This is the analogue of the reference's marshaled
+rule-table proto (internal/ruletable/index/marshal.go:20,240), which is
+likewise safe to load from anywhere.
+
+Layout: ``{"v": 1, "nodes": [...], "policies": [...]}`` where ``nodes`` is
+a flat table of unique encoded objects (CEL AST nodes, conditions, exprs,
+variables, outputs, params) referenced by index. Structural sharing does
+double duty: identical conditions across policies (the common case — policy
+fleets repeat templates) encode once, and ``PolicyParams`` object identity
+— which downstream caches key on (``params.cache_key``) — survives the
+round trip because each table entry decodes to exactly one object.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Optional
+
+from .cel import ast as A
+from .compile.compiler import (
+    CompiledCondition,
+    CompiledDerivedRole,
+    CompiledExpr,
+    CompiledOutput,
+    CompiledPolicy,
+    CompiledPrincipalPolicy,
+    CompiledPrincipalRule,
+    CompiledResourcePolicy,
+    CompiledResourceRule,
+    CompiledRolePolicy,
+    CompiledRoleRule,
+    CompiledVariable,
+    PolicyParams,
+)
+from .policy import model
+
+CODEC_VERSION = 1
+
+
+class CodecError(ValueError):
+    pass
+
+
+# -- values (Lit payloads, constants, source attributes) ----------------------
+
+
+def _enc_value(v: Any) -> Any:
+    """JSON-safe value encoding preserving the distinctions JSON collapses:
+    bytes, non-string map keys, int-vs-float (JSON already keeps), tuples."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, bytes):
+        return {"$B": base64.b64encode(v).decode()}
+    if isinstance(v, (list, tuple)):
+        return {"$L": [_enc_value(x) for x in v]}
+    if isinstance(v, (set, frozenset)):
+        return {"$S": [_enc_value(x) for x in sorted(v, key=repr)]}
+    if isinstance(v, dict):
+        return {"$M": [[_enc_value(k), _enc_value(x)] for k, x in v.items()]}
+    raise CodecError(f"unencodable value type {type(v).__name__}")
+
+
+def _dec_value(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        if "$B" in v:
+            return base64.b64decode(v["$B"])
+        if "$L" in v:
+            return [_dec_value(x) for x in v["$L"]]
+        if "$S" in v:
+            return frozenset(_dec_value(x) for x in v["$S"])
+        if "$M" in v:
+            return {_dec_value(k): _dec_value(x) for k, x in v["$M"]}
+    raise CodecError(f"malformed value payload: {v!r}")
+
+
+# -- intern-table encoder -----------------------------------------------------
+
+
+class _Encoder:
+    def __init__(self) -> None:
+        self.nodes: list[Any] = []
+        self._by_id: dict[int, int] = {}  # id(obj) -> index (identity fast path)
+        self._by_key: dict[Any, int] = {}  # structural key -> index
+
+    def _put(self, obj: Any, key: Any, encoded: Any) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(encoded)
+        self._by_id[id(obj)] = idx
+        if key is not None:
+            self._by_key[key] = idx
+        return idx
+
+    def ref(self, obj: Any) -> Optional[int]:
+        if obj is None:
+            return None
+        hit = self._by_id.get(id(obj))
+        if hit is not None:
+            return hit
+        if isinstance(obj, A.Node):
+            return self._node(obj)
+        if isinstance(obj, CompiledExpr):
+            key = ("E", obj.original, self._node(obj.node))
+            hit = self._by_key.get(key)
+            if hit is not None:
+                self._by_id[id(obj)] = hit
+                return hit
+            return self._put(obj, key, ["E", obj.original, self._node(obj.node)])
+        if isinstance(obj, CompiledCondition):
+            enc = [
+                "C",
+                obj.kind,
+                self.ref(obj.expr),
+                [self.ref(c) for c in obj.children],
+            ]
+            key = ("C", json.dumps(enc[1:], sort_keys=False))
+            hit = self._by_key.get(key)
+            if hit is not None:
+                self._by_id[id(obj)] = hit
+                return hit
+            return self._put(obj, key, enc)
+        if isinstance(obj, CompiledVariable):
+            enc = ["V", obj.name, self.ref(obj.expr)]
+            key = ("V", obj.name, enc[2])
+            hit = self._by_key.get(key)
+            if hit is not None:
+                self._by_id[id(obj)] = hit
+                return hit
+            return self._put(obj, key, enc)
+        if isinstance(obj, CompiledOutput):
+            enc = ["O", self.ref(obj.rule_activated), self.ref(obj.condition_not_met)]
+            key = ("O", enc[1], enc[2])
+            hit = self._by_key.get(key)
+            if hit is not None:
+                self._by_id[id(obj)] = hit
+                return hit
+            return self._put(obj, key, enc)
+        if isinstance(obj, PolicyParams):
+            # params are interned by IDENTITY only: the decoder must produce
+            # one object per encoded entry so cache keys keyed on object
+            # identity stay coherent, but two structurally equal params from
+            # different policies remain distinct (as built)
+            enc = [
+                "P",
+                _enc_value(obj.constants),
+                [self.ref(v) for v in obj.ordered_variables],
+            ]
+            return self._put(obj, None, enc)
+        raise CodecError(f"unencodable object {type(obj).__name__}")
+
+    def _node(self, n: A.Node) -> int:
+        hit = self._by_id.get(id(n))
+        if hit is not None:
+            return hit
+        if isinstance(n, A.Lit):
+            enc: list[Any] = ["lit", _enc_value(n.value)]
+        elif isinstance(n, A.Ident):
+            enc = ["id", n.name]
+        elif isinstance(n, A.Select):
+            enc = ["sel", self._node(n.operand), n.field]
+        elif isinstance(n, A.Present):
+            enc = ["has", self._node(n.operand), n.field]
+        elif isinstance(n, A.Index):
+            enc = ["ix", self._node(n.operand), self._node(n.index)]
+        elif isinstance(n, A.Call):
+            enc = [
+                "call",
+                n.fn,
+                [self._node(a) for a in n.args],
+                self._node(n.target) if n.target is not None else None,
+            ]
+        elif isinstance(n, A.ListLit):
+            enc = ["list", [self._node(a) for a in n.items]]
+        elif isinstance(n, A.MapLit):
+            enc = ["map", [[self._node(k), self._node(v)] for k, v in n.entries]]
+        elif isinstance(n, A.Bind):
+            enc = ["bind", n.name, self._node(n.init), self._node(n.body)]
+        elif isinstance(n, A.Comprehension):
+            enc = [
+                "comp",
+                n.kind,
+                self._node(n.iter_range),
+                n.iter_var,
+                self._node(n.step),
+                n.iter_var2,
+                self._node(n.step2) if n.step2 is not None else None,
+            ]
+        else:
+            raise CodecError(f"unencodable AST node {type(n).__name__}")
+        key = json.dumps(enc, sort_keys=False, default=_json_default)
+        hit = self._by_key.get(key)
+        if hit is not None:
+            self._by_id[id(n)] = hit
+            return hit
+        return self._put(n, key, enc)
+
+
+def _json_default(o: Any) -> Any:
+    raise CodecError(f"unencodable literal {type(o).__name__}")
+
+
+def _enc_schemas(s: Optional[model.Schemas]) -> Any:
+    if s is None:
+        return None
+
+    def ref(r: Optional[model.SchemaRef]) -> Any:
+        if r is None:
+            return None
+        return [r.ref, list(r.ignore_when_actions)]
+
+    return [ref(s.principal_schema), ref(s.resource_schema)]
+
+
+def _dec_schemas(v: Any) -> Optional[model.Schemas]:
+    if v is None:
+        return None
+
+    def ref(r: Any) -> Optional[model.SchemaRef]:
+        if r is None:
+            return None
+        return model.SchemaRef(ref=r[0], ignore_when_actions=list(r[1]))
+
+    return model.Schemas(principal_schema=ref(v[0]), resource_schema=ref(v[1]))
+
+
+def encode_compiled(policies: list[CompiledPolicy]) -> bytes:
+    enc = _Encoder()
+    out: list[Any] = []
+    for p in policies:
+        if isinstance(p, CompiledResourcePolicy):
+            out.append({
+                "k": "R",
+                "fqn": p.fqn,
+                "res": p.resource,
+                "raw": p.raw_resource,
+                "ver": p.version,
+                "sc": p.scope,
+                "sp": p.scope_permissions,
+                "par": enc.ref(p.params),
+                "rules": [
+                    [
+                        list(r.actions), list(r.roles), list(r.derived_roles),
+                        r.effect, r.name, enc.ref(r.condition), enc.ref(r.output),
+                    ]
+                    for r in p.rules
+                ],
+                "dr": [
+                    [
+                        name, sorted(dr.parent_roles), enc.ref(dr.condition),
+                        enc.ref(dr.params), dr.origin_fqn,
+                    ]
+                    for name, dr in p.derived_roles.items()
+                ],
+                "schemas": _enc_schemas(p.schemas),
+                "src": _enc_value(p.source_attributes),
+                "ann": dict(p.annotations),
+            })
+        elif isinstance(p, CompiledPrincipalPolicy):
+            out.append({
+                "k": "P",
+                "fqn": p.fqn,
+                "pr": p.principal,
+                "ver": p.version,
+                "sc": p.scope,
+                "sp": p.scope_permissions,
+                "par": enc.ref(p.params),
+                "rules": [
+                    [r.resource, r.action, r.effect, r.name, enc.ref(r.condition), enc.ref(r.output)]
+                    for r in p.rules
+                ],
+                "src": _enc_value(p.source_attributes),
+                "ann": dict(p.annotations),
+            })
+        elif isinstance(p, CompiledRolePolicy):
+            out.append({
+                "k": "L",
+                "fqn": p.fqn,
+                "role": p.role,
+                "ver": p.version,
+                "sc": p.scope,
+                "pp": list(p.parent_roles),
+                "par": enc.ref(p.params),
+                "rules": [
+                    [r.resource, sorted(r.allow_actions), r.name, enc.ref(r.condition), enc.ref(r.output)]
+                    for r in p.rules
+                ],
+                "src": _enc_value(p.source_attributes),
+                "ann": dict(p.annotations),
+            })
+        else:
+            raise CodecError(f"unknown policy type {type(p).__name__}")
+    doc = {"v": CODEC_VERSION, "nodes": enc.nodes, "policies": out}
+    return json.dumps(doc, separators=(",", ":"), default=_json_default).encode()
+
+
+# -- decoder ------------------------------------------------------------------
+
+
+class _Decoder:
+    def __init__(self, nodes: list[Any]) -> None:
+        self.raw = nodes
+        self.cache: list[Any] = [None] * len(nodes)
+        self.done: list[bool] = [False] * len(nodes)
+
+    def ref(self, idx: Optional[int]) -> Any:
+        if idx is None:
+            return None
+        if not isinstance(idx, int) or not (0 <= idx < len(self.raw)):
+            raise CodecError(f"bad node ref {idx!r}")
+        if self.done[idx]:
+            return self.cache[idx]
+        e = self.raw[idx]
+        tag = e[0]
+        if tag == "lit":
+            obj: Any = A.Lit(_dec_lit(e[1]))
+        elif tag == "id":
+            obj = A.Ident(e[1])
+        elif tag == "sel":
+            obj = A.Select(self.ref(e[1]), e[2])
+        elif tag == "has":
+            obj = A.Present(self.ref(e[1]), e[2])
+        elif tag == "ix":
+            obj = A.Index(self.ref(e[1]), self.ref(e[2]))
+        elif tag == "call":
+            obj = A.Call(e[1], tuple(self.ref(a) for a in e[2]),
+                         self.ref(e[3]) if e[3] is not None else None)
+        elif tag == "list":
+            obj = A.ListLit(tuple(self.ref(a) for a in e[1]))
+        elif tag == "map":
+            obj = A.MapLit(tuple((self.ref(k), self.ref(v)) for k, v in e[1]))
+        elif tag == "bind":
+            obj = A.Bind(e[1], self.ref(e[2]), self.ref(e[3]))
+        elif tag == "comp":
+            obj = A.Comprehension(e[1], self.ref(e[2]), e[3], self.ref(e[4]),
+                                  e[5], self.ref(e[6]) if e[6] is not None else None)
+        elif tag == "E":
+            obj = CompiledExpr(original=e[1], node=self.ref(e[2]))
+        elif tag == "C":
+            obj = CompiledCondition(kind=e[1], expr=self.ref(e[2]),
+                                    children=tuple(self.ref(c) for c in e[3]))
+        elif tag == "V":
+            obj = CompiledVariable(name=e[1], expr=self.ref(e[2]))
+        elif tag == "O":
+            obj = CompiledOutput(rule_activated=self.ref(e[1]), condition_not_met=self.ref(e[2]))
+        elif tag == "P":
+            obj = PolicyParams(constants=_dec_value(e[1]),
+                               ordered_variables=tuple(self.ref(v) for v in e[2]))
+        else:
+            raise CodecError(f"unknown node tag {tag!r}")
+        self.cache[idx] = obj
+        self.done[idx] = True
+        return obj
+
+
+def _dec_lit(v: Any) -> Any:
+    x = _dec_value(v)
+    # Lit list payloads decode as lists (parser emits only scalars, but a
+    # constant-folded literal could carry a container)
+    if isinstance(x, frozenset):
+        return x
+    return x
+
+
+def decode_compiled(blob: bytes) -> list[CompiledPolicy]:
+    """Decode; ANY structural malformation raises CodecError (never an
+    arbitrary exception) so untrusted bundles degrade to source recompile
+    instead of crashing the loader."""
+    try:
+        return _decode_compiled(blob)
+    except CodecError:
+        raise
+    except (KeyError, IndexError, TypeError, ValueError, AttributeError, RecursionError) as e:
+        raise CodecError(f"malformed bundle IR: {type(e).__name__}: {e}") from e
+
+
+def _decode_compiled(blob: bytes) -> list[CompiledPolicy]:
+    try:
+        doc = json.loads(blob)
+    except json.JSONDecodeError as e:
+        raise CodecError(f"malformed bundle IR: {e}") from e
+    if not isinstance(doc, dict) or doc.get("v") != CODEC_VERSION:
+        raise CodecError(f"unsupported IR codec version {doc.get('v') if isinstance(doc, dict) else None!r}")
+    dec = _Decoder(doc.get("nodes", []))
+    out: list[CompiledPolicy] = []
+    for p in doc.get("policies", []):
+        kind = p.get("k")
+        if kind == "R":
+            out.append(CompiledResourcePolicy(
+                fqn=p["fqn"],
+                resource=p["res"],
+                raw_resource=p["raw"],
+                version=p["ver"],
+                scope=p["sc"],
+                scope_permissions=p["sp"],
+                params=dec.ref(p["par"]),
+                rules=[
+                    CompiledResourceRule(
+                        actions=tuple(r[0]), roles=tuple(r[1]), derived_roles=tuple(r[2]),
+                        effect=r[3], name=r[4], condition=dec.ref(r[5]), output=dec.ref(r[6]),
+                    )
+                    for r in p["rules"]
+                ],
+                derived_roles={
+                    d[0]: CompiledDerivedRole(
+                        name=d[0], parent_roles=frozenset(d[1]), condition=dec.ref(d[2]),
+                        params=dec.ref(d[3]), origin_fqn=d[4],
+                    )
+                    for d in p["dr"]
+                },
+                schemas=_dec_schemas(p.get("schemas")),
+                source_attributes=_dec_value(p.get("src", {"$M": []})),
+                annotations=dict(p.get("ann", {})),
+            ))
+        elif kind == "P":
+            out.append(CompiledPrincipalPolicy(
+                fqn=p["fqn"],
+                principal=p["pr"],
+                version=p["ver"],
+                scope=p["sc"],
+                scope_permissions=p["sp"],
+                params=dec.ref(p["par"]),
+                rules=[
+                    CompiledPrincipalRule(
+                        resource=r[0], action=r[1], effect=r[2], name=r[3],
+                        condition=dec.ref(r[4]), output=dec.ref(r[5]),
+                    )
+                    for r in p["rules"]
+                ],
+                source_attributes=_dec_value(p.get("src", {"$M": []})),
+                annotations=dict(p.get("ann", {})),
+            ))
+        elif kind == "L":
+            out.append(CompiledRolePolicy(
+                fqn=p["fqn"],
+                role=p["role"],
+                version=p["ver"],
+                scope=p["sc"],
+                parent_roles=tuple(p["pp"]),
+                params=dec.ref(p["par"]),
+                rules=[
+                    CompiledRoleRule(
+                        resource=r[0], allow_actions=frozenset(r[1]), name=r[2],
+                        condition=dec.ref(r[3]), output=dec.ref(r[4]),
+                    )
+                    for r in p["rules"]
+                ],
+                source_attributes=_dec_value(p.get("src", {"$M": []})),
+                annotations=dict(p.get("ann", {})),
+            ))
+        else:
+            raise CodecError(f"unknown policy kind {kind!r}")
+    return out

@@ -275,7 +275,7 @@ def main(argv: list[str] | None = None) -> int:
     p_cs = sub.add_parser("compilestore", help="build a pre-compiled policy bundle")
     p_cs.add_argument("dir", help="policy directory")
     p_cs.add_argument("--output", "-o", default="bundle.crbp")
-    p_cs.add_argument("--sign-key", help="HMAC key file; lets loaders verify the compiled IR without trustCompiled")
+    p_cs.add_argument("--sign-key", help="HMAC key file recording a detached IR signature (supply-chain authenticity; the IR decode itself is safe for untrusted bundles)")
     p_cs.set_defaults(fn=cmd_compilestore)
 
     p_hc = sub.add_parser("healthcheck", help="probe a running PDP")

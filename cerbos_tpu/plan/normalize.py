@@ -134,7 +134,11 @@ def normalise_filter(kind: str, condition: Optional[Operand]) -> tuple[str, Opti
 
 
 def merge_with_and(filters: list[tuple[str, Optional[Operand]]]) -> tuple[str, Optional[Operand]]:
-    """merge.go MergeWithAnd: per-action filters → one filter."""
+    """merge.go MergeWithAnd: per-action filters → one filter.
+
+    Dedup/sort key is the filter debug string (`Operand.debug_str`), the
+    analogue of the reference's FilterToString key, so merged multi-action
+    AND operands come out in the same order the reference renders."""
     conds: dict[str, Operand] = {}
     for kind, cond in filters:
         if kind == KIND_ALWAYS_ALLOWED:
@@ -142,7 +146,7 @@ def merge_with_and(filters: list[tuple[str, Optional[Operand]]]) -> tuple[str, O
         if kind == KIND_ALWAYS_DENIED:
             return KIND_ALWAYS_DENIED, None
         assert cond is not None
-        conds[_canon(cond)] = cond
+        conds[cond.debug_str()] = cond
     if not conds:
         return KIND_ALWAYS_ALLOWED, None
     if len(conds) == 1:

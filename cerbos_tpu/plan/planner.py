@@ -141,9 +141,11 @@ class Planner:
 
         action_filters: list[tuple[str, Optional[Any]]] = []
         dr_lists: dict[str, Any] = {}  # scope → derived-roles list, shared across actions
+        effective_policies: dict[str, dict] = {}
         for action in dict.fromkeys(input.actions):
             node, matched_scope = self._plan_action(
-                pe, input, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes, dr_lists
+                pe, input, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes, dr_lists,
+                effective_policies,
             )
             if node is TRUE:
                 action_filters.append((KIND_ALWAYS_ALLOWED, None))
@@ -154,6 +156,9 @@ class Planner:
             output.matched_scopes[action] = matched_scope
 
         output.kind, output.condition = merge_with_and(action_filters)
+        output.effective_policies = {
+            namer.policy_key_from_fqn(f): attrs for f, attrs in effective_policies.items()
+        }
         return output
 
     def _partial_evaluator(self, input: PlanInput, params: T.EvalParams):
@@ -180,7 +185,8 @@ class Planner:
         return make
 
     def _plan_action(
-        self, pe_factory, input: PlanInput, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes, dr_lists
+        self, pe_factory, input: PlanInput, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes, dr_lists,
+        effective_policies: Optional[dict] = None,
     ) -> tuple[Any, str]:
         """One action → TRUE/FALSE/residual node.
 
@@ -298,6 +304,12 @@ class Planner:
                     pid = input.principal.id if pt == KIND_PRINCIPAL else ""
                     rows = rt.idx.query(resource_version, sanitized, scope, action, parent_roles, pt, pid)
                     for b in rows:
+                        if effective_policies is not None:
+                            # every QUERIED binding's policy chain lands in the
+                            # audit trail, matching plan.go's
+                            # maps.Copy(effectivePolicies, GetSourceAttributes())
+                            for f, attrs in rt.get_chain_source_attributes(b.origin_fqn).items():
+                                effective_policies.setdefault(f, dict(attrs))
                         pe = self._pe_for(pe_factory, known, b.params, drl)
                         node = self._cond_node(pe, b.condition)
                         if b.derived_role_condition is not None:

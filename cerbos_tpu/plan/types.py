@@ -90,6 +90,9 @@ class PlanOutput:
     matched_scopes: dict[str, str] = field(default_factory=dict)
     validation_errors: list[T.ValidationError] = field(default_factory=list)
     include_meta: bool = False
+    # policy key -> source attributes for every queried binding's chain
+    # (plan.go: effectivePolicies in the audit trail)
+    effective_policies: dict[str, dict] = field(default_factory=dict)
 
     def to_json(self, call_id: str = "") -> dict:
         filter_j: dict[str, Any] = {"kind": self.kind}

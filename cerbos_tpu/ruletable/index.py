@@ -222,10 +222,10 @@ class Index:
         if not v or not k:
             res = False
         else:
-            s: set[int] = set()
-            for sc in scopes:
-                s |= self.scope.get(sc, set())
-            res = bool(v & k & s)
+            vk = k & v if len(k) < len(v) else v & k
+            res = bool(vk) and any(
+                not vk.isdisjoint(self.scope.get(sc, ())) for sc in scopes
+            )
         if len(self._exists_cache) > 65536:
             self._exists_cache.clear()
         self._exists_cache[key] = res
@@ -249,13 +249,15 @@ class Index:
         k = self.policy_kind.get(KIND_RESOURCE)
         if not v or not k:
             return False
-        s: set[int] = set()
-        for sc in scopes:
-            s |= self.scope.get(sc, set())
-        if not s:
-            return False
+        # start from the (small) per-kind row set and early-exit per scope
+        # instead of unioning every scope's (large) row set
         r = self.resource.query(resource)
-        return bool(v & k & s & r)
+        if not r:
+            return False
+        rvk = r & v & k
+        if not rvk:
+            return False
+        return any(not rvk.isdisjoint(self.scope.get(sc, ())) for sc in scopes)
 
     def query(
         self,
@@ -332,6 +334,10 @@ class Index:
         if principal_ids is not None:
             dims.append(principal_ids)
 
+        # intersect smallest-first: the scope/version dims hold most of the
+        # table, while resource/role dims are a handful of rows per kind —
+        # starting small makes a cold query O(rows-per-kind), not O(table)
+        dims.sort(key=len)
         base = set(dims[0])
         for d in dims[1:]:
             base &= d

@@ -17,6 +17,22 @@ from typing import Any, Optional
 from aiohttp import web
 
 
+def _parse_policy_key(key: str) -> tuple[str, str, str]:
+    """Split a policy key ('kind.name.vVERSION[/scope]') into
+    (name, version, scope) components for per-column regexp filtering
+    (ref: internal/storage/db — name/version/scope are separate columns).
+    derived_roles / export_* keys carry no version."""
+    main, _, scope = key.partition("/")
+    parts = main.split(".")
+    kind = parts[0]
+    rest = parts[1:]
+    if kind in ("derived_roles", "export_variables", "export_constants"):
+        return ".".join(rest), "", scope
+    if len(rest) >= 2 and rest[-1].startswith("v"):
+        return ".".join(rest[:-1]), rest[-1][1:], scope
+    return ".".join(rest), "", scope
+
+
 class AdminService:
     def __init__(self, core: Any, username: str = "cerbos", password_hash: str = "", password: str = "cerbosAdmin"):
         self.core = core
@@ -128,12 +144,16 @@ class AdminService:
             keys = [namer.policy_key_from_fqn(i) for i in ids]
             import re as _re
 
+            # each regexp matches its own component (name / version / scope),
+            # mirroring the reference's per-column filters
+            # (internal/storage/db whereExprAndPostFilters), so anchored
+            # patterns like '^leave_request$' behave identically
             if req.name_regexp:
-                keys = [k for k in keys if _re.search(req.name_regexp, k)]
-            if req.scope_regexp:
-                keys = [k for k in keys if _re.search(req.scope_regexp, k.partition("/")[2])]
+                keys = [k for k in keys if _re.search(req.name_regexp, _parse_policy_key(k)[0])]
             if req.version_regexp:
-                keys = [k for k in keys if _re.search(req.version_regexp, k)]
+                keys = [k for k in keys if _re.search(req.version_regexp, _parse_policy_key(k)[1])]
+            if req.scope_regexp:
+                keys = [k for k in keys if _re.search(req.scope_regexp, _parse_policy_key(k)[2])]
             return response_pb2.ListPoliciesResponse(policy_ids=keys)
 
         def get_policy(req: request_pb2.GetPolicyRequest, ctx):

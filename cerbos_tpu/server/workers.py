@@ -39,17 +39,24 @@ def resolve_listen_addr(addr: str) -> str:
     accept distribution — and stays open so the port cannot be claimed by
     an unrelated process between worker restarts.
 
-    ``unix:`` addresses pass through untouched: per-worker SO_REUSEPORT
-    does not apply to unix sockets, so a pooled config should use TCP (a
-    single worker binding the socket path still works).
+    ``unix:`` addresses are rejected: SO_REUSEPORT does not load-balance
+    unix sockets, so a pooled config must use TCP (run workers=1 for a
+    unix-socket listener).
     """
     if addr.startswith("unix:"):
-        return addr
+        raise ValueError(
+            "worker pools need TCP listeners (SO_REUSEPORT does not load-"
+            f"balance unix sockets); got {addr!r} — use host:port or workers=1"
+        )
     host, _, port = addr.rpartition(":")
     host = host or "0.0.0.0"
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if host.startswith("[") and host.endswith("]"):
+        family, bind_host = socket.AF_INET6, host[1:-1]
+    else:
+        family, bind_host = socket.AF_INET, host
+    s = socket.socket(family, socket.SOCK_STREAM)
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-    s.bind((host, int(port)))
+    s.bind((bind_host, int(port)))
     chosen = s.getsockname()[1]
     _reservations.append(s)  # keep alive for the pool's lifetime
     return f"{host}:{chosen}"
@@ -61,13 +68,15 @@ _reservations: list[socket.socket] = []
 class WorkerPool:
     """Fork N serving workers and supervise them.
 
-    ``worker_main(worker_idx)`` runs in each child; it must block until the
-    process receives SIGTERM (the child's own signal handling) and then
-    return for a clean exit. Exceptions exit the child non-zero, triggering
-    a supervised restart.
+    ``worker_main(worker_idx, respawn)`` runs in each child; it must block
+    until the process receives SIGTERM (the child's own signal handling) and
+    then return for a clean exit. Exceptions exit the child non-zero,
+    triggering a supervised restart with ``respawn=True`` — restarted
+    workers must NOT reuse boot-time prebuilt state (policies may have
+    changed since boot; a stale table would diverge from sibling workers).
     """
 
-    def __init__(self, n_workers: int, worker_main: Callable[[int], None], log=None):
+    def __init__(self, n_workers: int, worker_main: Callable[[int, bool], None], log=None):
         self.n = n_workers
         self.worker_main = worker_main
         self.log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
@@ -75,14 +84,14 @@ class WorkerPool:
         self._restarts: dict[int, list[float]] = {}  # idx -> restart stamps
         self._shutdown = False
 
-    def _spawn(self, idx: int) -> None:
+    def _spawn(self, idx: int, respawn: bool = False) -> None:
         pid = os.fork()
         if pid == 0:
             # child: default signal dispositions; worker_main installs its own
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
             signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent fans out SIGTERM
             try:
-                self.worker_main(idx)
+                self.worker_main(idx, respawn)
                 os._exit(0)
             except BaseException as e:  # noqa: BLE001
                 print(f"worker {idx} crashed: {type(e).__name__}: {e}", file=sys.stderr, flush=True)
@@ -133,7 +142,7 @@ class WorkerPool:
                 handle_term(signal.SIGTERM, None)
                 continue
             self.log(f"worker {idx} (pid {pid}) exited {code}; restarting")
-            self._spawn(idx)
+            self._spawn(idx, respawn=True)
         return exit_code
 
 
@@ -151,6 +160,12 @@ def run_server_pool(
     ``build_server(core, config, http_addr, grpc_addr, reuse_port)`` must
     return a started-able Server (cli wires admin/authzen/playground the
     same way for 1 or N workers).
+
+    Cross-worker policy propagation: each worker owns a store; mutations
+    made through one worker's Admin API reach the others via the shared
+    backing medium (disk files / DB rows), so pool mode force-enables the
+    disk store's change watcher — without it, siblings would keep serving
+    the old policy until restart.
     """
     from ..bootstrap import initialize, prebuild
 
@@ -158,9 +173,15 @@ def run_server_pool(
     http_addr = resolve_listen_addr(server_conf.get("httpListenAddr", "0.0.0.0:3592"))
     grpc_addr = resolve_listen_addr(server_conf.get("grpcListenAddr", "0.0.0.0:3593"))
 
+    # section() returns a detached {} when the key is absent; write through
+    # config.data so the workers' new_store calls see the override
+    storage_conf = config.data.setdefault("storage", {})
+    if storage_conf.get("driver", "disk") == "disk":
+        storage_conf.setdefault("disk", {})["watchForChanges"] = True
+
     prebuilt = prebuild(config, use_tpu=use_tpu)
 
-    def worker_main(idx: int) -> None:
+    def worker_main(idx: int, respawn: bool) -> None:
         # install the handler BEFORE the (slow) init so a pool-wide SIGTERM
         # during startup still exits through the graceful path
         stop = {"flag": False}
@@ -171,7 +192,11 @@ def run_server_pool(
         signal.signal(signal.SIGTERM, on_term)
         if post_fork is not None:
             post_fork()
-        core = initialize(config, use_tpu=use_tpu, prebuilt=prebuilt)
+        # a respawned worker rebuilds from the store: the boot-time prebuilt
+        # table may be stale (policies can have changed since the pool came
+        # up, and this worker's fresh store snapshot won't re-emit events
+        # for already-applied changes)
+        core = initialize(config, use_tpu=use_tpu, prebuilt=None if respawn else prebuilt)
         server = build_server(core, config, http_addr, grpc_addr, True)
         try:
             if not stop["flag"]:

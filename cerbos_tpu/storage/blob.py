@@ -1,11 +1,18 @@
 """Blob store: poll an object-store prefix, serve a local clone.
 
 Behavioral reference: internal/storage/blob (S3/GCS/MinIO via gocloud with
-a local clone + poll — blob/cloner.go). This environment has no egress, so
-transports are pluggable: ``file://`` (local directory treated as a bucket,
-matching the reference's e2e fixture pattern) works out of the box; s3/gcs
-transports require the corresponding SDKs and raise a clear error when
-missing.
+a local clone + poll — blob/cloner.go). Transports:
+
+- ``file://`` — local directory treated as a bucket (the reference's e2e
+  fixture pattern).
+- ``s3://bucket`` — real S3 / MinIO / any S3-compatible endpoint via the
+  in-tree minimal REST client (`storage/s3.py`: SigV4 + ListObjectsV2 +
+  GetObject; no SDK). The endpoint comes from ``endpointUrl`` (default
+  AWS's regional endpoint), credentials from config or the standard AWS
+  env vars. Sync = list the prefix, download new/changed keys (ETag diff),
+  delete local files whose keys vanished — cloner.go's clone loop.
+- ``gs://`` / ``azblob://`` — would need their (different) auth protocols;
+  raise a clear error.
 """
 
 from __future__ import annotations
@@ -23,10 +30,36 @@ from .store import Event, Store, register_driver
 class BlobStore(Store):
     driver = "blob"
 
-    def __init__(self, bucket_url: str, work_dir: str, update_poll_interval: float = 60.0):
+    def __init__(
+        self,
+        bucket_url: str,
+        work_dir: str,
+        update_poll_interval: float = 60.0,
+        endpoint_url: str = "",
+        region: str = "us-east-1",
+        prefix: str = "",
+        access_key: Optional[str] = None,
+        secret_key: Optional[str] = None,
+    ):
         super().__init__()
         self.bucket_url = bucket_url
         self.work_dir = os.path.abspath(work_dir)
+        self.prefix = prefix
+        self._s3 = None
+        self._etags: dict[str, str] = {}  # key -> last-synced ETag
+        if bucket_url.startswith("s3://"):
+            from .s3 import S3Client
+
+            bucket = bucket_url[len("s3://"):].strip("/")
+            if not endpoint_url:
+                endpoint_url = f"https://s3.{region}.amazonaws.com"
+            self._s3 = S3Client(
+                bucket=bucket,
+                endpoint_url=endpoint_url,
+                region=region,
+                access_key=access_key,
+                secret_key=secret_key,
+            )
         self._stop = threading.Event()
         self._sync()
         self._disk = DiskStore(self.work_dir, watch_for_changes=False)
@@ -62,13 +95,44 @@ class BlobStore(Store):
                     rel_path = os.path.normpath(os.path.join(rel, f))
                     if rel_path not in seen:
                         os.unlink(os.path.join(root, f))
-        elif self.bucket_url.startswith(("s3://", "gs://", "azblob://")):
+        elif self._s3 is not None:
+            self._sync_s3()
+        elif self.bucket_url.startswith(("gs://", "azblob://")):
             raise RuntimeError(
-                f"blob transport for {self.bucket_url!r} requires the cloud SDK, "
-                "which is not available in this environment; use file:// or the git/disk drivers"
+                f"blob transport for {self.bucket_url!r} is not supported "
+                "(gs/azblob auth protocols need their SDKs); use s3://, file://, "
+                "or the git/disk drivers"
             )
         else:
             raise ValueError(f"unsupported bucket URL {self.bucket_url!r}")
+
+    def _sync_s3(self) -> None:
+        os.makedirs(self.work_dir, exist_ok=True)
+        objects = self._s3.list_objects(self.prefix)
+        seen: set[str] = set()
+        for obj in objects:
+            rel = obj.key[len(self.prefix):].lstrip("/") if self.prefix else obj.key
+            if not rel or rel.endswith("/"):
+                continue
+            rel = os.path.normpath(rel)
+            if rel.startswith("..") or os.path.isabs(rel):
+                continue  # refuse path escapes from hostile listings
+            seen.add(rel)
+            dst = os.path.join(self.work_dir, rel)
+            if self._etags.get(rel) == obj.etag and os.path.exists(dst):
+                continue
+            data = self._s3.get_object(obj.key)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with open(dst, "wb") as f:
+                f.write(data)
+            self._etags[rel] = obj.etag
+        for root, _dirs, files in os.walk(self.work_dir):
+            relroot = os.path.relpath(root, self.work_dir)
+            for f in files:
+                rel_path = os.path.normpath(os.path.join(relroot, f))
+                if rel_path not in seen:
+                    os.unlink(os.path.join(root, f))
+                    self._etags.pop(rel_path, None)
 
     def _poll_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
@@ -106,4 +170,9 @@ register_driver("blob", lambda conf: BlobStore(
     bucket_url=conf.get("bucket", ""),
     work_dir=conf.get("workDir", "/tmp/cerbos-tpu-blob"),
     update_poll_interval=float(conf.get("updatePollInterval", 60.0)),
+    endpoint_url=conf.get("endpointUrl", ""),
+    region=conf.get("region", "us-east-1"),
+    prefix=conf.get("prefix", ""),
+    access_key=conf.get("accessKeyId") or None,
+    secret_key=conf.get("secretAccessKey") or None,
 ))

@@ -1,0 +1,230 @@
+"""Remote bundle source: boot and keep a PDP fed from an HTTPS bundle URL.
+
+Behavioral reference: internal/storage/hub/remote_source.go (1-772) — the
+hub driver downloads a policy bundle, retries with backoff, polls for new
+versions, and KEEPS SERVING the last cached bundle when the remote dies.
+This is the generic-endpoint analogue: plain HTTP(S) with ETag /
+Last-Modified conditional GETs instead of the proprietary hub RPC; the
+mechanism (download → cache → atomic swap → circuit-break to cache) is the
+same. Bundle integrity/authenticity is the BundleStore's own layer
+(checksums + optional HMAC signing key — safe to fetch from untrusted
+transport since the IR decode executes no code).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from .store import EVENT_RELOAD, Event, Store, register_driver
+
+log = logging.getLogger("cerbos_tpu.storage.remote_bundle")
+
+
+class RemoteBundleError(RuntimeError):
+    pass
+
+
+class RemoteBundleStore(Store):
+    """Serve policies from a bundle downloaded over HTTP(S).
+
+    Boot: download the bundle (falling back to the cached copy if the
+    endpoint is unreachable and a cache exists). Then poll with conditional
+    GETs; a changed bundle is written atomically into the cache dir, swapped
+    in, and subscribers get a RELOAD event (the rule-table manager rebuilds
+    and re-lowers device tables). Download failures back off exponentially
+    and never interrupt serving (remote_source.go's keep-serving-cached).
+    """
+
+    driver = "remoteBundle"
+
+    def __init__(
+        self,
+        url: str,
+        cache_dir: Optional[str] = None,
+        poll_interval_s: float = 60.0,
+        signing_key: Optional[bytes] = None,
+        headers: Optional[dict[str, str]] = None,
+        backoff_base_s: float = 2.0,
+        backoff_max_s: float = 300.0,
+        timeout_s: float = 30.0,
+        _start_poll: bool = True,
+    ):
+        super().__init__()
+        self.url = url
+        self.cache_dir = cache_dir or os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "cerbos-tpu", "bundle"
+        )
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self.bundle_path = os.path.join(self.cache_dir, "bundle.crbp")
+        self.etag_path = os.path.join(self.cache_dir, "bundle.etag")
+        self.poll_interval = poll_interval_s
+        self.signing_key = signing_key
+        self.headers = dict(headers or {})
+        self.backoff_base = backoff_base_s
+        self.backoff_max = backoff_max_s
+        self.timeout = timeout_s
+        self._etag: Optional[str] = self._read_etag()
+        self._inner: Optional[Store] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._failures = 0  # consecutive download failures (drives backoff)
+        self.stats = {"downloads": 0, "not_modified": 0, "failures": 0, "served_from_cache_boot": False}
+
+        try:
+            changed = self._download()
+        except Exception as e:  # noqa: BLE001
+            if os.path.exists(self.bundle_path):
+                log.warning("bundle download failed (%s); serving cached bundle", e)
+                self.stats["served_from_cache_boot"] = True
+                changed = False
+            else:
+                raise RemoteBundleError(f"bundle download failed and no cache exists: {e}") from e
+        self._swap_inner()
+        del changed
+
+        self._poll_thread: Optional[threading.Thread] = None
+        if _start_poll and self.poll_interval > 0:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, daemon=True, name="remote-bundle-poll"
+            )
+            self._poll_thread.start()
+
+    # -- transport ---------------------------------------------------------
+
+    def _read_etag(self) -> Optional[str]:
+        try:
+            with open(self.etag_path) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    def _download(self) -> bool:
+        """Conditional GET; returns True when a new bundle was stored."""
+        req = urllib.request.Request(self.url, headers=dict(self.headers))
+        if self._etag and os.path.exists(self.bundle_path):
+            req.add_header("If-None-Match", self._etag)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+                etag = resp.headers.get("ETag")
+        except urllib.error.HTTPError as e:
+            if e.code == 304:
+                self.stats["not_modified"] += 1
+                self._failures = 0
+                return False
+            raise
+        # atomic replace so a reader never sees a torn file
+        fd, tmp = tempfile.mkstemp(dir=self.cache_dir, prefix=".bundle-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self.bundle_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._etag = etag
+        if etag:
+            with open(self.etag_path, "w") as f:
+                f.write(etag)
+        self.stats["downloads"] += 1
+        self._failures = 0
+        return True
+
+    def _swap_inner(self) -> None:
+        from ..bundle import BundleStore
+
+        new_inner = BundleStore(self.bundle_path, signing_key=self.signing_key)
+        with self._lock:
+            self._inner = new_inner
+
+    # -- polling -----------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        from ..util.retry import backoff_delay
+
+        while True:
+            # exponential backoff after failures, normal cadence otherwise
+            delay = backoff_delay(self._failures, self.backoff_base, self.backoff_max) or self.poll_interval
+            if self._stop.wait(delay):
+                return
+            try:
+                if not self._download():
+                    continue
+            except Exception as e:  # noqa: BLE001
+                self._failures += 1
+                self.stats["failures"] += 1
+                log.warning(
+                    "bundle poll failed (%s); keeping current bundle (failure #%d)",
+                    e, self._failures,
+                )
+                continue
+            try:
+                self._swap_inner()
+            except Exception:  # noqa: BLE001 — corrupt download: keep serving
+                self._failures += 1
+                self.stats["failures"] += 1
+                log.exception("downloaded bundle failed to load; keeping current bundle")
+                continue
+            log.info("bundle updated from %s", self.url)
+            self.subscriptions.notify([Event(EVENT_RELOAD)])
+
+    def poll_once(self) -> bool:
+        """Synchronous poll (exposed for tests / cerbosctl store reload)."""
+        try:
+            if not self._download():
+                return False
+            self._swap_inner()
+        except Exception as e:  # noqa: BLE001
+            self._failures += 1
+            self.stats["failures"] += 1
+            log.warning("bundle poll failed (%s); keeping current bundle", e)
+            return False
+        self.subscriptions.notify([Event(EVENT_RELOAD)])
+        return True
+
+    # -- Store surface (delegate to the current bundle) --------------------
+
+    def _store(self) -> Store:
+        with self._lock:
+            assert self._inner is not None
+            return self._inner
+
+    def get_all(self):
+        return self._store().get_all()
+
+    def get(self, fqn: str):
+        return self._store().get(fqn)
+
+    def get_schema(self, schema_id: str):
+        return self._store().get_schema(schema_id)
+
+    def list_schema_ids(self):
+        return self._store().list_schema_ids()
+
+    def get_compiled(self):
+        inner = self._store()
+        fn = getattr(inner, "get_compiled", None)
+        return fn() if fn is not None else None
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+
+
+register_driver("remoteBundle", lambda conf: RemoteBundleStore(
+    url=conf["url"],
+    cache_dir=conf.get("cacheDir"),
+    poll_interval_s=float(conf.get("pollIntervalSeconds", 60.0)),
+    signing_key=conf["signingKey"].encode() if conf.get("signingKey") else None,
+    headers=dict(conf.get("headers", {}) or {}),
+))

@@ -89,7 +89,10 @@ def register_driver(name: str, factory: Callable[[dict], Store]) -> None:
 
 
 # drivers living outside this package register on first use
-_LAZY_DRIVERS = {"bundle": "cerbos_tpu.bundle"}
+_LAZY_DRIVERS = {
+    "bundle": "cerbos_tpu.bundle",
+    "remoteBundle": "cerbos_tpu.storage.remote_bundle",
+}
 
 
 def new_store(conf: dict) -> Store:

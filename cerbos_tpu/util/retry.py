@@ -1,0 +1,16 @@
+"""Shared retry/backoff arithmetic for the remote clients.
+
+One audited implementation used by the remote bundle poller, the remote
+JWKS cache, and the remote audit ingest backend (each mirrors the
+reference's retry-with-backoff + keep-serving-cached pattern,
+storage/hub/remote_source.go / audit/hub/hub.go).
+"""
+
+from __future__ import annotations
+
+
+def backoff_delay(failures: int, base_s: float, cap_s: float) -> float:
+    """Capped exponential backoff: base * 2^(failures-1), 0 when healthy."""
+    if failures <= 0:
+        return 0.0
+    return min(base_s * (2 ** (failures - 1)), cap_s)

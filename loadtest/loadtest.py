@@ -220,6 +220,18 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     proc, http_port, grpc_port = spawn_server(tmp, workers, use_tpu)
     bodies = _make_bodies(n_mods)
 
+    # warmup: every request shape once, before the timed window (the
+    # reference's ghz harness runs a throughput probe before the sustained
+    # measurement, loadtest-classic.md:4-6)
+    warm_reqs = _http_request_bytes(bodies)
+    ws = socket.create_connection(("127.0.0.1", http_port))
+    ws.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    wbuf = bytearray()
+    for req in warm_reqs:
+        ws.sendall(req)
+        _read_http_response(ws, wbuf)
+    ws.close()
+
     latencies: list[float] = []
     counts = [0] * connections
     errors = [0] * connections

@@ -1,0 +1,130 @@
+"""Remote audit ingest: batching, retry/backoff, drop-oldest, auth header."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from cerbos_tpu.audit.remote import RemoteIngestBackend
+
+
+class _IngestServer:
+    def __init__(self):
+        self.batches = []
+        self.fail = False
+        self.auth_headers = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                outer.auth_headers.append(self.headers.get("Authorization"))
+                body = self.rfile.read(int(self.headers.get("Content-Length", "0")))
+                if outer.fail:
+                    self.send_error(503)
+                    return
+                outer.batches.append(json.loads(body)["entries"])
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def ingest():
+    srv = _IngestServer()
+    yield srv
+    srv.stop()
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_batched_flush_and_auth(ingest):
+    be = RemoteIngestBackend(
+        endpoint=f"http://127.0.0.1:{ingest.port}/ingest",
+        auth_token="tok-123",
+        batch_size=4,
+        flush_interval_s=0.2,
+    )
+    for i in range(10):
+        be.write({"callId": f"c{i}", "kind": "decision"})
+    assert _wait(lambda: sum(len(b) for b in ingest.batches) == 10)
+    assert all(len(b) <= 4 for b in ingest.batches)
+    assert ingest.auth_headers[0] == "Bearer tok-123"
+    be.close()
+
+
+def test_retry_after_failure_preserves_entries(ingest):
+    ingest.fail = True
+    be = RemoteIngestBackend(
+        endpoint=f"http://127.0.0.1:{ingest.port}/ingest",
+        batch_size=2,
+        flush_interval_s=0.1,
+        backoff_base_s=0.05,
+        backoff_max_s=0.2,
+    )
+    be.write({"callId": "a"})
+    be.write({"callId": "b"})
+    assert _wait(lambda: be.stats["failures"] >= 2)
+    assert ingest.batches == []  # nothing committed
+    ingest.fail = False
+    assert _wait(lambda: be.stats["posted"] == 2)
+    assert [e["callId"] for e in ingest.batches[0]] == ["a", "b"]  # nothing lost
+    be.close()
+
+
+def test_drop_oldest_past_buffer(ingest):
+    ingest.fail = True
+    be = RemoteIngestBackend(
+        endpoint=f"http://127.0.0.1:{ingest.port}/ingest",
+        batch_size=100,
+        flush_interval_s=5.0,
+        max_buffer=5,
+        backoff_base_s=5.0,
+    )
+    for i in range(8):
+        be.write({"callId": f"c{i}"})
+    assert be.stats["dropped"] == 3
+    with be._lock:
+        kept = [e["callId"] for e in be._buf]
+    assert kept == ["c3", "c4", "c5", "c6", "c7"]
+    be.close()
+
+
+def test_audit_log_integration(ingest):
+    from cerbos_tpu.audit.log import new_audit_log
+    import cerbos_tpu.audit.remote  # noqa: F401  (registers the backend)
+
+    log = new_audit_log(
+        {
+            "enabled": True,
+            "backend": "remote",
+            "remote": {
+                "endpoint": f"http://127.0.0.1:{ingest.port}/ingest",
+                "batchSize": 2,
+                "flushIntervalSeconds": 0.1,
+            },
+        }
+    )
+    assert log is not None
+    log.write_access("call-x", "/cerbos.svc.v1.CerbosService/CheckResources", peer="1.2.3.4")
+    log.close()
+    assert _wait(lambda: sum(len(b) for b in ingest.batches) >= 1)

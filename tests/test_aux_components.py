@@ -87,10 +87,9 @@ class TestBundle:
         manifest = build_bundle(store, out)
         assert manifest.compiled_checksum
 
-        # untrusted by default: the pickled IR must NOT be deserialized
-        assert BundleStore(out).get_compiled() is None
-
-        bstore = BundleStore(out, trust_compiled=True)
+        # the IR is a structured encoding (no code execution), so loading it
+        # from an untrusted bundle is safe and happens by default
+        bstore = BundleStore(out)
         compiled = bstore.get_compiled()
         assert compiled is not None and len(compiled) == 1
 
@@ -109,18 +108,19 @@ class TestBundle:
         out = str(tmp_path / "b.crbp")
         build_bundle(store, out)
         monkeypatch.setattr(bundle_mod, "COMPILER_VERSION", "cerbos-tpu-ir-999")
-        bstore = BundleStore(out, trust_compiled=True)
+        bstore = BundleStore(out)
         assert bstore.get_compiled() is None  # gated out
         assert len(bstore.get_all()) == 1  # sources still serve
 
     def test_signed_bundle(self, policy_dir, tmp_path):
-        """A signing key authenticates the compiled IR without trustCompiled."""
+        """A configured signing key gates the IR on HMAC authenticity; an
+        unsigned load still works (the decode itself is safe)."""
         store = DiskStore(str(policy_dir))
         out = str(tmp_path / "b.crbp")
         build_bundle(store, out, signing_key=b"k1")
         assert BundleStore(out, signing_key=b"k1").get_compiled() is not None
         assert BundleStore(out, signing_key=b"wrong").get_compiled() is None
-        assert BundleStore(out).get_compiled() is None
+        assert BundleStore(out).get_compiled() is not None
 
     def test_source_only_bundle(self, policy_dir, tmp_path):
         store = DiskStore(str(policy_dir))
@@ -436,3 +436,86 @@ class TestOTLPExporter:
             assert child["traceId"] == parent["traceId"]
         finally:
             srv.shutdown()
+
+
+class TestPlanAudit:
+    def test_write_plan_entry_shape(self, policy_dir):
+        """Plan decision entries carry DecisionLogEntry.PlanResources input/
+        output plus auditTrail.effectivePolicies for queried bindings
+        (audit.proto; plan.go effectivePolicies)."""
+        from cerbos_tpu.audit.log import AuditLog
+        from cerbos_tpu.plan import Planner
+        from cerbos_tpu.plan.types import PlanInput
+        from cerbos_tpu.ruletable import build_rule_table
+
+        entries = []
+
+        class Capture:
+            def write(self, entry):
+                entries.append(entry)
+
+        table = build_rule_table(compile_policy_set(DiskStore(str(policy_dir)).get_all()))
+        planner = Planner(table)
+        out = planner.plan(
+            PlanInput(
+                request_id="pr1",
+                actions=["view"],
+                principal=Principal(id="alice", roles=["user"]),
+                resource_kind="doc",
+            )
+        )
+        assert "resource.doc.vdefault" in out.effective_policies
+
+        log = AuditLog(backend=Capture(), decision_logs_enabled=True)
+        log.write_plan("call-1", PlanInput(
+            request_id="pr1",
+            actions=["view"],
+            principal=Principal(id="alice", roles=["user"]),
+            resource_kind="doc",
+        ), out)
+        log.close()
+        assert len(entries) == 1
+        e = entries[0]
+        pr = e["planResources"]
+        assert pr["input"]["principal"]["id"] == "alice"
+        assert pr["input"]["resource"]["kind"] == "doc"
+        assert pr["output"]["kind"] == "KIND_CONDITIONAL"
+        assert "filterDebug" in pr["output"]
+        ep = e["auditTrail"]["effectivePolicies"]
+        assert "resource.doc.vdefault" in ep
+        # SourceAttributes wrapping matches the check path (audit.proto)
+        assert "attributes" in ep["resource.doc.vdefault"]
+
+
+class TestBundleCodec:
+    def test_malformed_untrusted_bundles_degrade_to_codec_error(self):
+        """Any structural malformation must raise CodecError (the BundleStore
+        fallback trigger), never an arbitrary exception."""
+        import json as _json
+
+        import pytest as _pytest
+
+        from cerbos_tpu.bundle_codec import CodecError, decode_compiled
+
+        evil = [
+            b"not json",
+            b"[]",
+            _json.dumps({"v": 999}).encode(),
+            _json.dumps({"v": 1, "nodes": [], "policies": [{"k": "R"}]}).encode(),  # missing fields
+            _json.dumps({"v": 1, "nodes": [], "policies": [{
+                "k": "R", "fqn": "f", "res": "r", "raw": "r", "ver": "v",
+                "sc": "", "sp": "", "par": 0, "rules": [], "dr": [],
+            }]}).encode(),  # params ref into empty node table
+            _json.dumps({"v": 1, "nodes": [["P", {"$M": []}, [0]]], "policies": [{
+                "k": "R", "fqn": "f", "res": "r", "raw": "r", "ver": "v",
+                "sc": "", "sp": "", "par": 0, "rules": [], "dr": [],
+            }]}).encode(),  # self-referential params: recursion must not escape
+            _json.dumps({"v": 1, "nodes": [["wat", 1]], "policies": [{
+                "k": "R", "fqn": "f", "res": "r", "raw": "r", "ver": "v",
+                "sc": "", "sp": "", "par": 0, "rules": [], "dr": [],
+            }]}).encode(),  # unknown node tag, referenced
+            _json.dumps({"v": 1, "nodes": [], "policies": [{"k": "Z"}]}).encode(),
+        ]
+        for blob in evil:
+            with _pytest.raises(CodecError):
+                decode_compiled(blob)

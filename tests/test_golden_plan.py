@@ -163,3 +163,45 @@ def test_plan_lenient(case_tuple):
     name, suite = case_tuple
     failures = run_suite(name, suite, lenient=True)
     assert not failures, "\n".join(failures)
+
+
+STRUCT_CMP_POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: struct_cmp
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: '{"basic": 5, "pro": 20}[request.resource.attr.plan] > 10'
+"""
+
+
+def test_struct_matcher_ordered_comparison_divergence():
+    """Differential pin for the deliberate struct-matcher deviation
+    (plan/partial.py): `m[x] > c` must expand each option as
+    `(value > c)`, not the reference's inverted `(c > value)`
+    (struct_matcher.go:258-264 mkOption). Ground truth by direct
+    evaluation: plan="pro" gives 20 > 10 = true, plan="basic" gives
+    5 > 10 = false — so the residual filter must select "pro". The
+    reference's inversion computes 10 > 5 / 10 > 20 and would select
+    "basic" (documented in tests/golden/UNSUPPORTED.md)."""
+    from cerbos_tpu.policy.parser import parse_policies
+
+    table = build_rule_table(compile_policy_set(list(parse_policies(STRUCT_CMP_POLICY))))
+    planner = Planner(table)
+    out = planner.plan(
+        PlanInput(
+            request_id="r",
+            actions=["view"],
+            principal=Principal(id="p", roles=["user"]),
+            resource_kind="struct_cmp",
+        ),
+        EvalParams(),
+    )
+    assert out.kind == "KIND_CONDITIONAL"
+    j = json.dumps(out.condition.to_json())
+    assert "pro" in j and "basic" not in j, f"filter must select the option where value>const holds: {j}"

@@ -1,0 +1,189 @@
+"""Remote bundle source: download, ETag poll, backoff, serve-cached.
+
+Mirrors the mechanism of internal/storage/hub/remote_source.go against a
+local in-process HTTP server (no egress).
+"""
+
+import hashlib
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from cerbos_tpu.bundle import build_bundle
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, Principal, Resource
+from cerbos_tpu.ruletable import build_rule_table, check_input
+from cerbos_tpu.storage import DiskStore, new_store
+from cerbos_tpu.storage.remote_bundle import RemoteBundleError, RemoteBundleStore
+
+POLICY_V1 = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+"""
+
+POLICY_V2 = POLICY_V1.replace('["view"]', '["view", "edit"]')
+
+
+class _BundleServer:
+    """Serves one bundle blob with ETag semantics; togglable failure mode."""
+
+    def __init__(self):
+        self.blob = b""
+        self.etag = ""
+        self.fail = False
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                outer.requests.append(dict(self.headers))
+                if outer.fail:
+                    self.send_error(503, "down")
+                    return
+                if self.headers.get("If-None-Match") == outer.etag:
+                    self.send_response(304)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("ETag", outer.etag)
+                self.send_header("Content-Length", str(len(outer.blob)))
+                self.end_headers()
+                self.wfile.write(outer.blob)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+
+    def set_bundle(self, blob: bytes):
+        self.blob = blob
+        self.etag = '"%s"' % hashlib.sha256(blob).hexdigest()[:16]
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def _bundle_bytes(tmp_path, policy: str, name: str) -> bytes:
+    d = tmp_path / f"src-{name}"
+    d.mkdir()
+    (d / "doc.yaml").write_text(policy)
+    out = tmp_path / f"{name}.crbp"
+    build_bundle(DiskStore(str(d)), str(out))
+    return out.read_bytes()
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = _BundleServer()
+    srv.set_bundle(_bundle_bytes(tmp_path, POLICY_V1, "v1"))
+    yield srv
+    srv.stop()
+
+
+def _effect(store, action="edit"):
+    rt = build_rule_table(compile_policy_set(store.get_all()))
+    out = check_input(
+        rt,
+        CheckInput(
+            principal=Principal(id="u", roles=["user"]),
+            resource=Resource(kind="doc", id="d1"),
+            actions=[action],
+        ),
+        __import__("cerbos_tpu.engine", fromlist=["EvalParams"]).EvalParams(),
+    )
+    return out.actions[action].effect
+
+
+def test_boot_download_and_poll_update(server, tmp_path):
+    store = RemoteBundleStore(
+        url=f"http://127.0.0.1:{server.port}/bundle",
+        cache_dir=str(tmp_path / "cache"),
+        _start_poll=False,
+    )
+    assert store.stats["downloads"] == 1
+    assert len(store.get_all()) == 1
+    assert _effect(store) == "EFFECT_DENY"
+
+    # unchanged: conditional GET gets 304, no swap
+    assert store.poll_once() is False
+    assert store.stats["not_modified"] == 1
+
+    # new bundle appears: poll swaps it in and notifies subscribers
+    events = []
+    store.subscribe(lambda evs: events.append(evs))
+    server.set_bundle(_bundle_bytes(tmp_path, POLICY_V2, "v2"))
+    assert store.poll_once() is True
+    assert _effect(store) == "EFFECT_ALLOW"
+    assert events and events[0][0].kind == "RELOAD"
+    store.close()
+
+
+def test_endpoint_dies_midrun_keeps_serving(server, tmp_path):
+    store = RemoteBundleStore(
+        url=f"http://127.0.0.1:{server.port}/bundle",
+        cache_dir=str(tmp_path / "cache"),
+        _start_poll=False,
+    )
+    server.fail = True
+    assert store.poll_once() is False
+    assert store.poll_once() is False
+    assert store.stats["failures"] == 2
+    assert store._failures == 2  # drives exponential backoff in the poll loop
+    # still serving the cached bundle
+    assert len(store.get_all()) == 1
+    store.close()
+
+
+def test_boot_from_cache_when_endpoint_down(server, tmp_path):
+    cache = tmp_path / "cache"
+    store = RemoteBundleStore(
+        url=f"http://127.0.0.1:{server.port}/bundle",
+        cache_dir=str(cache),
+        _start_poll=False,
+    )
+    store.close()
+    server.fail = True
+    # reboot against a dead endpoint: cached bundle serves
+    store2 = RemoteBundleStore(
+        url=f"http://127.0.0.1:{server.port}/bundle",
+        cache_dir=str(cache),
+        _start_poll=False,
+    )
+    assert store2.stats["served_from_cache_boot"] is True
+    assert len(store2.get_all()) == 1
+    store2.close()
+
+
+def test_boot_fails_without_cache(server, tmp_path):
+    server.fail = True
+    with pytest.raises(RemoteBundleError):
+        RemoteBundleStore(
+            url=f"http://127.0.0.1:{server.port}/bundle",
+            cache_dir=str(tmp_path / "empty-cache"),
+            _start_poll=False,
+        )
+
+
+def test_driver_registry(server, tmp_path):
+    store = new_store(
+        {
+            "driver": "remoteBundle",
+            "remoteBundle": {
+                "url": f"http://127.0.0.1:{server.port}/bundle",
+                "cacheDir": str(tmp_path / "cache"),
+                "pollIntervalSeconds": 0,
+            },
+        }
+    )
+    assert len(store.get_all()) == 1
+    store.close()

@@ -1,0 +1,143 @@
+"""Remote JWKS: fetch, cache, rotation refresh-on-miss, keep-cached-on-failure.
+
+Local in-process HTTP server; real RSA keys and signatures (jwt.go:40-242).
+"""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+from cerbos_tpu.auxdata import AuxDataManager, JWTError, load_keyset
+
+
+def _b64(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def _jwk(key):
+    pub = key.public_key().public_numbers()
+    return {
+        "kty": "RSA",
+        "n": _b64(pub.n.to_bytes((pub.n.bit_length() + 7) // 8, "big")),
+        "e": _b64(pub.e.to_bytes((pub.e.bit_length() + 7) // 8, "big")),
+    }
+
+
+def _sign(key, claims: dict) -> str:
+    header = _b64(json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
+    payload = _b64(json.dumps(claims).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+    return f"{header}.{payload}.{_b64(sig)}"
+
+
+class _JWKSServer:
+    def __init__(self):
+        self.keys = []
+        self.fail = False
+        self.hits = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                outer.hits += 1
+                if outer.fail:
+                    self.send_error(503)
+                    return
+                body = json.dumps({"keys": [_jwk(k) for k in outer.keys]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def jwks_server():
+    srv = _JWKSServer()
+    yield srv
+    srv.stop()
+
+
+def _manager(srv, refresh=3600.0, min_refresh=0.0):
+    ks = load_keyset({"id": "remote", "remote": {
+        "url": f"http://127.0.0.1:{srv.port}/jwks.json",
+        "refreshInterval": refresh,
+        "minRefreshInterval": min_refresh,
+    }})
+    return AuxDataManager([ks])
+
+
+def test_verify_against_served_jwks(jwks_server):
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    jwks_server.keys = [key]
+    mgr = _manager(jwks_server)
+    aux = mgr.extract(_sign(key, {"sub": "alice", "scope": "admin"}))
+    assert aux.jwt["sub"] == "alice"
+    # second verify uses the cache, not another fetch
+    mgr.extract(_sign(key, {"sub": "bob"}))
+    assert jwks_server.hits == 1
+
+
+def test_rotation_refreshes_on_miss(jwks_server):
+    old = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    new = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    jwks_server.keys = [old]
+    mgr = _manager(jwks_server)
+    mgr.extract(_sign(old, {"sub": "a"}))
+    # signer rotates; the endpoint now serves only the new key
+    jwks_server.keys = [new]
+    aux = mgr.extract(_sign(new, {"sub": "rotated"}))  # forces one refresh
+    assert aux.jwt["sub"] == "rotated"
+    assert jwks_server.hits == 2
+    # the old key is gone from the set: old tokens now fail
+    with pytest.raises(JWTError):
+        mgr.extract(_sign(old, {"sub": "stale"}))
+
+
+def test_fetch_failure_keeps_cached_keys(jwks_server):
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    jwks_server.keys = [key]
+    mgr = _manager(jwks_server, refresh=0.0)  # stale on every call
+    mgr.extract(_sign(key, {"sub": "a"}))
+    jwks_server.fail = True
+    # endpoint down: cached keys keep verifying
+    aux = mgr.extract(_sign(key, {"sub": "b"}))
+    assert aux.jwt["sub"] == "b"
+
+
+def test_no_cache_and_down_endpoint_errors(jwks_server):
+    jwks_server.fail = True
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    mgr = _manager(jwks_server)
+    with pytest.raises(JWTError):
+        mgr.extract(_sign(key, {"sub": "a"}))
+
+
+def test_forced_refresh_is_throttled(jwks_server):
+    """A flood of bad-signature tokens must not hammer the JWKS endpoint:
+    refresh-on-miss is rate-limited by minRefreshInterval."""
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    jwks_server.keys = [key]
+    mgr = _manager(jwks_server, min_refresh=300.0)
+    mgr.extract(_sign(key, {"sub": "a"}))
+    for _ in range(20):
+        with pytest.raises(JWTError):
+            mgr.extract(_sign(other, {"sub": "forged"}))
+    # initial fetch only; the 20 misses were throttled
+    assert jwks_server.hits == 1

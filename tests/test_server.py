@@ -246,6 +246,19 @@ class TestAdmin:
                                 response_pb2.ListPoliciesResponse)
         assert "resource.album.vdefault" in resp.policy_ids
 
+        # regexps match per component (name/version/scope), so anchored
+        # patterns work like the reference's per-column filters
+        resp = self._admin_call(
+            server, "ListPolicies",
+            request_pb2.ListPoliciesRequest(name_regexp="^album$", version_regexp="^default$"),
+            response_pb2.ListPoliciesResponse)
+        assert "resource.album.vdefault" in resp.policy_ids
+        resp = self._admin_call(
+            server, "ListPolicies",
+            request_pb2.ListPoliciesRequest(name_regexp="^lbum$"),
+            response_pb2.ListPoliciesResponse)
+        assert not resp.policy_ids
+
         got = self._admin_call(server, "GetPolicy",
                                request_pb2.GetPolicyRequest(id=["resource.album.vdefault"]),
                                response_pb2.GetPolicyResponse)
