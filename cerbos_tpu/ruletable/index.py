@@ -28,17 +28,19 @@ from ..policy.model import SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT
 class _GlobDim:
     """Literal + glob pattern buckets (ref: index/glob_dimension.go)."""
 
-    __slots__ = ("literals", "globs", "_cache")
+    __slots__ = ("literals", "globs", "_cache", "_multi_cache")
 
     def __init__(self) -> None:
         self.literals: dict[str, set[int]] = {}
         self.globs: dict[str, set[int]] = {}
         self._cache: dict[str, frozenset[int]] = {}
+        self._multi_cache: dict[tuple[str, ...], frozenset[int]] = {}
 
     def add(self, value: str, rid: int) -> None:
         bucket = self.globs if globs.is_glob(value) or value == "*" else self.literals
         bucket.setdefault(value, set()).add(rid)
         self._cache.clear()
+        self._multi_cache.clear()
 
     def remove(self, value: str, rid: int) -> None:
         bucket = self.globs if globs.is_glob(value) or value == "*" else self.literals
@@ -48,6 +50,7 @@ class _GlobDim:
             if not ids:
                 del bucket[value]
         self._cache.clear()
+        self._multi_cache.clear()
 
     def query(self, value: str) -> frozenset[int]:
         hit = self._cache.get(value)
@@ -67,10 +70,21 @@ class _GlobDim:
         return res
 
     def query_multiple(self, values: Iterable[str]) -> frozenset[int]:
+        # memoized per value tuple: role lists repeat across requests, and
+        # at 40k policies each per-role set holds tens of thousands of rows —
+        # re-unioning them per query dominated first-batch cost
+        key = tuple(values)
+        hit = self._multi_cache.get(key)
+        if hit is not None:
+            return hit
         out: set[int] = set()
-        for v in values:
+        for v in key:
             out |= self.query(v)
-        return frozenset(out)
+        res = frozenset(out)
+        if len(self._multi_cache) > 65536:
+            self._multi_cache.clear()
+        self._multi_cache[key] = res
+        return res
 
 
 class Index:

@@ -54,6 +54,29 @@ def split_key(key: int) -> tuple[int, int]:
     return hi, lo
 
 
+_TS_EPOCH = None
+
+
+def timestamp_key(v: Any) -> tuple[int, int]:
+    """CEL-convertible timestamp value → order-preserving (hi, lo) i32 pair.
+
+    Uses the same conversion as the CEL runtime's ``timestamp()`` overloads
+    (str RFC3339 / int epoch-seconds / Timestamp), then maps exact epoch
+    MICROseconds (int arithmetic — no float rounding at far dates) onto the
+    signed-biased key space device kernels compare. Raises on anything the
+    CEL function would reject."""
+    global _TS_EPOCH
+    import datetime as _dt
+
+    from ..cel.stdlib import _to_timestamp
+
+    ts = _to_timestamp(v)
+    if _TS_EPOCH is None:
+        _TS_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+    micros = (ts - _TS_EPOCH) // _dt.timedelta(microseconds=1)
+    return split_key((micros + (1 << 63)) & ((1 << 64) - 1))
+
+
 class StringInterner:
     """Batch-local string → i32 id (0 reserved for 'absent')."""
 
@@ -85,6 +108,15 @@ class ColumnBatch:
     # (state 0=missing, 1=ok, 2=error)
     list_sids: dict[tuple, np.ndarray] = field(default_factory=dict)
     list_states: dict[tuple, np.ndarray] = field(default_factory=dict)
+    # parsed-timestamp columns for paths used inside timestamp(...) calls:
+    # path -> key halves [B] + state [B] (0=missing, 1=ok, 2=error)
+    ts_his: dict[tuple, np.ndarray] = field(default_factory=dict)
+    ts_los: dict[tuple, np.ndarray] = field(default_factory=dict)
+    ts_states: dict[tuple, np.ndarray] = field(default_factory=dict)
+    # request-stable now() as a batch-constant key (0-d arrays: value varies
+    # per batch without retriggering jit tracing)
+    now_hi: np.ndarray = field(default_factory=lambda: np.zeros((), dtype=np.int32))
+    now_lo: np.ndarray = field(default_factory=lambda: np.zeros((), dtype=np.int32))
 
 
 def resolve_path(input_obj: Any, path: tuple[str, ...]) -> tuple[bool, Any]:

@@ -1,18 +1,32 @@
-"""Condition compiler: CEL AST → vectorized JAX kernel.
+"""Condition compiler: CEL AST → vectorized, TEMPLATED JAX kernels.
 
-Each distinct (condition, params) pair becomes one kernel computing
-``(value, error)`` per batch element over SoA attribute columns, reproducing
+Each distinct (condition, params) pair becomes one kernel computing a
+satisfied bit per batch element over SoA attribute columns, reproducing
 cel-go semantics: missing keys are errors, ``&&``/``||`` absorb errors
 commutatively, mismatched-type equality is false, mismatched ordering is an
 error. Variables/constants/globals are inlined at compile time (sound:
 conditions are pure and variables are topologically ordered).
 
-Fragments outside the native device op set — regex, timestamps, arithmetic,
-list membership in attribute lists, function calls — compile to *predicate
-columns*: host-evaluated (value, error) bits per input, cached per unique
-referenced-attribute tuple. Paths whose runtime values the device cannot
-compare (lists/dicts under ``==``, strings under ``<``) register fallback
-trigger tags; the packer routes affected inputs to the CPU oracle.
+**Templating** (the scale property): policy fleets repeat condition
+*structures* with different literals (``R.attr.amount < 100`` vs ``< 250``).
+Kernels are compiled against constant SLOTS instead of baked scalars; all
+kernels sharing a template signature (identical AST shape, paths and
+operators — literals abstracted) form one group whose emit evaluates every
+member at once: columns enter as ``[B, 1]``, slot constants as ``[1, G]``,
+and the whole group resolves with one broadcast compare per leaf. The jit
+graph is therefore O(distinct templates), not O(distinct conditions) — at
+2,000 distinct conditions sharing 2 shapes, XLA compiles 2 subgraphs
+instead of 2,000 (which took 126 s on CPU).
+
+Fragments outside the native device op set — regex, arithmetic, function
+calls — compile to *predicate columns*: host-evaluated (value, error) bits
+per input, cached per unique referenced-attribute tuple; the pred id is
+itself a slot, so pred-bearing kernels still template. Timestamp
+comparisons (``timestamp(path) op timestamp(lit)/now()``) ride parsed
+key columns on device. Paths whose runtime values the device cannot
+compare (lists/dicts under ``==``, strings under path-vs-path ``<``)
+register fallback trigger tags; the packer routes affected inputs to the
+CPU oracle.
 """
 
 from __future__ import annotations
@@ -66,20 +80,73 @@ class CondKernel:
     cond_id: int
     paths: set[tuple[str, ...]] = field(default_factory=set)
     preds: list[PredSpec] = field(default_factory=list)
-    # emit(refs) -> bool ndarray [B]; refs provides col/pred accessors
-    emit: Optional[Callable[["Refs"], Any]] = None
+    # non-None marks the kernel device-evaluable (callers only None-check).
+    # The stored value is the SHARED template emit — signature (refs, gc) —
+    # which is only ever invoked through KernelGroup with the group's
+    # constant vectors; do not call it with this kernel alone
+    emit: Optional[Callable[..., Any]] = None
     # tags that force CPU fallback when seen at a path in a batch
     fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
     # paths needing string-list membership columns
     list_paths: set[tuple[str, ...]] = field(default_factory=set)
+    # paths compared as timestamps (timestamp(path) op ...)
+    ts_paths: set[tuple[str, ...]] = field(default_factory=set)
+    # kernel reads the batch-constant now() key
+    uses_now: bool = False
     references_runtime: bool = False
+    # templating artifacts
+    template_sig: Optional[tuple] = None
+    slot_kinds: tuple[str, ...] = ()
+    slot_values: tuple[Any, ...] = ()
+
+
+@dataclass
+class KernelGroup:
+    """All kernels sharing one template: one traced subgraph, G members."""
+
+    emit: Callable[["Refs", "GroupConsts"], Any]  # -> sat [B, G]
+    gc: "GroupConsts"
+    cond_ids: list[int]
+
+
+class GroupConsts:
+    """Per-slot constant vectors for one kernel group."""
+
+    __slots__ = ("size", "slots")
+
+    def __init__(self, size: int, slots: list[Any]):
+        self.size = size
+        self.slots = slots
+
+    @classmethod
+    def build(cls, kinds: tuple[str, ...], member_values: list[tuple[Any, ...]]) -> "GroupConsts":
+        g = len(member_values)
+        slots: list[Any] = []
+        for i, kind in enumerate(kinds):
+            vals = [mv[i] for mv in member_values]
+            if kind == "key":  # (hi, lo) int pairs → two i32 vectors
+                slots.append((
+                    np.asarray([v[0] for v in vals], dtype=np.int32),
+                    np.asarray([v[1] for v in vals], dtype=np.int32),
+                ))
+            elif kind in ("sid", "bool"):
+                slots.append(np.asarray(vals, dtype=np.int32))
+            elif kind == "pred":  # static python ids: traced-graph gather is static
+                slots.append(tuple(int(v) for v in vals))
+            elif kind == "none":
+                slots.append(None)
+            else:  # pragma: no cover - sig construction guarantees known kinds
+                raise ValueError(f"unknown slot kind {kind}")
+        return cls(g, slots)
 
 
 class Refs:
     """Accessors handed to kernel emit functions (jnp or np arrays)."""
 
     def __init__(self, xp, tags, his, los, sids, nans, pred_vals, pred_errs,
-                 list_sids=None, list_states=None):
+                 list_sids=None, list_states=None,
+                 ts_his=None, ts_los=None, ts_states=None,
+                 now_hi=None, now_lo=None):
         self.xp = xp
         self._tags = tags
         self._his = his
@@ -90,6 +157,11 @@ class Refs:
         self._pred_errs = pred_errs
         self._list_sids = list_sids or {}
         self._list_states = list_states or {}
+        self._ts_his = ts_his or {}
+        self._ts_los = ts_los or {}
+        self._ts_states = ts_states or {}
+        self._now_hi = now_hi
+        self._now_lo = now_lo
 
     def tag(self, path):
         return self._tags[path]
@@ -114,26 +186,32 @@ class Refs:
         state: 0=missing, 1=ok list, 2=error (non-list / bad element)."""
         return self._list_sids[path], self._list_states[path]
 
+    def ts_col(self, path):
+        """(hi [B], lo [B], state [B]) parsed-timestamp key column;
+        state: 0=missing attr, 1=ok, 2=unconvertible value."""
+        return self._ts_his[path], self._ts_los[path], self._ts_states[path]
 
-# ---------------------------------------------------------------------------
-# typed mini-IR for operands
+    def now_key(self):
+        """Batch-constant (hi, lo) key of the request-stable now()."""
+        return self._now_hi, self._now_lo
 
-
-@dataclass(frozen=True)
-class ConstOp:
-    value: Any
-
-
-@dataclass(frozen=True)
-class PathOp:
-    path: tuple[str, ...]
+    def batch_size(self) -> int:
+        for d in (self._tags, self._pred_vals, self._ts_states, self._list_states):
+            for v in d.values():
+                return v.shape[0]
+        return 1
 
 
 @dataclass
 class BoolExpr:
-    """emit(refs) -> (val, err) boolean arrays."""
+    """emit(refs, gc) -> (val, err) boolean arrays broadcastable to [B, G]."""
 
-    emit: Callable[[Refs], tuple[Any, Any]]
+    emit: Callable[[Refs, GroupConsts], tuple[Any, Any]]
+
+
+def _col(a):
+    """[B] column → [B, 1] for broadcasting against [1, G] slot vectors."""
+    return a[:, None]
 
 
 class _Compiler:
@@ -143,6 +221,21 @@ class _Compiler:
         self.globals = globals_
         self.pred_alloc = pred_alloc  # (node, params) -> PredSpec
         self.var_defs = {v.name: v.expr.node for v in params.ordered_variables}
+        # template accumulation: sig tokens fully determine the emit graph;
+        # slots carry this kernel's literal payloads in allocation order
+        self.sig: list[Any] = []
+        self.slot_kinds: list[str] = []
+        self.slot_values: list[Any] = []
+
+    def tok(self, *t: Any) -> None:
+        self.sig.append(t)
+
+    def slot(self, kind: str, value: Any) -> int:
+        idx = len(self.slot_kinds)
+        self.slot_kinds.append(kind)
+        self.slot_values.append(value)
+        self.tok("slot", kind)
+        return idx
 
     # -- variable / constant inlining -------------------------------------
 
@@ -230,26 +323,30 @@ class _Compiler:
         if isinstance(node, A.Call) and node.target is None:
             fn = node.fn
             if fn == "_&&_":
+                self.tok("and", len(node.args))
                 return self._logic(node.args, is_and=True)
             if fn == "_||_":
+                self.tok("or", len(node.args))
                 return self._logic(node.args, is_and=False)
             if fn == "!_":
+                self.tok("not")
                 inner = self.compile_bool(node.args[0])
 
-                def emit_not(refs, inner=inner):
-                    v, e = inner.emit(refs)
+                def emit_not(refs, gc, inner=inner):
+                    v, e = inner.emit(refs, gc)
                     return ~v & ~e, e
 
                 return BoolExpr(emit_not)
             if fn == "_?_:_":
+                self.tok("ternary")
                 c = self.compile_bool(node.args[0])
                 t = self.compile_bool(node.args[1])
                 f = self.compile_bool(node.args[2])
 
-                def emit_ternary(refs, c=c, t=t, f=f):
-                    cv, ce = c.emit(refs)
-                    tv, te = t.emit(refs)
-                    fv, fe = f.emit(refs)
+                def emit_ternary(refs, gc, c=c, t=t, f=f):
+                    cv, ce = c.emit(refs, gc)
+                    tv, te = t.emit(refs, gc)
+                    fv, fe = f.emit(refs, gc)
                     pick_t = cv & ~ce
                     pick_f = ~cv & ~ce
                     err = ce | (pick_t & te) | (pick_f & fe)
@@ -268,12 +365,14 @@ class _Compiler:
             return self._has(node)
         if isinstance(node, A.Lit):
             if isinstance(node.value, bool):
-                b = node.value
+                s = self.slot("bool", 1 if node.value else 0)
+                self.tok("litbool")
 
-                def emit_lit(refs, b=b):
+                def emit_lit(refs, gc, s=s):
                     xp = refs.xp
-                    shape = self._any_shape(refs)
-                    return xp.full(shape, b, dtype=bool), xp.zeros(shape, dtype=bool)
+                    B = refs.batch_size()
+                    val = xp.broadcast_to(gc.slots[s][None, :] == 1, (B, gc.size))
+                    return val, xp.zeros((B, gc.size), dtype=bool)
 
                 return BoolExpr(emit_lit)
             raise Unsupported("non-bool literal in boolean position")
@@ -281,27 +380,22 @@ class _Compiler:
         path = self.path_of(node)
         if path is not None:
             self.k.paths.add(path)
+            self.tok("boolpath", path)
 
-            def emit_path(refs, path=path):
-                tag = refs.tag(path)
-                val = (tag == TAG_BOOL) & (refs.hi(path) == 1)
+            def emit_path(refs, gc, path=path):
+                tag = _col(refs.tag(path))
+                val = (tag == TAG_BOOL) & (_col(refs.hi(path)) == 1)
                 err = (tag == TAG_MISSING) | (tag == TAG_ERR)
                 return val & ~err, err
 
             return BoolExpr(emit_path)
         raise Unsupported("unsupported boolean expression")
 
-    def _any_shape(self, refs: Refs):
-        for d in (refs._tags, refs._pred_vals):
-            for v in d.values():
-                return v.shape
-        return (1,)
-
     def _logic(self, args, is_and: bool) -> BoolExpr:
         parts = [self.compile_bool(a) for a in args]
 
-        def emit(refs):
-            vals_errs = [p.emit(refs) for p in parts]
+        def emit(refs, gc):
+            vals_errs = [p.emit(refs, gc) for p in parts]
             if is_and:
                 # false if any (false & !err); err if no false and any err
                 any_false = None
@@ -333,18 +427,107 @@ class _Compiler:
         if path is None:
             raise Unsupported("has() on non-path")
         self.k.paths.add(path)
+        self.tok("has", path)
 
-        def emit(refs, path=path):
-            tag = refs.tag(path)
+        def emit(refs, gc, path=path):
+            tag = _col(refs.tag(path))
             err = tag == TAG_ERR
             val = ~err & (tag != TAG_MISSING)
             return val, err
 
         return BoolExpr(emit)
 
+    # -- timestamp operands -------------------------------------------------
+
+    def _ts_side(self, node: A.Node):
+        """PROBE a timestamp-typed operand: timestamp(path),
+        timestamp(literal), or now(). Returns a descriptor tuple or None.
+        Mutation-free — both sides are probed before either commits, so a
+        mixed comparison (one ts side, one untyped) leaves no orphaned ts
+        column or slot behind when it falls back to a predicate."""
+        if not (isinstance(node, A.Call) and node.target is None):
+            return None
+        if node.fn == "now" and not node.args:
+            return ("now",)
+        if node.fn == "timestamp" and len(node.args) == 1:
+            arg = self.inline(node.args[0])
+            if isinstance(arg, A.Lit):
+                from .columns import timestamp_key
+
+                try:
+                    hi, lo = timestamp_key(arg.value)
+                except Exception:  # noqa: BLE001 — invalid constant: host evaluates (errors)
+                    raise Unsupported("unconvertible timestamp constant") from None
+                return ("rawconst", (hi, lo))
+            path = self.path_of(arg)
+            if path is not None:
+                return ("rawpath", path)
+        return None
+
+    def _ts_commit(self, side):
+        """Materialize a probed side: allocate slots / register columns.
+        Called lhs-first so slot order matches the sig token order."""
+        if side[0] == "rawconst":
+            return ("const", self.slot("key", side[1]))
+        if side[0] == "rawpath":
+            self.k.ts_paths.add(side[1])
+            return ("path", side[1])
+        self.k.uses_now = True
+        return side
+
+    def _ts_key_of(self, refs: Refs, gc: GroupConsts, side):
+        """side descriptor → (hi, lo, err) broadcastable arrays."""
+        xp = refs.xp
+        if side[0] == "path":
+            hi, lo, state = refs.ts_col(side[1])
+            return _col(hi), _col(lo), _col(state != 1)
+        if side[0] == "now":
+            hi, lo = refs.now_key()
+            zero = xp.zeros((1, 1), dtype=bool)
+            return hi, lo, zero
+        shi, slo = gc.slots[side[1]]
+        zero = xp.zeros((1, 1), dtype=bool)
+        return shi[None, :], slo[None, :], zero
+
+    def _ts_compare(self, fn: str, ls, rs) -> BoolExpr:
+        self.tok("ts", fn, ls[0], ls[1] if ls[0] == "path" else None,
+                 rs[0], rs[1] if rs[0] == "path" else None)
+
+        def emit(refs, gc, ls=ls, rs=rs, fn=fn):
+            ahi, alo, aerr = self._ts_key_of(refs, gc, ls)
+            bhi, blo, berr = self._ts_key_of(refs, gc, rs)
+            err = aerr | berr
+            lt = (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+            eq = (ahi == bhi) & (alo == blo)
+            if fn == "_<_":
+                val = lt
+            elif fn == "_<=_":
+                val = lt | eq
+            elif fn == "_>_":
+                val = ~lt & ~eq
+            elif fn == "_>=_":
+                val = ~lt
+            elif fn == "_==_":
+                val = eq
+            else:  # _!=_
+                val = ~eq
+            xp = refs.xp
+            shape = (refs.batch_size(), gc.size)
+            val = xp.broadcast_to(val, shape)
+            err = xp.broadcast_to(err, shape)
+            return val & ~err, err
+
+        return BoolExpr(emit)
+
     # value-compare helpers; `a` is PathOp, b is ConstOp/PathOp
 
     def _equality(self, lhs_n: A.Node, rhs_n: A.Node, negate: bool) -> BoolExpr:
+        ls, rs = self._ts_side(lhs_n), self._ts_side(rhs_n)
+        if ls is not None or rs is not None:
+            if ls is None or rs is None:
+                raise Unsupported("mixed timestamp equality")
+            ls, rs = self._ts_commit(ls), self._ts_commit(rs)
+            return self._ts_compare("_!=_" if negate else "_==_", ls, rs)
         lhs, rhs = self.as_operand(lhs_n), self.as_operand(rhs_n)
         if isinstance(lhs, ConstOp) and isinstance(rhs, PathOp):
             lhs, rhs = rhs, lhs
@@ -355,13 +538,19 @@ class _Compiler:
         self._add_fallback(lhs.path, {TAG_OTHER})
         if isinstance(rhs, PathOp):
             self._add_fallback(rhs.path, {TAG_OTHER})
+            self.tok("eqpp", lhs.path, rhs.path, negate)
 
-            def emit_pp(refs, a=lhs.path, b=rhs.path, negate=negate):
-                ta, tb = refs.tag(a), refs.tag(b)
+            def emit_pp(refs, gc, a=lhs.path, b=rhs.path, negate=negate):
+                ta, tb = _col(refs.tag(a)), _col(refs.tag(b))
                 err = (ta == TAG_MISSING) | (ta == TAG_ERR) | (tb == TAG_MISSING) | (tb == TAG_ERR)
-                same_num = (ta == TAG_NUM) & (tb == TAG_NUM) & ~refs.nan(a) & ~refs.nan(b) & (refs.hi(a) == refs.hi(b)) & (refs.lo(a) == refs.lo(b))
-                same_str = (ta == TAG_STR) & (tb == TAG_STR) & (refs.sid(a) == refs.sid(b))
-                same_bool = (ta == TAG_BOOL) & (tb == TAG_BOOL) & (refs.hi(a) == refs.hi(b))
+                same_num = (
+                    (ta == TAG_NUM) & (tb == TAG_NUM)
+                    & _col(~refs.nan(a)) & _col(~refs.nan(b))
+                    & (_col(refs.hi(a)) == _col(refs.hi(b)))
+                    & (_col(refs.lo(a)) == _col(refs.lo(b)))
+                )
+                same_str = (ta == TAG_STR) & (tb == TAG_STR) & (_col(refs.sid(a)) == _col(refs.sid(b)))
+                same_bool = (ta == TAG_BOOL) & (tb == TAG_BOOL) & (_col(refs.hi(a)) == _col(refs.hi(b)))
                 same_null = (ta == TAG_NULL) & (tb == TAG_NULL)
                 val = same_num | same_str | same_bool | same_null
                 if negate:
@@ -374,21 +563,23 @@ class _Compiler:
         if isinstance(cval, list):
             raise Unsupported("list equality")
         if isinstance(cval, bool):
-            want = 1 if cval else 0
+            s = self.slot("bool", 1 if cval else 0)
+            self.tok("eqpb", lhs.path, negate)
 
-            def emit_pb(refs, p=lhs.path, want=want, negate=negate):
-                tag = refs.tag(p)
+            def emit_pb(refs, gc, p=lhs.path, s=s, negate=negate):
+                tag = _col(refs.tag(p))
                 err = (tag == TAG_MISSING) | (tag == TAG_ERR)
-                val = (tag == TAG_BOOL) & (refs.hi(p) == want)
+                val = (tag == TAG_BOOL) & (_col(refs.hi(p)) == gc.slots[s][None, :])
                 if negate:
                     val = ~val
                 return val & ~err, err
 
             return BoolExpr(emit_pb)
         if cval is None:
+            self.tok("eqpn", lhs.path, negate)
 
-            def emit_pn(refs, p=lhs.path, negate=negate):
-                tag = refs.tag(p)
+            def emit_pn(refs, gc, p=lhs.path, negate=negate):
+                tag = _col(refs.tag(p))
                 err = (tag == TAG_MISSING) | (tag == TAG_ERR)
                 val = tag == TAG_NULL
                 if negate:
@@ -399,9 +590,10 @@ class _Compiler:
         if isinstance(cval, (int, float)):
             f = float(cval)
             if f != f:
+                self.tok("eqpnan", lhs.path, negate)
 
-                def emit_pnan(refs, p=lhs.path, negate=negate):
-                    tag = refs.tag(p)
+                def emit_pnan(refs, gc, p=lhs.path, negate=negate):
+                    tag = _col(refs.tag(p))
                     err = (tag == TAG_MISSING) | (tag == TAG_ERR)
                     xp = refs.xp
                     val = xp.zeros_like(err)
@@ -410,24 +602,31 @@ class _Compiler:
                     return val & ~err, err
 
                 return BoolExpr(emit_pnan)
-            hi, lo = split_key(double_key(f))
+            s = self.slot("key", split_key(double_key(f)))
+            self.tok("eqpf", lhs.path, negate)
 
-            def emit_pf(refs, p=lhs.path, hi=hi, lo=lo, negate=negate):
-                tag = refs.tag(p)
+            def emit_pf(refs, gc, p=lhs.path, s=s, negate=negate):
+                tag = _col(refs.tag(p))
                 err = (tag == TAG_MISSING) | (tag == TAG_ERR)
-                val = (tag == TAG_NUM) & ~refs.nan(p) & (refs.hi(p) == hi) & (refs.lo(p) == lo)
+                chi, clo = gc.slots[s]
+                val = (
+                    (tag == TAG_NUM) & _col(~refs.nan(p))
+                    & (_col(refs.hi(p)) == chi[None, :])
+                    & (_col(refs.lo(p)) == clo[None, :])
+                )
                 if negate:
                     val = ~val
                 return val & ~err, err
 
             return BoolExpr(emit_pf)
         if isinstance(cval, str):
-            sid = self.interner.intern(cval)
+            s = self.slot("sid", self.interner.intern(cval))
+            self.tok("eqps", lhs.path, negate)
 
-            def emit_ps(refs, p=lhs.path, sid=sid, negate=negate):
-                tag = refs.tag(p)
+            def emit_ps(refs, gc, p=lhs.path, s=s, negate=negate):
+                tag = _col(refs.tag(p))
                 err = (tag == TAG_MISSING) | (tag == TAG_ERR)
-                val = (tag == TAG_STR) & (refs.sid(p) == sid)
+                val = (tag == TAG_STR) & (_col(refs.sid(p)) == gc.slots[s][None, :])
                 if negate:
                     val = ~val
                 return val & ~err, err
@@ -436,6 +635,13 @@ class _Compiler:
         raise Unsupported(f"equality against {type(cval).__name__} constant")
 
     def _ordering(self, fn: str, lhs_n: A.Node, rhs_n: A.Node) -> BoolExpr:
+        ls, rs = self._ts_side(lhs_n), self._ts_side(rhs_n)
+        if ls is not None or rs is not None:
+            if ls is None or rs is None:
+                # mixed timestamp vs untyped operand: host evaluates
+                raise Unsupported("mixed timestamp ordering")
+            ls, rs = self._ts_commit(ls), self._ts_commit(rs)
+            return self._ts_compare(fn, ls, rs)
         lhs, rhs = self.as_operand(lhs_n), self.as_operand(rhs_n)
         flip = {"_<_": "_>_", "_<=_": "_>=_", "_>_": "_<_", "_>=_": "_<=_"}
         if isinstance(lhs, ConstOp) and isinstance(rhs, PathOp):
@@ -444,10 +650,8 @@ class _Compiler:
         if isinstance(lhs, ConstOp):
             raise Unsupported("constant ordering")
         assert isinstance(lhs, PathOp)
-        # strings/bools/other under ordering → CPU fallback when seen
-        self._add_fallback(lhs.path, {TAG_STR, TAG_OTHER})
 
-        def cmp(refs, ahi, alo, bhi, blo, fn):
+        def cmp(ahi, alo, bhi, blo, fn):
             lt = (ahi < bhi) | ((ahi == bhi) & (alo < blo))
             eq = (ahi == bhi) & (alo == blo)
             if fn == "_<_":
@@ -459,32 +663,50 @@ class _Compiler:
             return ~lt
 
         if isinstance(rhs, PathOp):
+            # path-vs-path ordering between two STRINGS (or two timestamps
+            # under TAG_OTHER) is satisfiable in CEL but not computable on
+            # device → route those inputs to the oracle. Every other
+            # non-numeric pairing is a CEL type error, which the device err
+            # bit reproduces.
+            self._add_fallback(lhs.path, {TAG_STR, TAG_OTHER})
             self._add_fallback(rhs.path, {TAG_STR, TAG_OTHER})
+            self.tok("ordpp", lhs.path, rhs.path, fn)
 
-            def emit_pp(refs, a=lhs.path, b=rhs.path, fn=fn):
-                ta, tb = refs.tag(a), refs.tag(b)
-                numeric = (ta == TAG_NUM) & (tb == TAG_NUM) & ~refs.nan(a) & ~refs.nan(b)
+            def emit_pp(refs, gc, a=lhs.path, b=rhs.path, fn=fn):
+                numeric = (
+                    (_col(refs.tag(a)) == TAG_NUM) & (_col(refs.tag(b)) == TAG_NUM)
+                    & _col(~refs.nan(a)) & _col(~refs.nan(b))
+                )
                 err = ~numeric
-                val = numeric & cmp(refs, refs.hi(a), refs.lo(a), refs.hi(b), refs.lo(b), fn)
+                val = numeric & cmp(
+                    _col(refs.hi(a)), _col(refs.lo(a)), _col(refs.hi(b)), _col(refs.lo(b)), fn
+                )
                 return val, err
 
             return BoolExpr(emit_pp)
         cval = rhs.value
+        if isinstance(cval, str):
+            # string ordering against a constant: a predicate column (host
+            # CEL, value-cached) — NOT an oracle fallback; strings at the
+            # path stay device-served
+            raise Unsupported("string ordering constant")
         if isinstance(cval, bool) or not isinstance(cval, (int, float)):
             raise Unsupported("non-numeric ordering constant")
         f = float(cval)
         if f != f:
             raise Unsupported("NaN ordering constant")
-        hi, lo = split_key(double_key(f))
+        s = self.slot("key", split_key(double_key(f)))
+        self.tok("ordpc", lhs.path, fn)
 
-        def emit_pc(refs, p=lhs.path, hi=hi, lo=lo, fn=fn):
-            tag = refs.tag(p)
-            numeric = (tag == TAG_NUM) & ~refs.nan(p)
+        # vs a numeric constant no fallback tags are needed: any non-numeric
+        # value at the path (string, list, timestamp) is a CEL type error,
+        # exactly what the device err bit produces
+        def emit_pc(refs, gc, p=lhs.path, s=s, fn=fn):
+            tag = _col(refs.tag(p))
+            numeric = (tag == TAG_NUM) & _col(~refs.nan(p))
             err = ~numeric
-            xp = refs.xp
-            chi = xp.asarray(hi, dtype=refs.hi(p).dtype)
-            clo = xp.asarray(lo, dtype=refs.lo(p).dtype)
-            val = numeric & cmp(refs, refs.hi(p), refs.lo(p), chi, clo, fn)
+            chi, clo = gc.slots[s]
+            val = numeric & cmp(_col(refs.hi(p)), _col(refs.lo(p)), chi[None, :], clo[None, :], fn)
             return val, err
 
         return BoolExpr(emit_pc)
@@ -494,16 +716,17 @@ class _Compiler:
         rhs = self.as_operand(rhs_n)
         if isinstance(lhs, PathOp) and isinstance(rhs, ConstOp) and isinstance(rhs.value, list):
             # OR of equalities against each element
+            self.tok("inlist", lhs.path, len(rhs.value))
             parts = []
             for el in rhs.value:
                 parts.append(self._equality(lhs_n, A.Lit(el), negate=False))
 
-            def emit(refs, parts=parts, p=lhs.path):
-                tag = refs.tag(p)
+            def emit(refs, gc, parts=parts, p=lhs.path):
+                tag = _col(refs.tag(p))
                 err = (tag == TAG_MISSING) | (tag == TAG_ERR)
                 val = None
                 for part in parts:
-                    v, _ = part.emit(refs)
+                    v, _ = part.emit(refs, gc)
                     val = v if val is None else (val | v)
                 if val is None:
                     xp = refs.xp
@@ -516,15 +739,26 @@ class _Compiler:
             # (sid comparison per padded slot; non-list values error, which
             # collapses to false at the condition boundary like the oracle)
             self.k.list_paths.add(rhs.path)
-            sid = self.interner.intern(lhs.value)
+            s = self.slot("sid", self.interner.intern(lhs.value))
+            self.tok("instr", rhs.path)
 
-            def emit_in_list(refs, p=rhs.path, sid=sid):
+            def emit_in_list(refs, gc, p=rhs.path, s=s):
                 sids, state = refs.list_col(p)
                 # anything but a well-formed list (missing attr, wrong type)
                 # is a CEL error, which matters under ! / && / || absorption
-                err = state != 1
-                val = (sids == sid).any(axis=1) & ~err
-                return val, err
+                err = _col(state != 1)
+                needle = gc.slots[s][None, :]  # [1, G]
+                # accumulate over the (static, small) list axis instead of
+                # materializing a [B, L, G] intermediate — at fleet scale
+                # (G in the thousands) that tensor is gigabytes on numpy
+                L = sids.shape[1]
+                val = None
+                for j in range(L):
+                    m = sids[:, j : j + 1] == needle  # [B, G]
+                    val = m if val is None else (val | m)
+                if val is None:
+                    val = refs.xp.zeros_like(err)
+                return val & ~err, err
 
             return BoolExpr(emit_in_list)
         raise Unsupported("in over attribute lists")
@@ -534,6 +768,19 @@ class _Compiler:
         self.k.fallback_tags[path] = cur | frozenset(tags)
 
     interner: StringInterner  # set by compile_condition
+
+
+# typed mini-IR for operands
+
+
+@dataclass(frozen=True)
+class ConstOp:
+    value: Any
+
+
+@dataclass(frozen=True)
+class PathOp:
+    path: tuple[str, ...]
 
 
 def _split_chain(node: A.Node) -> Optional[tuple[str, tuple[str, ...]]]:
@@ -633,6 +880,10 @@ class ConditionSetCompiler:
         self.kernels: list[CondKernel] = []
         self._by_key: dict[tuple[int, int], int] = {}
         self.preds: list[PredSpec] = []
+        self._template_emits: dict[int, Callable] = {}  # cond_id -> slot-mode emit
+        self.groups: list[KernelGroup] = []
+        self.perm: Optional[np.ndarray] = None
+        self._groups_dirty = True
 
     def cond_id(self, cond: Optional[CompiledCondition], params: Optional[PolicyParams]) -> int:
         """Intern a (condition, params) pair; -1 for condition-less.
@@ -658,6 +909,7 @@ class ConditionSetCompiler:
         self.kernels.append(kernel)
         self._by_key[id_key] = cid
         self._by_key[struct_key] = cid
+        self._groups_dirty = True
         return cid
 
     def _alloc_pred(self, node: A.Node, params: PolicyParams) -> PredSpec:
@@ -677,8 +929,8 @@ class ConditionSetCompiler:
         comp = _Compiler(kernel, params, self.globals, self._alloc_pred)
         comp.interner = self.interner
 
-        def compile_tree(c: CompiledCondition) -> Callable[[Refs], Any]:
-            """Condition-tree node → emit(refs) -> sat bool array.
+        def compile_tree(c: CompiledCondition) -> Callable[[Refs, GroupConsts], Any]:
+            """Condition-tree node → emit(refs, gc) -> sat [B, G].
 
             all/any/none combine *satisfied* child results (each child's
             errors collapse to false at its own boundary — check.go:650-702),
@@ -689,8 +941,8 @@ class ConditionSetCompiler:
                 try:
                     be = comp.compile_bool(node)
 
-                    def emit_expr(refs, be=be):
-                        v, e = be.emit(refs)
+                    def emit_expr(refs, gc, be=be):
+                        v, e = be.emit(refs, gc)
                         return v & ~e
 
                     return emit_expr
@@ -699,45 +951,89 @@ class ConditionSetCompiler:
                         raise
                     spec = self._alloc_pred(node, params)
                     kernel.preds.append(spec)
+                    s = comp.slot("pred", spec.pred_id)
+                    comp.tok("predexpr")
 
-                    def emit_pred(refs, pid=spec.pred_id):
-                        v, e = refs.pred(pid)
+                    def emit_pred(refs, gc, s=s):
+                        xp = refs.xp
+                        vs = [refs.pred(pid) for pid in gc.slots[s]]
+                        v = xp.stack([x[0] for x in vs], axis=1)
+                        e = xp.stack([x[1] for x in vs], axis=1)
                         return v & ~e
 
                     return emit_pred
+            comp.tok("tree", c.kind, len(c.children))
             subs = [compile_tree(ch) for ch in c.children]
             if c.kind == "all":
-                def emit_all(refs, subs=subs):
+                def emit_all(refs, gc, subs=subs):
                     out = None
-                    for s in subs:
-                        v = s(refs)
+                    for sfn in subs:
+                        v = sfn(refs, gc)
                         out = v if out is None else (out & v)
                     return out
                 return emit_all
             if c.kind == "any":
-                def emit_any(refs, subs=subs):
+                def emit_any(refs, gc, subs=subs):
                     out = None
-                    for s in subs:
-                        v = s(refs)
+                    for sfn in subs:
+                        v = sfn(refs, gc)
                         out = v if out is None else (out | v)
                     return out
                 return emit_any
             if c.kind == "none":
-                def emit_none(refs, subs=subs):
+                def emit_none(refs, gc, subs=subs):
                     out = None
-                    for s in subs:
-                        v = s(refs)
+                    for sfn in subs:
+                        v = sfn(refs, gc)
                         out = v if out is None else (out | v)
                     return ~out
                 return emit_none
             raise ValueError(f"unknown condition kind {c.kind}")
 
         try:
-            kernel.emit = compile_tree(cond)
+            template = compile_tree(cond)
         except Unsupported:
             # runtime-referencing conditions can't be batched at all
             kernel.emit = None
+            return kernel
+
+        kernel.template_sig = tuple(comp.sig)
+        kernel.slot_kinds = tuple(comp.slot_kinds)
+        kernel.slot_values = tuple(comp.slot_values)
+        self._template_emits[cid] = template
+        # contract: non-None emit marks the kernel device-evaluable (callers
+        # only None-check it); evaluation happens through the group path,
+        # emit(refs, gc) being the shared template
+        kernel.emit = template
         return kernel
+
+    def build_groups(self) -> None:
+        """Group kernels by template signature; one traced subgraph per
+        group evaluates all members against slot constant vectors."""
+        if not self._groups_dirty:
+            return
+        by_sig: dict[tuple, list[int]] = {}
+        for k in self.kernels:
+            if k.emit is None or k.template_sig is None:
+                continue
+            by_sig.setdefault(k.template_sig, []).append(k.cond_id)
+        self.groups = []
+        order: list[int] = []
+        for sig, cids in by_sig.items():
+            gc = GroupConsts.build(
+                self.kernels[cids[0]].slot_kinds,
+                [self.kernels[c].slot_values for c in cids],
+            )
+            self.groups.append(KernelGroup(emit=self._template_emits[cids[0]], gc=gc, cond_ids=cids))
+            order.extend(cids)
+        # column permutation: concatenated group output order -> cond_id order
+        C = len(self.kernels)
+        self.perm = np.zeros(C, dtype=np.int64)
+        self.dead = np.ones(C, dtype=bool)  # kernels with no device emit
+        for pos, cid in enumerate(order):
+            self.perm[cid] = pos
+            self.dead[cid] = False
+        self._groups_dirty = False
 
 
 def _cond_struct_key(c: CompiledCondition):

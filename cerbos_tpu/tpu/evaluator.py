@@ -57,7 +57,7 @@ _BIG = 127
 
 def _compute(
     xp,
-    kernels,
+    compiler,
     K: int,
     J: int,
     D: int,
@@ -78,6 +78,11 @@ def _compute(
     scope_sp,
     list_sids=None,
     list_states=None,
+    ts_his=None,
+    ts_los=None,
+    ts_states=None,
+    now_hi=None,
+    now_lo=None,
 ):
     """Pure array computation: jittable with `xp=jnp`, testable with numpy.
 
@@ -85,20 +90,27 @@ def _compute(
     sat_cond [B,C]) — see module docstring for the lattice.
     """
     refs = Refs(xp, tags, his, los, sids, nans, pred_vals, pred_errs,
-                list_sids=list_sids, list_states=list_states)
+                list_sids=list_sids, list_states=list_states,
+                ts_his=ts_his, ts_los=ts_los, ts_states=ts_states,
+                now_hi=now_hi, now_lo=now_lo)
     # scope_sp is always [B, 2, D]; column dicts can all be empty when the
     # policy set has only unconditional rules, so B must not come from them
     B = scope_sp.shape[0]
 
-    sat_list = []
-    for k in kernels:
-        if k.emit is None:
-            sat_list.append(xp.zeros(B, dtype=bool))
-        else:
-            sat_list.append(k.emit(refs))
-    C = len(kernels)
+    # evaluate per TEMPLATE GROUP: one broadcast subgraph per distinct
+    # condition structure covers all its members at once (graph size is
+    # O(templates), not O(conditions))
+    compiler.build_groups()
+    C = len(compiler.kernels)
     if C:
-        sat_cond = xp.stack(sat_list, axis=1)  # [B, C]
+        blocks = [xp.broadcast_to(g.emit(refs, g.gc), (B, g.gc.size)) for g in compiler.groups]
+        if blocks:
+            allsat = xp.concatenate(blocks, axis=1)
+            sat_cond = allsat[:, compiler.perm]
+            if compiler.dead.any():
+                sat_cond = sat_cond & ~xp.asarray(compiler.dead)[None, :]
+        else:
+            sat_cond = xp.zeros((B, C), dtype=bool)
     else:
         sat_cond = xp.zeros((B, 1), dtype=bool)
 
@@ -201,13 +213,13 @@ def _device_eval(
     they divide evenly over 2/4/8-device meshes) and XLA partitions the
     computation across devices.
     """
-    kernels = lt.compiler.kernels
+    compiler = lt.compiler
     K, J, D = batch.K, batch.J, batch.D
     BA = batch.cand_cond.shape[0]
     B = batch.columns.size
 
     if BA == 0:
-        C = max(len(kernels), 1)
+        C = max(len(compiler.kernels), 1)
         return (
             np.zeros((0, 4), dtype=np.int8),
             np.zeros((0, K, 2, 2), dtype=np.int8),
@@ -223,10 +235,12 @@ def _device_eval(
         cand_effect=batch.cand_effect, cand_pt=batch.cand_pt, cand_depth=batch.cand_depth,
         cand_valid=batch.cand_valid, scope_sp=batch.scope_sp,
         list_sids=cols.list_sids, list_states=cols.list_states,
+        ts_his=cols.ts_his, ts_los=cols.ts_los, ts_states=cols.ts_states,
+        now_hi=cols.now_hi, now_lo=cols.now_lo,
     )
 
     if not use_jax:
-        final, role_results, win_j, sat_cond = _compute(np, kernels, K, J, D, **arrays)
+        final, role_results, win_j, sat_cond = _compute(np, compiler, K, J, D, **arrays)
         return np.asarray(final), np.asarray(role_results), np.asarray(win_j), np.asarray(sat_cond)
 
     import jax
@@ -250,6 +264,11 @@ def _device_eval(
     padded = dict(
         list_sids={p: pad_b(a) for p, a in cols.list_sids.items()},
         list_states={p: pad_b(a) for p, a in cols.list_states.items()},
+        ts_his={p: pad_b(a) for p, a in cols.ts_his.items()},
+        ts_los={p: pad_b(a) for p, a in cols.ts_los.items()},
+        ts_states={p: pad_b(a) for p, a in cols.ts_states.items()},
+        now_hi=cols.now_hi,
+        now_lo=cols.now_lo,
         tags={p: pad_b(a) for p, a in cols.tags.items()},
         his={p: pad_b(a) for p, a in cols.his.items()},
         los={p: pad_b(a) for p, a in cols.los.items()},
@@ -277,7 +296,7 @@ def _device_eval(
     key = (B_pad, BA_pad, K, J)
     fn = jit_cache.get(key)
     if fn is None:
-        fn = jax.jit(lambda **kw: _compute(jnp, kernels, K, J, D, **kw))
+        fn = jax.jit(lambda **kw: _compute(jnp, compiler, K, J, D, **kw))
         jit_cache[key] = fn
     final, role_results, win_j, sat_cond = fn(**padded)
     return (

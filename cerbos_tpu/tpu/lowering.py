@@ -61,6 +61,8 @@ class LoweredTable:
     rows: dict[int, LoweredRow] = field(default_factory=dict)  # by RuleRow.id
     paths: set[tuple[str, ...]] = field(default_factory=set)
     list_paths: set[tuple[str, ...]] = field(default_factory=set)
+    ts_paths: set[tuple[str, ...]] = field(default_factory=set)
+    uses_now: bool = False
     fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
     dr_cond_ids: dict[int, int] = field(default_factory=dict)  # id(CompiledDerivedRole) -> cond id
     has_outputs: bool = False
@@ -112,10 +114,14 @@ class LoweredTable:
     def _collect_paths(self) -> None:
         self.paths.clear()
         self.list_paths.clear()
+        self.ts_paths.clear()
         self.fallback_tags.clear()
+        self.uses_now = False
         for k in self.compiler.kernels:
             self.paths |= k.paths
             self.list_paths |= k.list_paths
+            self.ts_paths |= k.ts_paths
+            self.uses_now = self.uses_now or k.uses_now
             for p, tags in k.fallback_tags.items():
                 self.fallback_tags[p] = self.fallback_tags.get(p, frozenset()) | tags
             for spec in k.preds:

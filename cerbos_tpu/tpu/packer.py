@@ -108,6 +108,7 @@ class Packer:
         self._accessors: dict[tuple, Any] = {}
         self._pred_accessors: dict[int, list] = {}
         self._encode_cache: dict[Any, tuple] = {}
+        self._ts_memo: dict[Any, Any] = {}
 
     def invalidate(self) -> None:
         self._cand_cache.clear()
@@ -118,6 +119,7 @@ class Packer:
         self._accessors.clear()
         self._pred_accessors.clear()
         self._encode_cache.clear()
+        self._ts_memo.clear()
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
         key = (kind, scope, name, version, lenient)
@@ -533,6 +535,7 @@ class Packer:
         if native is not None and hasattr(native, "encode_column"):
             self._encode_columns_native(cb, plans, active, paths, native)
             self._encode_list_columns(cb, plans, active)
+            self._encode_ts_columns(cb, plans, active, params)
             self._encode_preds(cb, plans, active, params)
             return cb
         for p in paths:
@@ -586,8 +589,64 @@ class Packer:
             cb.tags[p], cb.his[p], cb.los[p], cb.sids[p], cb.nans[p] = t, h, l, s, nn
 
         self._encode_list_columns(cb, plans, active)
+        self._encode_ts_columns(cb, plans, active, params)
         self._encode_preds(cb, plans, active, params)
         return cb
+
+    def _encode_ts_columns(self, cb: ColumnBatch, plans, active, params) -> None:
+        """Parsed-timestamp key columns for paths used inside timestamp(...)
+        comparisons, plus the batch-constant now() key. Conversion is the CEL
+        runtime's own timestamp() overload set (columns.timestamp_key), so
+        device semantics match the oracle bit-exactly; unconvertible values
+        carry state 2 (a CEL error on device)."""
+        from .columns import timestamp_key
+
+        ts_paths = self.lt.ts_paths
+        if not ts_paths and not self.lt.uses_now:
+            return
+        B = cb.size
+        memo = self._ts_memo
+        for p in sorted(ts_paths):
+            accessor = self._path_accessor(p)
+            hi = np.zeros(B, dtype=np.int32)
+            lo = np.zeros(B, dtype=np.int32)
+            state = np.zeros(B, dtype=np.int8)
+            for bi, plan in active:
+                if plan.oracle:
+                    continue
+                v = accessor(plan.input)
+                if v is _MISSING_SENTINEL:
+                    continue  # state 0: the attribute access itself errors
+                try:
+                    mk = (type(v), v)
+                    enc = memo.get(mk)
+                except TypeError:
+                    mk, enc = None, None
+                if enc is None:
+                    try:
+                        enc = timestamp_key(v)
+                    except Exception:  # noqa: BLE001 — CEL would error on this value
+                        enc = "err"
+                    if mk is not None:
+                        if len(memo) > 65536:
+                            memo.clear()
+                        memo[mk] = enc
+                if enc == "err":
+                    state[bi] = 2
+                else:
+                    hi[bi], lo[bi] = enc
+                    state[bi] = 1
+            cb.ts_his[p], cb.ts_los[p], cb.ts_states[p] = hi, lo, state
+        now_fn = getattr(params, "now_fn", None)
+        if now_fn is not None:
+            now_val = now_fn()
+        else:
+            import datetime as _dt
+
+            now_val = _dt.datetime.now(_dt.timezone.utc).isoformat()
+        nh, nl = timestamp_key(now_val)
+        cb.now_hi = np.asarray(nh, dtype=np.int32)
+        cb.now_lo = np.asarray(nl, dtype=np.int32)
 
     def _encode_list_columns(self, cb: ColumnBatch, plans, active) -> None:
         """String-list membership columns: per path, pad each input's list of

