@@ -202,7 +202,10 @@ class AuditLog:
                 },
                 "output": {
                     "requestId": getattr(plan_input, "request_id", ""),
-                    "kind": getattr(plan_output, "kind", ""),
+                    "filter": {
+                        "kind": getattr(plan_output, "kind", ""),
+                        **({"condition": cond.to_json()} if cond is not None else {}),
+                    },
                     "filterDebug": cond.debug_str() if cond is not None else getattr(plan_output, "kind", ""),
                 },
             },
@@ -230,11 +233,21 @@ def register_backend(name: str, factory: Callable[[dict], Any]) -> None:
     _BACKENDS[name] = factory
 
 
+# backends living outside this module register on first use (the storage
+# registry's _LAZY_DRIVERS pattern)
+_LAZY_BACKENDS = {"remote": "cerbos_tpu.audit.remote", "kafka": "cerbos_tpu.audit.kafka"}
+
+
 def new_audit_log(conf: dict) -> Optional[AuditLog]:
     if not conf.get("enabled", False):
         return None
     backend_name = conf.get("backend", "local")
     factory = _BACKENDS.get(backend_name)
+    if factory is None and backend_name in _LAZY_BACKENDS:
+        import importlib
+
+        importlib.import_module(_LAZY_BACKENDS[backend_name])
+        factory = _BACKENDS.get(backend_name)
     if factory is None:
         raise ValueError(f"unknown audit backend {backend_name!r} (known: {sorted(_BACKENDS)})")
     backend = factory(conf.get(backend_name, {}))

@@ -319,7 +319,7 @@ class _Decoder:
         e = self.raw[idx]
         tag = e[0]
         if tag == "lit":
-            obj: Any = A.Lit(_dec_lit(e[1]))
+            obj: Any = A.Lit(_dec_value(e[1]))
         elif tag == "id":
             obj = A.Ident(e[1])
         elif tag == "sel":
@@ -357,15 +357,6 @@ class _Decoder:
         self.cache[idx] = obj
         self.done[idx] = True
         return obj
-
-
-def _dec_lit(v: Any) -> Any:
-    x = _dec_value(v)
-    # Lit list payloads decode as lists (parser emits only scalars, but a
-    # constant-folded literal could carry a container)
-    if isinstance(x, frozenset):
-        return x
-    return x
 
 
 def decode_compiled(blob: bytes) -> list[CompiledPolicy]:

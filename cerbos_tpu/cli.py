@@ -53,6 +53,7 @@ def _build_server(core, config, http_addr=None, grpc_addr=None, reuse_port=False
             grpc_listen_addr=grpc_addr or server_conf.get("grpcListenAddr", "0.0.0.0:3593"),
             tls_cert=tls.get("cert", ""),
             tls_key=tls.get("key", ""),
+            tls_watch_interval_s=float(tls.get("watchInterval", 5.0)),
             cors_disabled=bool(cors_conf.get("disabled", False)),
             cors_allowed_origins=tuple(cors_conf.get("allowedOrigins", []) or []),
             cors_allowed_headers=tuple(cors_conf.get("allowedHeaders", []) or []),
@@ -71,17 +72,28 @@ def cmd_server(args: argparse.Namespace) -> int:
     from .bootstrap import initialize
     from .config import Config
 
-    from .observability import close_exporter, init_otlp_from_env
+    from .observability import (
+        close_exporter,
+        close_metrics_exporter,
+        init_otlp_from_env,
+        init_otlp_metrics_from_env,
+        metrics_exporter,
+    )
 
     config = Config.load(args.config, overrides=args.set or [])
     server_conf = config.section("server")
+
+    def wire_metrics(core) -> None:
+        mx = metrics_exporter()
+        if mx is not None:
+            mx.add_source(core.service.metrics.snapshot)
 
     n_workers = int(getattr(args, "workers", 0) or server_conf.get("workers", 1) or 1)
     if n_workers > 1:
         # fork-after-load worker pool (engine.go:74-144 analogue): the pool
         # prints the serving line itself once ports are resolved. The OTLP
-        # exporter thread must start POST-fork (each worker exports its own
-        # spans; a pre-fork thread would not exist in the children)
+        # exporter threads must start POST-fork (each worker exports its own
+        # spans/metrics; a pre-fork thread would not exist in the children)
         from .server.workers import run_server_pool
 
         def announce(http_addr: str, grpc_addr: str) -> None:
@@ -92,17 +104,28 @@ def cmd_server(args: argparse.Namespace) -> int:
                 flush=True,
             )
 
+        def post_fork() -> None:
+            init_otlp_from_env()
+            init_otlp_metrics_from_env()
+
+        def pre_exit() -> None:
+            close_exporter()
+            close_metrics_exporter()
+
         return run_server_pool(
             config,
             n_workers,
             _build_server,
             announce=announce,
-            post_fork=init_otlp_from_env,
-            pre_exit=close_exporter,
+            post_fork=post_fork,
+            post_init=wire_metrics,
+            pre_exit=pre_exit,
         )
 
     init_otlp_from_env()  # OTEL_EXPORTER_OTLP_ENDPOINT et al (ref: otel.go)
+    init_otlp_metrics_from_env()
     core = initialize(config)
+    wire_metrics(core)
     server = _build_server(core, config)
     server.start()
     print(f"cerbos-tpu serving: http={server.http_port} grpc={server.grpc_port}", flush=True)
@@ -114,6 +137,7 @@ def cmd_server(args: argparse.Namespace) -> int:
         server.stop()
         core.close()
         close_exporter()  # drain buffered OTLP spans
+        close_metrics_exporter()
     return 0
 
 

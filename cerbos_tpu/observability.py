@@ -232,3 +232,113 @@ def start_span(name: str, **attributes: Any) -> Iterator[Span]:
         else:
             _current[tid] = parent
         _exporter.export(span, (time.perf_counter() - span.start) * 1000)
+
+
+class OTLPMetricsExporter:
+    """OTLP/HTTP JSON metrics exporter (ref: internal/observability/metrics —
+    the reference exports OTel metrics; Prometheus scrape stays at
+    /_cerbos/metrics, this pushes the same series to an OTLP collector).
+    Metric sources are callables returning {name: value}; gauges snapshot on
+    a background interval and POST to {endpoint}/v1/metrics. Export failures
+    drop the snapshot — metrics must never block serving."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        service_name: str = "cerbos-tpu",
+        interval_s: float = 15.0,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self._sources: list[Any] = []
+        self._stop = threading.Event()
+        self._interval = interval_s
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="otlp-metrics")
+        self._thread.start()
+
+    def add_source(self, fn) -> None:
+        self._sources.append(fn)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.flush()
+
+    def collect(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for fn in list(self._sources):
+            try:
+                out.update(fn())
+            except Exception:  # noqa: BLE001
+                logging.getLogger("cerbos_tpu.metrics").debug("metrics source failed", exc_info=True)
+        return out
+
+    def flush(self) -> None:
+        series = self.collect()
+        if not series:
+            return
+        now_ns = str(time.time_ns())
+        metrics = [
+            {
+                "name": name,
+                "gauge": {"dataPoints": [{"asDouble": float(v), "timeUnixNano": now_ns}]},
+            }
+            for name, v in sorted(series.items())
+        ]
+        payload = json.dumps(
+            {
+                "resourceMetrics": [
+                    {
+                        "resource": {
+                            "attributes": [
+                                {"key": "service.name", "value": {"stringValue": self.service_name}}
+                            ]
+                        },
+                        "scopeMetrics": [{"scope": {"name": "cerbos_tpu"}, "metrics": metrics}],
+                    }
+                ]
+            }
+        ).encode()
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.endpoint}/v1/metrics",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception as e:  # noqa: BLE001
+            logging.getLogger("cerbos_tpu.metrics").debug("otlp metrics export failed: %s", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        self.flush()
+
+
+_metrics_exporter: "OTLPMetricsExporter | None" = None
+
+
+def init_otlp_metrics_from_env() -> "OTLPMetricsExporter | None":
+    """OTEL_EXPORTER_OTLP_METRICS_ENDPOINT / OTEL_EXPORTER_OTLP_ENDPOINT."""
+    global _metrics_exporter
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT") or os.environ.get(
+        "OTEL_EXPORTER_OTLP_ENDPOINT"
+    )
+    if not endpoint:
+        return None
+    _metrics_exporter = OTLPMetricsExporter(
+        endpoint, service_name=os.environ.get("OTEL_SERVICE_NAME", "cerbos-tpu")
+    )
+    return _metrics_exporter
+
+
+def metrics_exporter() -> "OTLPMetricsExporter | None":
+    return _metrics_exporter
+
+
+def close_metrics_exporter() -> None:
+    global _metrics_exporter
+    if _metrics_exporter is not None:
+        _metrics_exporter.close()
+        _metrics_exporter = None

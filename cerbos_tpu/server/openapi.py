@@ -1,0 +1,177 @@
+"""OpenAPI document + API explorer for the HTTP surface.
+
+Behavioral reference: internal/server/server.go:441-447 — the reference
+serves the grpc-gateway-generated Swagger v2 document at
+``/schema/swagger.json`` and an API-explorer UI at ``/``. The document here
+is hand-maintained over the same route surface (this build has no
+grpc-gateway); the explorer is a self-contained page (no CDN assets — the
+deployment targets may have zero egress).
+"""
+
+from __future__ import annotations
+
+from .. import __version__
+
+_CHECK_INPUT = {
+    "type": "object",
+    "properties": {
+        "requestId": {"type": "string"},
+        "includeMeta": {"type": "boolean"},
+        "principal": {"$ref": "#/definitions/Principal"},
+        "resources": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "actions": {"type": "array", "items": {"type": "string"}},
+                    "resource": {"$ref": "#/definitions/Resource"},
+                },
+            },
+        },
+        "auxData": {"$ref": "#/definitions/AuxData"},
+    },
+}
+
+
+def build_swagger() -> dict:
+    """Swagger v2 document over the served HTTP routes."""
+
+    def op(summary: str, body_schema=None, tag: str = "CerbosService", params=None):
+        o: dict = {"summary": summary, "tags": [tag], "produces": ["application/json"],
+                   "responses": {"200": {"description": "Success"}}}
+        if body_schema is not None:
+            o["consumes"] = ["application/json"]
+            o["parameters"] = [
+                {"name": "body", "in": "body", "required": True, "schema": body_schema}
+            ]
+        if params:
+            o.setdefault("parameters", []).extend(params)
+        return o
+
+    plan_body = {
+        "type": "object",
+        "properties": {
+            "requestId": {"type": "string"},
+            "action": {"type": "string"},
+            "actions": {"type": "array", "items": {"type": "string"}},
+            "principal": {"$ref": "#/definitions/Principal"},
+            "resource": {"$ref": "#/definitions/Resource"},
+            "includeMeta": {"type": "boolean"},
+            "auxData": {"$ref": "#/definitions/AuxData"},
+        },
+    }
+
+    return {
+        "swagger": "2.0",
+        "info": {
+            "title": "Cerbos-compatible TPU PDP",
+            "version": __version__,
+            "description": "Policy decision point API (CheckResources / PlanResources and companions).",
+        },
+        "basePath": "/",
+        "schemes": ["http", "https"],
+        "paths": {
+            "/api/check/resources": {"post": op("Check access to resources", _CHECK_INPUT)},
+            "/api/plan/resources": {"post": op("Produce a query plan for a resource kind", plan_body)},
+            "/api/check": {"post": op("Deprecated: CheckResourceSet", {"type": "object"})},
+            "/api/x/check_resource_batch": {"post": op("Deprecated: CheckResourceBatch", {"type": "object"})},
+            "/api/server_info": {"get": op("Server version information")},
+            "/_cerbos/health": {"get": op("Health probe", tag="Health")},
+            "/_cerbos/metrics": {"get": op("Prometheus metrics", tag="Health")},
+            "/admin/policies": {
+                "get": op("List policy ids", tag="CerbosAdminService"),
+                "post": op("Add or update policies", {"type": "object"}, tag="CerbosAdminService"),
+            },
+            "/admin/policy": {"get": op("Fetch policy definitions", tag="CerbosAdminService")},
+            "/admin/schemas": {
+                "get": op("List schema ids", tag="CerbosAdminService"),
+                "post": op("Add or update schemas", {"type": "object"}, tag="CerbosAdminService"),
+            },
+            "/admin/store/reload": {"get": op("Reload the policy store", tag="CerbosAdminService")},
+            "/access/v1/evaluation": {"post": op("AuthZen access evaluation", {"type": "object"}, tag="AuthZen")},
+            "/access/v1/evaluations": {"post": op("AuthZen batched evaluations", {"type": "object"}, tag="AuthZen")},
+        },
+        "definitions": {
+            "Principal": {
+                "type": "object",
+                "properties": {
+                    "id": {"type": "string"},
+                    "roles": {"type": "array", "items": {"type": "string"}},
+                    "attr": {"type": "object"},
+                    "policyVersion": {"type": "string"},
+                    "scope": {"type": "string"},
+                },
+            },
+            "Resource": {
+                "type": "object",
+                "properties": {
+                    "kind": {"type": "string"},
+                    "id": {"type": "string"},
+                    "attr": {"type": "object"},
+                    "policyVersion": {"type": "string"},
+                    "scope": {"type": "string"},
+                },
+            },
+            "AuxData": {
+                "type": "object",
+                "properties": {
+                    "jwt": {
+                        "type": "object",
+                        "properties": {
+                            "token": {"type": "string"},
+                            "keySetId": {"type": "string"},
+                        },
+                    }
+                },
+            },
+        },
+    }
+
+
+EXPLORER_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>Cerbos TPU PDP — API explorer</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 60rem; color: #1a1a2e; }
+ h1 { font-size: 1.4rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ .op { border: 1px solid #d0d0e0; border-radius: 6px; padding: .6rem .9rem; margin: .5rem 0; }
+ .method { display: inline-block; min-width: 3.5rem; font-weight: 700; }
+ .get { color: #0a7d42; } .post { color: #1452cc; }
+ code { background: #f2f2f8; padding: .1rem .3rem; border-radius: 4px; }
+ small { color: #555; }
+</style>
+</head>
+<body>
+<h1>Cerbos-compatible TPU PDP</h1>
+<p>Full machine-readable spec: <a href="/schema/swagger.json">/schema/swagger.json</a></p>
+<div id="ops">loading…</div>
+<script>
+fetch('/schema/swagger.json').then(r => r.json()).then(doc => {
+  const groups = {};
+  for (const [path, methods] of Object.entries(doc.paths)) {
+    for (const [method, op] of Object.entries(methods)) {
+      const tag = (op.tags || ['API'])[0];
+      (groups[tag] = groups[tag] || []).push({path, method, op});
+    }
+  }
+  const root = document.getElementById('ops');
+  root.innerHTML = '';
+  for (const [tag, ops] of Object.entries(groups)) {
+    const h = document.createElement('h2');
+    h.textContent = tag;
+    root.appendChild(h);
+    for (const {path, method, op} of ops) {
+      const d = document.createElement('div');
+      d.className = 'op';
+      d.innerHTML = `<span class="method ${method}">${method.toUpperCase()}</span>` +
+        `<code>${path}</code><br><small>${op.summary || ''}</small>`;
+      root.appendChild(d);
+    }
+  }
+});
+</script>
+</body>
+</html>
+"""

@@ -404,6 +404,9 @@ class Server:
         app.router.add_get("/_cerbos/health", self._h_health)
         app.router.add_get("/_cerbos/metrics", self._h_metrics)
         app.router.add_get("/api/server_info", self._h_server_info)
+        # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
+        app.router.add_get("/schema/swagger.json", self._h_swagger)
+        app.router.add_get("/", self._h_explorer)
         if self.admin_service is not None:
             self.admin_service.add_http_routes(app)
         for svc in self.extra_services:
@@ -412,6 +415,16 @@ class Server:
 
     async def _h_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "SERVING"})
+
+    async def _h_swagger(self, request: web.Request) -> web.Response:
+        from .openapi import build_swagger
+
+        return web.json_response(build_swagger())
+
+    async def _h_explorer(self, request: web.Request) -> web.Response:
+        from .openapi import EXPLORER_HTML
+
+        return web.Response(text=EXPLORER_HTML, content_type="text/html")
 
     async def _h_server_info(self, request: web.Request) -> web.Response:
         return web.json_response(self.svc.server_info())
@@ -608,6 +621,8 @@ class Server:
                 site: web.BaseSite = web.UnixSite(runner, addr[len("unix:"):], ssl_context=ssl_ctx)
             else:
                 host, _, port = addr.rpartition(":")
+                if host.startswith("[") and host.endswith("]"):
+                    host = host[1:-1]  # bracketed IPv6 → bare for getaddrinfo
                 site = web.TCPSite(
                     runner,
                     host or "0.0.0.0",

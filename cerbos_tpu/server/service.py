@@ -43,6 +43,23 @@ class ServiceMetrics:
             del self.check_latency_ms[:5000]
             del self.batch_sizes[:5000]
 
+    def snapshot(self) -> dict[str, float]:
+        """Gauge snapshot for the OTLP metrics exporter (the same series the
+        Prometheus handler renders — metrics.go:129-147 analogues)."""
+        lat = sorted(self.check_latency_ms)
+
+        def pct(p: float) -> float:
+            return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+        return {
+            "cerbos_dev_engine_check_count": float(self.check_count),
+            "cerbos_dev_engine_plan_count": float(self.plan_count),
+            "cerbos_dev_engine_check_latency_ms_p50": pct(0.50),
+            "cerbos_dev_engine_check_latency_ms_p95": pct(0.95),
+            "cerbos_dev_engine_check_latency_ms_p99": pct(0.99),
+            "cerbos_dev_engine_check_batch_size_total": float(sum(self.batch_sizes)),
+        }
+
 
 class CerbosService:
     def __init__(

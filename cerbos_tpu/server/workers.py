@@ -153,6 +153,7 @@ def run_server_pool(
     use_tpu: Optional[bool] = None,
     announce=None,
     post_fork: Optional[Callable[[], None]] = None,
+    post_init: Optional[Callable[[object], None]] = None,
     pre_exit: Optional[Callable[[], None]] = None,
 ) -> int:
     """Boot a pool of full PDP servers from one prebuilt core.
@@ -197,6 +198,8 @@ def run_server_pool(
         # up, and this worker's fresh store snapshot won't re-emit events
         # for already-applied changes)
         core = initialize(config, use_tpu=use_tpu, prebuilt=None if respawn else prebuilt)
+        if post_init is not None:
+            post_init(core)
         server = build_server(core, config, http_addr, grpc_addr, True)
         try:
             if not stop["flag"]:

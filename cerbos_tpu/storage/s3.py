@@ -66,7 +66,15 @@ def sigv4_headers(
     for k, v in (extra_headers or {}).items():
         headers[k.lower()] = v
 
-    canonical_uri = _uri_encode(parsed.path or "/", encode_slash=False)
+    # S3's encode-once rule: the canonical URI is the path AS SENT (callers
+    # percent-encode key segments once when building the URL); re-encoding
+    # here would double-encode and break the signature for any key with
+    # spaces/unicode. Non-S3 services (e.g. the iam test vector) use the
+    # generic double-encode rule.
+    if service == "s3":
+        canonical_uri = parsed.path or "/"
+    else:
+        canonical_uri = _uri_encode(parsed.path or "/", encode_slash=False)
     # canonical query: sorted by key, values URI-encoded
     query_pairs = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
     canonical_query = "&".join(

@@ -3,6 +3,7 @@
 # option, so absolute generated imports are rewritten to live under
 # cerbos_tpu.api).
 set -e
-protoc -I api --python_out=cerbos_tpu/api api/cerbos/*/v1/*.proto
+protoc -I api --python_out=cerbos_tpu/api api/cerbos/*/v1/*.proto api/authzen/*/v1/*.proto
 find cerbos_tpu/api -type d -exec touch {}/__init__.py \;
 sed -i 's/^from cerbos\./from cerbos_tpu.api.cerbos./' cerbos_tpu/api/cerbos/*/v1/*_pb2.py
+sed -i 's/^from authzen\./from cerbos_tpu.api.authzen./' cerbos_tpu/api/authzen/*/v1/*_pb2.py

@@ -479,7 +479,8 @@ class TestPlanAudit:
         pr = e["planResources"]
         assert pr["input"]["principal"]["id"] == "alice"
         assert pr["input"]["resource"]["kind"] == "doc"
-        assert pr["output"]["kind"] == "KIND_CONDITIONAL"
+        assert pr["output"]["filter"]["kind"] == "KIND_CONDITIONAL"
+        assert "condition" in pr["output"]["filter"]  # machine-readable operand tree
         assert "filterDebug" in pr["output"]
         ep = e["auditTrail"]["effectivePolicies"]
         assert "resource.doc.vdefault" in ep

@@ -150,6 +150,16 @@ def test_list_pagination(fake_s3):
     assert len(_client(fake_s3).list_objects()) == 3
 
 
+def test_keys_needing_percent_encoding(fake_s3):
+    """S3's encode-once rule: keys with spaces/unicode must sign over the
+    path AS SENT, not a re-encoded (double-encoded) form."""
+    fake_s3.objects["policies/a b ü.yaml"] = b"data: 1"
+    c = _client(fake_s3)
+    assert c.get_object("policies/a b ü.yaml") == b"data: 1"
+    keys = [o.key for o in c.list_objects("policies/")]
+    assert "policies/a b ü.yaml" in keys
+
+
 def test_blob_store_syncs_from_s3(fake_s3, tmp_path):
     store = BlobStore(
         bucket_url=f"s3://{fake_s3.bucket}",

@@ -616,3 +616,108 @@ class TestCORS:
         )
         with urllib.request.urlopen(req) as resp:
             assert resp.headers["Access-Control-Allow-Origin"] == "*"
+
+
+class TestOpenAPI:
+    def test_swagger_document(self, server):
+        doc = json.loads(http_get(server, "/schema/swagger.json"))
+        assert doc["swagger"] == "2.0"
+        assert "/api/check/resources" in doc["paths"]
+        assert "/api/plan/resources" in doc["paths"]
+        assert "/admin/policies" in doc["paths"]
+        assert "Principal" in doc["definitions"]
+
+    def test_api_explorer(self, server):
+        html = http_get(server, "/").decode()
+        assert "/schema/swagger.json" in html
+        assert "<html" in html
+
+
+class TestOtlpMetrics:
+    def test_export_posts_gauges(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from cerbos_tpu.observability import OTLPMetricsExporter
+        from cerbos_tpu.server.service import ServiceMetrics
+
+        received = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers.get("Content-Length", "0")))
+                received.append((self.path, json.loads(body)))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            m = ServiceMetrics()
+            m.record_check(1.5, 2)
+            m.record_check(3.5, 1)
+            mx = OTLPMetricsExporter(f"http://127.0.0.1:{httpd.server_address[1]}", interval_s=3600)
+            mx.add_source(m.snapshot)
+            mx.close()  # close flushes
+            assert received and received[0][0] == "/v1/metrics"
+            metrics = received[0][1]["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+            by_name = {x["name"]: x["gauge"]["dataPoints"][0]["asDouble"] for x in metrics}
+            assert by_name["cerbos_dev_engine_check_count"] == 2.0
+            assert by_name["cerbos_dev_engine_check_batch_size_total"] == 3.0
+        finally:
+            httpd.shutdown()
+
+
+class TestAuditProtos:
+    def test_decision_log_entry_wire_shape(self):
+        """Audit proto family is wire-compatible: DecisionLogEntry round-trips
+        with the reference's field numbers (audit.proto)."""
+        from google.protobuf import json_format
+
+        from cerbos_tpu.api.cerbos.audit.v1 import audit_pb2
+
+        e = audit_pb2.DecisionLogEntry(call_id="01HXYZ")
+        e.peer.address = "10.0.0.1"
+        e.check_resources.inputs.add(request_id="r1")
+        e.audit_trail.effective_policies["resource.doc.vdefault"].attributes["source"].string_value = "doc.yaml"
+        raw = e.SerializeToString()
+        back = audit_pb2.DecisionLogEntry.FromString(raw)
+        assert back.call_id == "01HXYZ"
+        assert back.WhichOneof("method") == "check_resources"
+        j = json_format.MessageToDict(back)
+        assert j["auditTrail"]["effectivePolicies"]["resource.doc.vdefault"]["attributes"]["source"] == "doc.yaml"
+
+    def test_telemetry_proto_shape(self):
+        from cerbos_tpu.api.cerbos.telemetry.v1 import telemetry_pb2
+
+        launch = telemetry_pb2.ServerLaunch(version="1.0")
+        launch.features.storage.driver = "disk"
+        launch.features.storage.disk.watch = True
+        launch.stats.policy.count["RESOURCE"] = 9
+        back = telemetry_pb2.ServerLaunch.FromString(launch.SerializeToString())
+        assert back.features.storage.WhichOneof("store") == "disk"
+        assert back.stats.policy.count["RESOURCE"] == 9
+
+
+class TestAuthZenProtos:
+    def test_authzen_wire_shapes(self):
+        from google.protobuf import json_format
+
+        from cerbos_tpu.api.authzen.authorization.v1 import evaluation_pb2
+
+        req = evaluation_pb2.AccessEvaluationRequest()
+        req.subject.type = "user"
+        req.subject.id = "alice"
+        req.resource.type = "doc"
+        req.action.name = "view"
+        back = evaluation_pb2.AccessEvaluationRequest.FromString(req.SerializeToString())
+        assert back.subject.id == "alice"
+        # AuthZEN wire JSON uses snake_case metadata field names (json_name)
+        meta = evaluation_pb2.MetadataResponse(access_evaluation_endpoint="/access/v1/evaluation")
+        j = json_format.MessageToDict(meta)
+        assert j == {"access_evaluation_endpoint": "/access/v1/evaluation"}
