@@ -94,6 +94,16 @@ class PackedBatch:
     D: int
 
 
+
+def _memo_put(memo: dict, key, val):
+    """Bounded memo insert: wholesale clear past the cap (simple, O(1)
+    amortized; the caches re-warm in one batch)."""
+    if len(memo) > 65536:
+        memo.clear()
+    memo[key] = val
+    return val
+
+
 class Packer:
     def __init__(self, lowered: LoweredTable, max_roles: int = 8, max_candidates: int = 32, max_depth: int = 8):
         self.lt = lowered
@@ -109,6 +119,8 @@ class Packer:
         self._pred_accessors: dict[int, list] = {}
         self._encode_cache: dict[Any, tuple] = {}
         self._ts_memo: dict[Any, Any] = {}
+        self._list_memo: dict[Any, list[int]] = {}
+        self._plan_memo: dict[tuple, tuple] = {}
 
     def invalidate(self) -> None:
         self._cand_cache.clear()
@@ -120,6 +132,8 @@ class Packer:
         self._pred_accessors.clear()
         self._encode_cache.clear()
         self._ts_memo.clear()
+        self._list_memo.clear()
+        self._plan_memo.clear()
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
         key = (kind, scope, name, version, lenient)
@@ -231,37 +245,54 @@ class Packer:
     def pack(self, inputs: list[T.CheckInput], params: T.EvalParams) -> PackedBatch:
         rt = self.lt.table
         plans: list[InputPlan] = []
+        # plan SKELETONS (everything except the input reference) depend only
+        # on (principal id/scope/version, resource kind/scope/version, roles)
+        # — a handful of distinct shapes per corpus, memoized across batches
+        plan_memo = self._plan_memo
+        lenient = params.lenient_scope_search
         for inp in inputs:
-            principal_scope = T.effective_scope(inp.principal.scope, params)
-            principal_version = T.effective_version(inp.principal.policy_version, params)
-            resource_scope = T.effective_scope(inp.resource.scope, params)
-            resource_version = T.effective_version(inp.resource.policy_version, params)
-            p_scopes, p_key, _p_fqn = self._get_all_scopes(
-                KIND_PRINCIPAL, principal_scope, inp.principal.id, principal_version, params.lenient_scope_search
+            sk = (
+                inp.principal.id, inp.principal.scope, inp.principal.policy_version,
+                inp.resource.kind, inp.resource.scope, inp.resource.policy_version,
+                tuple(inp.principal.roles), lenient,
+                params.default_scope, params.default_policy_version,
             )
-            r_scopes, r_key, r_fqn = self._get_all_scopes(
-                KIND_RESOURCE, resource_scope, inp.resource.kind, resource_version, params.lenient_scope_search
-            )
-            plan = InputPlan(
+            hit = plan_memo.get(sk)
+            if hit is None:
+                principal_scope = T.effective_scope(inp.principal.scope, params)
+                principal_version = T.effective_version(inp.principal.policy_version, params)
+                resource_scope = T.effective_scope(inp.resource.scope, params)
+                resource_version = T.effective_version(inp.resource.policy_version, params)
+                p_scopes, p_key, _p_fqn = self._get_all_scopes(
+                    KIND_PRINCIPAL, principal_scope, inp.principal.id, principal_version, lenient
+                )
+                r_scopes, r_key, r_fqn = self._get_all_scopes(
+                    KIND_RESOURCE, resource_scope, inp.resource.kind, resource_version, lenient
+                )
+                sp_exists = self._exists(KIND_PRINCIPAL, principal_version, "", p_scopes)
+                sr_exists = self._exists(
+                    KIND_RESOURCE, resource_version, namer.sanitize(inp.resource.kind), r_scopes
+                )
+                roles = list(inp.principal.roles)
+                trivial = (not p_scopes and not r_scopes) or (not sp_exists and not sr_exists)
+                oracle = len(roles) > self.K or len(p_scopes) > self.D or len(r_scopes) > self.D
+                hit = _memo_put(plan_memo, sk, (
+                    p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists, roles, trivial, oracle,
+                ))
+            p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists, roles, trivial, oracle = hit
+            plans.append(InputPlan(
                 input=inp,
                 principal_scopes=p_scopes,
                 resource_scopes=r_scopes,
                 principal_policy_key=p_key,
                 resource_policy_key=r_key,
                 resource_policy_fqn=r_fqn,
-                scoped_principal_exists=self._exists(KIND_PRINCIPAL, principal_version, "", p_scopes),
-                scoped_resource_exists=self._exists(
-                    KIND_RESOURCE, resource_version, namer.sanitize(inp.resource.kind), r_scopes
-                ),
-                roles=list(inp.principal.roles),
-            )
-            if not p_scopes and not r_scopes:
-                plan.trivial = True
-            elif not plan.scoped_principal_exists and not plan.scoped_resource_exists:
-                plan.trivial = True
-            if len(plan.roles) > self.K or len(p_scopes) > self.D or len(r_scopes) > self.D:
-                plan.oracle = True
-            plans.append(plan)
+                scoped_principal_exists=sp_exists,
+                scoped_resource_exists=sr_exists,
+                roles=roles,
+                trivial=trivial,
+                oracle=oracle,
+            ))
 
         # Per-(input, action) candidate cells, memoized by shape key. The cell
         # block for one (version, kind, chains, roles, action, pid) tuple is
@@ -628,9 +659,7 @@ class Packer:
                     except Exception:  # noqa: BLE001 — CEL would error on this value
                         enc = "err"
                     if mk is not None:
-                        if len(memo) > 65536:
-                            memo.clear()
-                        memo[mk] = enc
+                        _memo_put(memo, mk, enc)
                 if enc == "err":
                     state[bi] = 2
                 else:
@@ -651,12 +680,17 @@ class Packer:
     def _encode_list_columns(self, cb: ColumnBatch, plans, active) -> None:
         """String-list membership columns: per path, pad each input's list of
         interned sids to the batch max length; non-lists / non-string
-        elements error (state 2), missing attrs are state 0."""
+        elements error (state 2), missing attrs are state 0.
+
+        Interned sid vectors memoize per concrete list value — request
+        corpora repeat a small set of role/location lists, so the per-
+        element intern loop runs once per distinct list, not per input."""
         B = cb.size
         interner = self.lt.interner
+        memo = self._list_memo
         for p in sorted(self.lt.list_paths):
             accessor = self._path_accessor(p)
-            per_input: list[list[int]] = [[] for _ in range(B)]
+            per_input: list[Optional[list[int]]] = [None] * B
             state = np.zeros(B, dtype=np.int8)
             max_len = 1
             for bi, plan in active:
@@ -674,17 +708,26 @@ class Packer:
                 if not isinstance(v, list):
                     state[bi] = 2
                     continue
-                sids = []
-                for el in v:
-                    if isinstance(el, str):
-                        sids.append(interner.intern(el))
-                    else:
-                        # a non-string element can never equal the string
-                        # constant; slot 0 (reserved) never matches
-                        sids.append(0)
+                try:
+                    mk = tuple(v)
+                    sids = memo.get(mk)
+                except TypeError:
+                    mk, sids = None, None
+                if sids is None:
+                    sids = []
+                    for el in v:
+                        if isinstance(el, str):
+                            sids.append(interner.intern(el))
+                        else:
+                            # a non-string element can never equal the string
+                            # constant; slot 0 (reserved) never matches
+                            sids.append(0)
+                    if mk is not None:
+                        _memo_put(memo, mk, sids)
                 state[bi] = 1
                 per_input[bi] = sids
-                max_len = max(max_len, len(sids))
+                if len(sids) > max_len:
+                    max_len = len(sids)
             # bucket the list axis so jit traces are reused across batches
             # with different max lengths
             max_len = _pow2(max(max_len, 4))
