@@ -121,6 +121,7 @@ class Packer:
         self._ts_memo: dict[Any, Any] = {}
         self._list_memo: dict[Any, list[int]] = {}
         self._plan_memo: dict[tuple, tuple] = {}
+        self._padded_block_cache: dict[tuple, tuple] = {}
 
     def invalidate(self) -> None:
         self._cand_cache.clear()
@@ -134,6 +135,7 @@ class Packer:
         self._ts_memo.clear()
         self._list_memo.clear()
         self._plan_memo.clear()
+        self._padded_block_cache.clear()
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
         key = (kind, scope, name, version, lenient)
@@ -401,26 +403,33 @@ class Packer:
         padded_arrays: list[tuple] = []
         block_ids = np.empty(BA, dtype=np.int32)
         cand_entries: list[list[list[Optional[CandEntry]]]] = []
+        # the padded (K, J) form of a block is reusable across batches while
+        # K/J stay at the same buckets — cached per block identity (cell
+        # blocks themselves live in _cell_cache, so id() is stable)
+        pad_cache = self._padded_block_cache
         for ci, blk in enumerate(blocks):
             key = id(blk)
             uid = unique_padded.get(key)
             if uid is None:
                 uid = len(padded_arrays)
                 unique_padded[key] = uid
-                kk, jj = blk[0].shape
-                pc = np.full((K, J), -1, dtype=np.int32)
-                pd = np.full((K, J), -1, dtype=np.int32)
-                pe = np.zeros((K, J), dtype=np.int8)
-                pp = np.zeros((K, J), dtype=np.int8)
-                pdep = np.full((K, J), -1, dtype=np.int8)
-                pv = np.zeros((K, J), dtype=bool)
-                pc[:kk, :jj] = blk[0]
-                pd[:kk, :jj] = blk[1]
-                pe[:kk, :jj] = blk[2]
-                pp[:kk, :jj] = blk[3]
-                pdep[:kk, :jj] = blk[4]
-                pv[:kk, :jj] = blk[5]
-                padded_arrays.append((pc, pd, pe, pp, pdep, pv))
+                cached = pad_cache.get((key, K, J))
+                if cached is None:
+                    kk, jj = blk[0].shape
+                    pc = np.full((K, J), -1, dtype=np.int32)
+                    pd = np.full((K, J), -1, dtype=np.int32)
+                    pe = np.zeros((K, J), dtype=np.int8)
+                    pp = np.zeros((K, J), dtype=np.int8)
+                    pdep = np.full((K, J), -1, dtype=np.int8)
+                    pv = np.zeros((K, J), dtype=bool)
+                    pc[:kk, :jj] = blk[0]
+                    pd[:kk, :jj] = blk[1]
+                    pe[:kk, :jj] = blk[2]
+                    pp[:kk, :jj] = blk[3]
+                    pdep[:kk, :jj] = blk[4]
+                    pv[:kk, :jj] = blk[5]
+                    cached = _memo_put(pad_cache, (key, K, J), (pc, pd, pe, pp, pdep, pv))
+                padded_arrays.append(cached)
             block_ids[ci] = uid
             cand_entries.append(blocks[ci][6])
         if padded_arrays:
