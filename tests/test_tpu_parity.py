@@ -553,3 +553,73 @@ def test_now_only_condition_gets_now_key(mode):
     # the mixed ts-vs-untyped comparison fell back to a predicate, not an
     # orphaned ts column: no ts path may be registered for it
     assert ("resource", "attr", "deadline") not in ev.lowered.ts_paths
+
+
+TS_FUZZ_POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: event
+  version: default
+  rules:
+    - actions: ["rsvp"]
+      effect: EFFECT_ALLOW
+      roles: [member]
+      condition:
+        match:
+          all:
+            of:
+              - expr: timestamp(R.attr.startsAt) > now()
+              - expr: R.attr.venue >= "m" || "vip" in R.attr.tags
+    - actions: ["recap"]
+      effect: EFFECT_ALLOW
+      roles: [member]
+      condition:
+        match:
+          expr: timestamp(R.attr.startsAt) <= timestamp(R.attr.endsAt) && !(timestamp(R.attr.endsAt) > now())
+    - actions: ["archive"]
+      effect: EFFECT_DENY
+      roles: ["*"]
+      condition:
+        match:
+          expr: timestamp(R.attr.startsAt) > timestamp("2030-01-01T00:00:00Z")
+"""
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_fuzz_timestamp_string_list_parity(mode):
+    """Random mixes over the round-3 device features — timestamp key
+    columns, now(), string-ordering predicates, list membership — including
+    malformed/missing values, must match the oracle exactly."""
+    import datetime
+
+    from cerbos_tpu.cel.values import Timestamp
+
+    rng = random.Random(7)
+    rt = table_for(TS_FUZZ_POLICIES)
+    now = Timestamp.from_datetime(datetime.datetime(2025, 6, 1, tzinfo=datetime.timezone.utc))
+    params = EvalParams(now_fn=lambda: now)
+    ts_pool = [
+        "2024-01-01T00:00:00Z", "2025-06-01T00:00:00Z", "2025-06-01T00:00:01Z",
+        "2031-05-05T10:00:00+02:00", "1999-12-31T23:59:59.999Z",
+        "garbage", 1717286400, None, 3.5, True,
+    ]
+    inputs = []
+    for i in range(200):
+        attr = {}
+        s = rng.choice(ts_pool)
+        e = rng.choice(ts_pool)
+        if s is not None:
+            attr["startsAt"] = s
+        if e is not None:
+            attr["endsAt"] = e
+        if rng.random() < 0.7:
+            attr["venue"] = rng.choice(["metro hall", "annex", "zoo", "", 42])
+        if rng.random() < 0.6:
+            attr["tags"] = rng.choice([["vip"], ["open", "vip"], ["open"], [], "vip", [1, "vip"]])
+        inputs.append(CheckInput(
+            principal=Principal(id=f"p{i%5}", roles=rng.sample(["member", "guest"], k=rng.randint(1, 2))),
+            resource=Resource(kind="event", id=f"e{i}", attr=attr),
+            actions=rng.sample(["rsvp", "recap", "archive"], k=rng.randint(1, 3)),
+        ))
+    ev = assert_parity(rt, inputs, params=params, mode=mode)
+    assert ev.stats["device_inputs"] >= 150, ev.stats
