@@ -107,6 +107,8 @@ class KernelGroup:
     emit: Callable[["Refs", "GroupConsts"], Any]  # -> sat [B, G]
     gc: "GroupConsts"
     cond_ids: list[int]
+    # ndarray form for per-batch active-mask lookups (hot path)
+    cond_id_arr: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.int64))
 
 
 class GroupConsts:
@@ -1024,7 +1026,10 @@ class ConditionSetCompiler:
                 self.kernels[cids[0]].slot_kinds,
                 [self.kernels[c].slot_values for c in cids],
             )
-            self.groups.append(KernelGroup(emit=self._template_emits[cids[0]], gc=gc, cond_ids=cids))
+            self.groups.append(KernelGroup(
+                emit=self._template_emits[cids[0]], gc=gc, cond_ids=cids,
+                cond_id_arr=np.asarray(cids, dtype=np.int64),
+            ))
             order.extend(cids)
         # column permutation: concatenated group output order -> cond_id order
         C = len(self.kernels)

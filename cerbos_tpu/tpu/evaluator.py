@@ -83,11 +83,17 @@ def _compute(
     ts_states=None,
     now_hi=None,
     now_lo=None,
+    active_mask=None,
 ):
     """Pure array computation: jittable with `xp=jnp`, testable with numpy.
 
     Returns (final [BA,4], role_results [BA,K,2,2], win_j [BA,K,2],
     sat_cond [B,C]) — see module docstring for the lattice.
+
+    ``active_mask`` (numpy bool [C], eager path only — it would make the
+    traced graph batch-dependent) marks condition ids this batch actually
+    reads (candidates + derived roles); template groups with no active
+    member skip their kernels and contribute zeros.
     """
     refs = Refs(xp, tags, his, los, sids, nans, pred_vals, pred_errs,
                 list_sids=list_sids, list_states=list_states,
@@ -103,7 +109,12 @@ def _compute(
     compiler.build_groups()
     C = len(compiler.kernels)
     if C:
-        blocks = [xp.broadcast_to(g.emit(refs, g.gc), (B, g.gc.size)) for g in compiler.groups]
+        blocks = [
+            xp.zeros((B, g.gc.size), dtype=bool)
+            if active_mask is not None and not active_mask[g.cond_id_arr].any()
+            else xp.broadcast_to(g.emit(refs, g.gc), (B, g.gc.size))
+            for g in compiler.groups
+        ]
         if blocks:
             allsat = xp.concatenate(blocks, axis=1)
             sat_cond = allsat[:, compiler.perm]
@@ -240,7 +251,21 @@ def _device_eval(
     )
 
     if not use_jax:
-        final, role_results, win_j, sat_cond = _compute(np, compiler, K, J, D, **arrays)
+        # eager path: skip template groups no condition id in this batch
+        # references (candidates, synthetic denies — both live in the cand
+        # arrays — plus every derived-role condition, which host assembly
+        # reads off sat_cond regardless of candidates)
+        C = len(compiler.kernels)
+        active = np.zeros(max(C, 1), dtype=bool)
+        for arr in (batch.cand_cond, batch.cand_drcond):
+            ids = arr[arr >= 0]
+            if ids.size:
+                active[ids] = True
+        if lt.dr_cond_id_arr.size:
+            active[lt.dr_cond_id_arr] = True
+        final, role_results, win_j, sat_cond = _compute(
+            np, compiler, K, J, D, active_mask=active, **arrays
+        )
         return np.asarray(final), np.asarray(role_results), np.asarray(win_j), np.asarray(sat_cond)
 
     import jax

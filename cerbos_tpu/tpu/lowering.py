@@ -65,6 +65,7 @@ class LoweredTable:
     uses_now: bool = False
     fallback_tags: dict[tuple[str, ...], frozenset[int]] = field(default_factory=dict)
     dr_cond_ids: dict[int, int] = field(default_factory=dict)  # id(CompiledDerivedRole) -> cond id
+    dr_cond_id_arr: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.int64))
     has_outputs: bool = False
 
     def refresh(self) -> None:
@@ -81,6 +82,10 @@ class LoweredTable:
             for dr in drs.values():
                 if dr.condition is not None:
                     self.dr_cond_ids[id(dr)] = self.compiler.cond_id(dr.condition, dr.params)
+        # ndarray form for the per-batch active-mask (hot path)
+        self.dr_cond_id_arr = np.asarray(
+            [c for c in self.dr_cond_ids.values() if c >= 0], dtype=np.int64
+        )
         self._collect_paths()
 
     def _lower_row(self, row: RuleRow) -> LoweredRow:
