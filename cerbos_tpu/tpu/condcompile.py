@@ -107,8 +107,12 @@ class KernelGroup:
     emit: Callable[["Refs", "GroupConsts"], Any]  # -> sat [B, G]
     gc: "GroupConsts"
     cond_ids: list[int]
-    # ndarray form for per-batch active-mask lookups (hot path)
-    cond_id_arr: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.int64))
+    # ndarray form for per-batch active-mask lookups (hot path); derived —
+    # a mis-wired empty array would silently disable the whole group
+    cond_id_arr: np.ndarray = field(init=False)
+
+    def __post_init__(self) -> None:
+        self.cond_id_arr = np.asarray(self.cond_ids, dtype=np.int64)
 
 
 class GroupConsts:
@@ -1026,10 +1030,7 @@ class ConditionSetCompiler:
                 self.kernels[cids[0]].slot_kinds,
                 [self.kernels[c].slot_values for c in cids],
             )
-            self.groups.append(KernelGroup(
-                emit=self._template_emits[cids[0]], gc=gc, cond_ids=cids,
-                cond_id_arr=np.asarray(cids, dtype=np.int64),
-            ))
+            self.groups.append(KernelGroup(emit=self._template_emits[cids[0]], gc=gc, cond_ids=cids))
             order.extend(cids)
         # column permutation: concatenated group output order -> cond_id order
         C = len(self.kernels)

@@ -104,7 +104,11 @@ def spawn_server(policy_dir: str, workers: int, use_tpu: bool) -> tuple[subproce
     with open(cfg_path, "w") as f:
         yaml.safe_dump(
             {
-                "server": {"httpListenAddr": "127.0.0.1:0", "grpcListenAddr": "127.0.0.1:0"},
+                "server": {
+                    "httpListenAddr": "127.0.0.1:0",
+                    "grpcListenAddr": "127.0.0.1:0",
+                    "maxWorkers": int(os.environ.get("CERBOS_TPU_LOADTEST_MAX_WORKERS", "16")),
+                },
                 "storage": {"driver": "disk", "disk": {"directory": policy_dir}},
                 "engine": {"tpu": {"enabled": bool(use_tpu)}},
                 "auxData": {
