@@ -59,6 +59,7 @@ def _build_server(core, config, http_addr=None, grpc_addr=None, reuse_port=False
             cors_allowed_headers=tuple(cors_conf.get("allowedHeaders", []) or []),
             cors_max_age_s=_parse_duration_s(cors_conf.get("maxAge", 0)),
             max_workers=int(server_conf.get("maxWorkers", 16)),
+            grpc_async=bool(server_conf.get("grpcAsync", False)),
             reuse_port=reuse_port,
             # inline dispatch is only safe without the cross-request batcher
             # (which needs concurrent requests in flight to fill batches)

@@ -79,9 +79,20 @@ class AdminService:
         app.router.add_post("/admin/policies/inspect", self._h_inspect)
 
     def grpc_handler(self):
+        """Wire-compatible cerbos.svc.v1.CerbosAdminService as a sync
+        generic handler."""
+        import grpc
+
+        return grpc.method_handlers_generic_handler(
+            "cerbos.svc.v1.CerbosAdminService", self.grpc_rpcs()
+        )
+
+    def grpc_rpcs(self):
         """Wire-compatible cerbos.svc.v1.CerbosAdminService (ref:
         internal/svc/admin_svc.go) over the same store operations as the
-        HTTP surface; basic auth read from request metadata."""
+        HTTP surface; basic auth read from request metadata. Returns the raw
+        rpc method handlers so the server can assemble either the threaded
+        sync server or the grpc.aio event-loop server from them."""
         import grpc
 
         from .. import namer
@@ -285,7 +296,7 @@ class AdminService:
                 response_serializer=lambda m: m.SerializeToString(),
             ),
         }
-        return grpc.method_handlers_generic_handler("cerbos.svc.v1.CerbosAdminService", rpcs)
+        return rpcs
 
     def _mutable_store(self):
         store = self.core.store

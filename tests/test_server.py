@@ -721,3 +721,93 @@ class TestAuthZenProtos:
         meta = evaluation_pb2.MetadataResponse(access_evaluation_endpoint="/access/v1/evaluation")
         j = json_format.MessageToDict(meta)
         assert j == {"access_evaluation_endpoint": "/access/v1/evaluation"}
+
+
+class TestAioGrpc:
+    """The grpc.aio listener variant (server.grpcAsync): same handlers on
+    the HTTP event loop; abort semantics translated by the shim."""
+
+    @pytest.fixture(scope="class")
+    def aio_server(self, tmp_path_factory):
+        policy_dir = tmp_path_factory.mktemp("policies-aio")
+        (policy_dir / "album.yaml").write_text(POLICY)
+        config = Config.load(
+            overrides=[
+                f"storage.disk.directory={policy_dir}",
+                "audit.enabled=true",
+                "audit.backend=local",
+                "engine.tpu.enabled=false",
+            ]
+        )
+        core = initialize(config, use_tpu=False)
+        admin = AdminService(core, username="cerbos", password="cerbosAdmin")
+        srv = Server(
+            core.service,
+            ServerConfig(
+                http_listen_addr="127.0.0.1:0",
+                grpc_listen_addr="127.0.0.1:0",
+                grpc_async=True,
+            ),
+            admin_service=admin,
+        )
+        srv.start()
+        yield srv
+        srv.stop()
+        core.close()
+
+    def test_check_over_aio(self, aio_server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+        from cerbos_tpu.server.convert import py_to_value
+
+        with grpc.insecure_channel(f"127.0.0.1:{aio_server.grpc_port}") as ch:
+            stub = ch.unary_unary(
+                "/cerbos.svc.v1.CerbosService/CheckResources",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=response_pb2.CheckResourcesResponse.FromString,
+            )
+            req = request_pb2.CheckResourcesRequest(request_id="aio-1")
+            req.principal.id = "alice"
+            req.principal.roles.append("user")
+            entry = req.resources.add()
+            entry.actions.append("view")
+            entry.resource.kind = "album"
+            entry.resource.id = "a1"
+            entry.resource.attr["owner"].CopyFrom(py_to_value("alice"))
+            resp = stub(req, timeout=10)
+            assert resp.results[0].actions["view"] == 1  # EFFECT_ALLOW
+
+    def test_abort_translates(self, aio_server):
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+
+        with grpc.insecure_channel(f"127.0.0.1:{aio_server.grpc_port}") as ch:
+            stub = ch.unary_unary(
+                "/cerbos.svc.v1.CerbosAdminService/ListPolicies",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=response_pb2.ListPoliciesResponse.FromString,
+            )
+            with pytest.raises(grpc.RpcError) as e:
+                stub(request_pb2.ListPoliciesRequest(), timeout=10)  # no auth
+            assert e.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+    def test_admin_streaming_over_aio(self, aio_server):
+        import base64
+
+        from cerbos_tpu.api.cerbos.request.v1 import request_pb2
+        from cerbos_tpu.api.cerbos.response.v1 import response_pb2
+
+        # generate at least one decision entry
+        self.test_check_over_aio(aio_server)
+        auth = [("authorization", "Basic " + base64.b64encode(b"cerbos:cerbosAdmin").decode())]
+        with grpc.insecure_channel(f"127.0.0.1:{aio_server.grpc_port}") as ch:
+            stub = ch.unary_stream(
+                "/cerbos.svc.v1.CerbosAdminService/ListAuditLogEntries",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=response_pb2.ListAuditLogEntriesResponse.FromString,
+            )
+            req = request_pb2.ListAuditLogEntriesRequest(
+                kind=request_pb2.ListAuditLogEntriesRequest.KIND_DECISION, tail=10
+            )
+            entries = list(stub(req, metadata=auth, timeout=10))
+            assert entries, "decision entries must stream over the aio server"
