@@ -5,22 +5,27 @@ Workload mirrors the reference's classic load test at full fidelity
 (hack/loadtest/templates/classic): 100 name-mods × 9 policy documents = 900
 docs, i.e. at least the reference's "800 policies" configuration, including
 the inIPAddrRange location variable, JWT defer conditions, schema refs and
-the default-version scope chain. The reference's 800-policy config peaks at
-8,638 req/s × 4 decisions/req ≈ 34.6k decisions/s on a 4-vCPU c3-standard-4
-(BASELINE.md). Prints one JSON line; vs_baseline is decisions/sec relative
-to that anchor.
+the default-version scope chain — plus the condition-diversity extension
+(util/bench_corpus.diverse_docs) so the device path is exercised over ≥50
+distinct condition kernels, not a memo-friendly handful. The reference's
+800-policy config peaks at 8,638 req/s × 4 decisions/req ≈ 34.6k
+decisions/s on a 4-vCPU c3-standard-4 (BASELINE.md). Prints one JSON line;
+vs_baseline is decisions/sec relative to that anchor.
 
 Device availability is established by ``cerbos_tpu.util.tpu_probe``: every
 probe runs in a subprocess (the axon PJRT plugin hangs *in native code* when
-its tunnel is down, wedging any in-process ``jax.devices()``), retries with
-backoff, and falls through to a direct-libtpu rung. The full evidence —
-per-rung exit codes, hang tracebacks, stderr — is written to
-``TPU_PROBE.json`` and summarized in the final JSON line, so the artifact
-always shows whether a chip was reachable and, if not, exactly how the
-attempt failed.
+its tunnel is down, wedging any in-process ``jax.devices()``), and — because
+the tunnel is flaky rather than permanently dead — failed probes are RETRIED
+ACROSS THE WHOLE BENCH RUN: the numpy measurement proceeds immediately after
+the first failure, and the probe re-runs between phases, switching to the
+device if it comes up late. The full evidence — per-rung exit codes, hang
+tracebacks, stderr — is written to ``TPU_PROBE.json`` and summarized in the
+final JSON line, so the artifact always shows whether a chip was reachable
+and, if not, exactly how each spaced attempt failed.
 """
 
 import json
+import statistics
 import time
 
 from cerbos_tpu.compile import compile_policy_set
@@ -42,23 +47,57 @@ def _timed(fn, *args) -> float:
     return time.perf_counter() - t0
 
 
+def _measure(ev, inputs, params, decisions_per_batch, label, n_iters=ITERS, warm=True):
+    """Optionally warm up, then time n_iters batches."""
+    warm_excess = 0.0
+    if warm:
+        t_warm0 = time.perf_counter()
+        ev.check(inputs, params)  # warmup: caches + jit compile
+        warm1 = time.perf_counter() - t_warm0
+        warm2 = _timed(ev.check, inputs, params)
+        warm_excess = max(warm1 - warm2, 0.0)
+    iter_times = []
+    outs = None
+    for _ in range(n_iters):
+        t0 = time.perf_counter()
+        outs = ev.check(inputs, params)
+        iter_times.append(time.perf_counter() - t0)
+    med = statistics.median(iter_times)
+    rate = decisions_per_batch / med
+    sustained = decisions_per_batch * n_iters / sum(iter_times)
+    print(
+        f"{label}: median {rate:.0f} dec/s, sustained {sustained:.0f} over {n_iters} batches "
+        f"(best {decisions_per_batch / min(iter_times):.0f}, worst {decisions_per_batch / max(iter_times):.0f})",
+        flush=True,
+    )
+    return rate, iter_times, warm_excess, outs
+
+
+def _merge_probe(evidence, fresh, label):
+    for r in fresh["rungs"]:
+        r["rung"] = f"{label}:{r['rung']}"
+        evidence["rungs"].append(r)
+    if fresh["available"]:
+        evidence["available"] = True
+        evidence["platform"] = fresh["platform"]
+        evidence["env_overrides"] = fresh.get("env_overrides", {})
+    return fresh["available"]
+
+
 def main() -> None:
-    probe = tpu_probe.probe_ladder()
-    tpu_probe.write_artifact(probe)
-    probe_summary = tpu_probe.summarize(probe)
-    jax_ok = probe["available"]
+    evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
+    probe = tpu_probe.probe_ladder(attempts=1)
+    jax_ok = _merge_probe(evidence, probe, "initial")
+    tpu_probe.write_artifact(evidence)
     if jax_ok:
-        # a libtpu-direct win means the default (axon) env would still hang
-        # in-process; switch to the env the winning rung actually used
-        tpu_probe.apply_env(probe)
-    if not jax_ok:
+        tpu_probe.apply_env(evidence)
+        print(f"jax backend up: platform={evidence['platform']}", flush=True)
+    else:
         print(
-            "WARNING: no jax backend reachable — benchmarking the numpy fallback. "
-            f"Probe evidence: {json.dumps(probe_summary)} (full detail in TPU_PROBE.json)",
+            "WARNING: no jax backend on first probe — benchmarking the numpy fallback "
+            "and re-probing between benchmark phases",
             flush=True,
         )
-    else:
-        print(f"jax backend up: platform={probe['platform']}", flush=True)
 
     policies = list(parse_policies(bench_corpus.corpus_yaml(N_MODS)))
     print(f"policy documents: {len(policies)} ({N_MODS} mods)", flush=True)
@@ -69,35 +108,53 @@ def main() -> None:
     inputs = bench_corpus.requests(BATCH, N_MODS)
     decisions_per_batch = sum(len(i.actions) for i in inputs)
 
-    # calibrate: the engine picks the faster backend for this hardware (the
-    # device wins when condition compute dominates; pure-host wins when the
-    # batch is transfer-bound)
-    candidates = [False, True] if jax_ok else [False]
-    best_ev, best_rate = None, -1.0
-    compile_s = None
-    for use_jax in candidates:
-        ev_c = TpuEvaluator(rt, use_jax=use_jax)
-        t_warm0 = time.perf_counter()
-        ev_c.check(inputs, params)  # warmup: caches + jit compile
-        warm1 = time.perf_counter() - t_warm0
-        warm2 = _timed(ev_c.check, inputs, params)
-        if use_jax:
-            # first-call excess over steady state ≈ trace + XLA compile
-            compile_s = round(max(warm1 - warm2, 0.0), 2)
-        # best-of-3 to ride out scheduler noise on shared hosts
-        best_dt = min(_timed(ev_c.check, inputs, params) for _ in range(3))
-        rate = decisions_per_batch / best_dt
-        print(f"calibration {'jax' if use_jax else 'numpy'}: {rate:.0f} dec/s", flush=True)
-        if rate > best_rate:
-            best_ev, best_rate = ev_c, rate
-    ev = best_ev
+    results = {}  # backend name -> (rate, iter_times, warm_excess, outs)
+    ev_by_backend = {}
 
-    iter_times = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        outs = ev.check(inputs, params)
-        iter_times.append(time.perf_counter() - t0)
-    dt = sum(iter_times)
+    # numpy measurement runs in phases with a probe retry BETWEEN each phase,
+    # so the spaced attempts bracket minutes of real bench work — if the
+    # flaky tunnel comes up at any point, the device phase below still runs
+    def _retry_probe(label: str) -> bool:
+        fresh = tpu_probe.probe_ladder(attempts=1)
+        ok = _merge_probe(evidence, fresh, label)
+        tpu_probe.write_artifact(evidence)
+        if ok:
+            tpu_probe.apply_env(evidence)
+            print(f"jax backend up ({label}): platform={evidence['platform']}", flush=True)
+        return ok
+
+    ev_np = TpuEvaluator(rt, use_jax=False)
+    half = max(ITERS // 2, 1)
+    rate_a, times_a, warm_np, outs_np = _measure(
+        ev_np, inputs, params, decisions_per_batch, "numpy phase-1", n_iters=half
+    )
+    if not jax_ok:
+        jax_ok = _retry_probe("retry-1")
+    _, times_b, _, outs_np = _measure(
+        ev_np, inputs, params, decisions_per_batch, "numpy phase-2",
+        n_iters=ITERS - half, warm=False,
+    )
+    if not jax_ok:
+        jax_ok = _retry_probe("retry-2")
+    all_np = times_a + times_b
+    results["numpy"] = (
+        decisions_per_batch / statistics.median(all_np), all_np, warm_np, outs_np
+    )
+    ev_by_backend["numpy"] = ev_np
+
+    compile_s = None
+    if jax_ok:
+        ev_jx = TpuEvaluator(rt, use_jax=True)
+        rate, iter_times, warm_excess, outs = _measure(
+            ev_jx, inputs, params, decisions_per_batch, "jax"
+        )
+        results["jax"] = (rate, iter_times, warm_excess, outs)
+        ev_by_backend["jax"] = ev_jx
+        compile_s = round(warm_excess, 2)  # first-call excess ≈ trace + XLA compile
+
+    backend = max(results, key=lambda k: results[k][0])
+    rate, iter_times, _, outs = results[backend]
+    ev = ev_by_backend[backend]
 
     allow = sum(1 for o in outs for e in o.actions.values() if e.effect == "EFFECT_ALLOW")
     assert allow > 0, "benchmark workload produced no allows — corpus is broken"
@@ -127,20 +184,14 @@ def main() -> None:
     # without inflating toward the best-case single iteration (the baseline
     # 8,638 RPS is an aggregate ghz probe; mean and median coincide on a
     # quiet machine)
-    iter_times.sort()
-    mid = iter_times[len(iter_times) // 2]
-    value = decisions_per_batch / mid
-    sustained = decisions_per_batch * ITERS / dt
-    print(f"sustained mean: {sustained:.0f} dec/s over {ITERS} batches "
-          f"(best {decisions_per_batch / iter_times[0]:.0f}, worst {decisions_per_batch / iter_times[-1]:.0f})",
-          flush=True)
+    value = rate
     record = {
         "metric": "check_decisions_per_sec",
         "value": round(value, 1),
         "unit": "decisions/s/chip",
         "vs_baseline": round(value / REFERENCE_DECISIONS_PER_SEC, 2),
-        "backend": ("jax-" + (probe["platform"] or "?")) if (ev.use_jax and jax_ok) else "numpy",
-        "probe": probe_summary,
+        "backend": ("jax-" + (evidence["platform"] or "?")) if backend == "jax" else "numpy",
+        "probe": tpu_probe.summarize(evidence),
     }
     if compile_s is not None:
         record["jit_compile_s"] = compile_s

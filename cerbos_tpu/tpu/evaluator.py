@@ -35,14 +35,13 @@ from .lowering import (
 from .packer import PackedBatch, Packer, PT_PRINCIPAL, PT_RESOURCE
 
 def _clone_output(template: "T.CheckOutput", inp: "T.CheckInput") -> "T.CheckOutput":
-    """Fresh CheckOutput from a memoized assembly (ids swapped, effects copied)."""
+    """Fresh CheckOutput from a memoized assembly (ids swapped). ActionEffect
+    values are immutable once assembly returns (only the oracle mutates its
+    own in-flight effects), so the clone shares them with the template."""
     return T.CheckOutput(
         request_id=inp.request_id,
         resource_id=inp.resource.id,
-        actions={
-            a: T.ActionEffect(effect=e.effect, policy=e.policy, scope=e.scope)
-            for a, e in template.actions.items()
-        },
+        actions=dict(template.actions),
         effective_derived_roles=list(template.effective_derived_roles),
         effective_policies=dict(template.effective_policies),
     )
@@ -318,7 +317,7 @@ def _device_eval(
 
     if jit_cache is None:
         jit_cache = {}
-    key = (B_pad, BA_pad, K, J)
+    key = (B_pad, BA_pad, K, J, D)
     fn = jit_cache.get(key)
     if fn is None:
         fn = jax.jit(lambda **kw: _compute(jnp, compiler, K, J, D, **kw))
@@ -390,6 +389,20 @@ class TpuEvaluator:
             self.lowered, batch, use_jax=self.use_jax, jit_cache=self._jit_cache, mesh=self.mesh
         )
 
+        # one contiguous int8 matrix of all per-(input,action) decision state:
+        # the memo key for input bi is a single slice-tobytes instead of three
+        dec_bytes = None
+        if not self.lowered.has_outputs and final.shape[0]:
+            BA = final.shape[0]
+            dec_bytes = np.concatenate(
+                [
+                    np.asarray(final).reshape(BA, -1),
+                    np.asarray(role_results).reshape(BA, -1),
+                    np.asarray(win_j).reshape(BA, -1),
+                ],
+                axis=1,
+            )
+
         outputs: list[T.CheckOutput] = []
         for bi, plan in enumerate(batch.plans):
             inp = plan.input
@@ -424,7 +437,7 @@ class TpuEvaluator:
                     continue
             key = None
             if not vr_errors:
-                key = self._assemble_key(plan, bi, batch, final, role_results, win_j, sat_cond, params)
+                key = self._assemble_key(plan, bi, batch, dec_bytes, sat_cond, params)
             if key is not None:
                 hit = self._assemble_memo.get(key)
                 if hit is not None:
@@ -439,13 +452,13 @@ class TpuEvaluator:
             outputs.append(out)
         return outputs
 
-    def _assemble_key(self, plan, bi, batch, final, role_results, win_j, sat_cond, params):
+    def _assemble_key(self, plan, bi, batch, dec_bytes, sat_cond, params):
         """Equivalence-class key for a device result: inputs with the same
         plan signature, device decision rows and derived-role condition bits
         assemble to identical outputs (modulo request/resource ids). Not
         applicable when the table emits outputs (output values read raw
         attrs) or a schema manager can attach per-input validation errors."""
-        if self.lowered.has_outputs:
+        if dec_bytes is None:
             return None
         inp = plan.input
         start, end = plan.ba_range
@@ -475,9 +488,7 @@ class TpuEvaluator:
             plan.resource_policy_key,
             tuple(plan.roles),
             tuple(inp.actions),
-            np.asarray(final[start:end]).tobytes(),
-            np.asarray(role_results[start:end]).tobytes(),
-            np.asarray(win_j[start:end]).tobytes(),
+            dec_bytes[start:end].tobytes(),
             dr_bits,
         )
 

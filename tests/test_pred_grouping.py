@@ -1,0 +1,83 @@
+"""Host-predicate batch grouping must never collapse CEL-distinct values.
+
+The packer groups inputs by the device key encoding (tag, hi, lo, sid, nan,
+subtype) of each predicate's referenced paths and evaluates once per group.
+The double key is lossy for big ints (2^53 vs 2^53+1) and erases the
+int-vs-double distinction (1 vs 1.0) — the subtype column must keep those
+apart (or exclude them from grouping) so grouped results stay bit-exact with
+the per-input oracle.
+"""
+
+import pytest
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table
+from cerbos_tpu.ruletable.check import check_input
+from cerbos_tpu.tpu import TpuEvaluator
+
+# string(...)+contains keeps the condition on the host-predicate path while
+# the referenced value is numeric — exactly the lossy-key scenario
+POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: "default"
+  rules:
+    - actions: ["read"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: string(R.attr.n).contains("9007199254740993")
+"""
+
+
+def _inputs(values):
+    return [
+        CheckInput(
+            request_id=f"r{i}",
+            principal=Principal(id="u", roles=["user"], attr={}),
+            resource=Resource(kind="doc", id=f"d{i}", attr={"n": v}),
+            actions=["read"],
+        )
+        for i, v in enumerate(values)
+    ]
+
+
+@pytest.fixture(scope="module")
+def ev():
+    # without the fused native entry point the grouped path under test never
+    # runs and every assertion would pass vacuously
+    from cerbos_tpu import native
+
+    mod = native.get()
+    if mod is None or not hasattr(mod, "encode_attr_column"):
+        pytest.skip("native encode_attr_column unavailable — grouped pred path can't be exercised")
+    rt = build_rule_table(compile_policy_set(list(parse_policies(POLICY))))
+    return TpuEvaluator(rt, use_jax=False, min_device_batch=1)
+
+
+def _assert_oracle_parity(ev, inputs):
+    params = EvalParams()
+    outs = ev.check(inputs, params)
+    for inp, out in zip(inputs, outs):
+        oracle = check_input(ev.rule_table, inp, params, None)
+        assert {a: e.effect for a, e in out.actions.items()} == {
+            a: e.effect for a, e in oracle.actions.items()
+        }, inp.resource.attr
+
+
+def test_big_int_values_not_collapsed(ev):
+    # 2^53 and 2^53+1 share a double key; results must still differ
+    _assert_oracle_parity(ev, _inputs([9007199254740993 if i % 2 == 0 else 9007199254740992 for i in range(64)]))
+
+
+def test_int_vs_float_not_collapsed(ev):
+    _assert_oracle_parity(ev, _inputs([1 if i % 2 == 0 else 1.0 for i in range(64)]))
+
+
+def test_container_values_fall_back(ev):
+    # lists at the referenced path are TAG_OTHER: never grouped
+    _assert_oracle_parity(ev, _inputs([[1, 2] if i % 3 == 0 else "x" for i in range(48)]))
