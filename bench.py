@@ -33,7 +33,7 @@ from cerbos_tpu.engine import EvalParams
 from cerbos_tpu.policy.parser import parse_policies
 from cerbos_tpu.ruletable import build_rule_table
 from cerbos_tpu.tpu import TpuEvaluator
-from cerbos_tpu.util import bench_corpus, tpu_probe
+from cerbos_tpu.util import bench_corpus, gctune, tpu_probe
 
 REFERENCE_DECISIONS_PER_SEC = 8638 * 4  # BASELINE.md: max RPS @800 policies × 4 decisions/req
 N_MODS = 100  # × 9 docs per mod = 900 docs (≥ the classic "800 policies" config)
@@ -56,6 +56,10 @@ def _measure(ev, inputs, params, decisions_per_batch, label, n_iters=ITERS, warm
         warm1 = time.perf_counter() - t_warm0
         warm2 = _timed(ev.check, inputs, params)
         warm_excess = max(warm1 - warm2, 0.0)
+        # freeze the warmed table/caches out of the GC's scan set (the
+        # reference serves at GOGC=100 after a GOGC=10 build; see
+        # util/gctune for the CPython analogue and measurements)
+        gctune.tune_for_serving()
     iter_times = []
     outs = None
     for _ in range(n_iters):

@@ -14,6 +14,7 @@ from typing import Any, Optional
 from .bootstrap import Core, initialize
 from .config import Config
 from .server.server import Server, ServerConfig
+from .util import gctune
 
 
 @dataclass
@@ -54,6 +55,9 @@ def serve(
             grpc_listen_addr=server_conf.get("grpcListenAddr", "127.0.0.1:0"),
         ),
     )
+    # tables are built: pace the collector BEFORE the listeners come up so
+    # no in-flight request's transients get frozen (util/gctune)
+    gctune.tune_for_serving()
     server.start()
     return Handle(core=core, server=server)
 

@@ -26,6 +26,8 @@ import sys
 import time
 from typing import Callable, Optional
 
+from ..util import gctune
+
 _RESTART_LIMIT = 10  # per worker slot; a crash-looping config must not spin forever
 _RESTART_WINDOW_S = 60.0
 
@@ -200,6 +202,10 @@ def run_server_pool(
         core = initialize(config, use_tpu=use_tpu, prebuilt=None if respawn else prebuilt)
         if post_init is not None:
             post_init(core)
+        # worker-local tables are built and listeners not yet started: freeze
+        # them and pace the collector for the request path (util/gctune —
+        # the serving-time analogue of the reference's GOGC handling)
+        gctune.tune_for_serving()
         server = build_server(core, config, http_addr, grpc_addr, True)
         try:
             if not stop["flag"]:

@@ -146,6 +146,29 @@ class GroupConsts:
         return cls(g, slots)
 
 
+def subset_group_consts(gc: "GroupConsts", sel: tuple[int, ...]) -> "GroupConsts":
+    """A GroupConsts view holding only the members at positions ``sel``.
+
+    Used by the evaluator's jit variant graphs: a batch that references only
+    a few members of a template group traces the group's kernel over just
+    those members' constant vectors, so the compiled graph (and the device
+    work) is O(active conditions) instead of O(all conditions)."""
+    idx = np.asarray(sel, dtype=np.int64)
+    slots: list[Any] = []
+    for s in gc.slots:
+        if s is None:
+            slots.append(None)
+        elif isinstance(s, tuple) and len(s) == 2 and isinstance(s[0], np.ndarray):
+            slots.append((s[0][idx], s[1][idx]))  # key slot: (hi, lo)
+        elif isinstance(s, np.ndarray):
+            slots.append(s[idx])  # sid / bool slot
+        elif isinstance(s, tuple):
+            slots.append(tuple(s[i] for i in sel))  # pred-id slot (static)
+        else:  # pragma: no cover - GroupConsts.build guarantees known shapes
+            raise ValueError(f"unknown slot shape {type(s)}")
+    return GroupConsts(len(sel), slots)
+
+
 class Refs:
     """Accessors handed to kernel emit functions (jnp or np arrays)."""
 

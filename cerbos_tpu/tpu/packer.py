@@ -69,6 +69,10 @@ class InputPlan:
     oracle: bool = False  # fall back to the CPU oracle for this input
     trivial: bool = False  # no scopes/rows at all: every action default-DENY
     ba_range: tuple[int, int] = (0, 0)  # [start, end) in the flattened axis
+    # small integer identifying the request SHAPE (one per distinct shape-memo
+    # entry); the evaluator's assembly memo keys on it instead of re-hashing
+    # every shape field per input
+    sig: int = -1
 
 
 @dataclass
@@ -120,8 +124,20 @@ class Packer:
         self._encode_cache: dict[Any, tuple] = {}
         self._ts_memo: dict[Any, Any] = {}
         self._list_memo: dict[Any, list[int]] = {}
-        self._padded_block_cache: dict[tuple, tuple] = {}
         self._shape_memo: dict[tuple, tuple] = {}
+        # monotone shape-signature sequence; NOT reset by invalidate() so a
+        # sig never aliases across reloads (downstream memos key on it)
+        self._sig_seq = 0
+        # block registry: every distinct candidate cell block gets a stable
+        # uid at shape-build time; pack() assembles cand_* tensors with one
+        # gather over a cached [n_blocks, K, J] stack instead of per-cell
+        # Python work. Same scheme for scope-permission rows.
+        self._block_uid: dict[int, int] = {}
+        self._block_store: list[tuple] = []
+        self._block_stacked: dict[tuple[int, int], tuple[int, list[np.ndarray]]] = {}
+        self._sp_uid: dict[bytes, int] = {}
+        self._sp_store: list[np.ndarray] = []
+        self._sp_stacked: Optional[tuple[int, np.ndarray]] = None
         # scratch interner for predicate group keys (kept separate from the
         # device interner so grouping never grows the device string space)
         self._pred_scratch: dict[str, int] = {}
@@ -137,9 +153,14 @@ class Packer:
         self._encode_cache.clear()
         self._ts_memo.clear()
         self._list_memo.clear()
-        self._padded_block_cache.clear()
         self._shape_memo.clear()
         self._pred_scratch.clear()
+        self._block_uid.clear()
+        self._block_store.clear()
+        self._block_stacked.clear()
+        self._sp_uid.clear()
+        self._sp_store.clear()
+        self._sp_stacked = None
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
         key = (kind, scope, name, version, lenient)
@@ -259,27 +280,53 @@ class Packer:
         # shape-level memo, not a value-level one: it stays hot under
         # per-request-unique attribute values (the memo-cold benchmark).
         shape_memo = self._shape_memo
+        if len(shape_memo) > 65536:
+            # the shape memo anchors the block/sp registries (uids live in
+            # its values) and the cell cache (block identity) — evict them
+            # together, and ONLY between batches: a mid-batch clear would
+            # invalidate uids already collected for earlier inputs of the
+            # same pack() call. One batch may overshoot the cap by its own
+            # input count; that's bounded and re-warms immediately.
+            self._clear_shape_caches()
         lenient = params.lenient_scope_search
-        ba_input: list[int] = []
+        ba_count = 0
+        ba_counts: list[int] = []
         ba_action: list[str] = []
-        blocks: list[tuple] = []
+        uid_chunks: list[np.ndarray] = []
+        cand_entries: list[list[list[Optional[CandEntry]]]] = []
         K_max, J_max, chain_max = 1, 1, 1
-        sp_row_for_plan: list[np.ndarray] = []
+        sp_uids: list[int] = []
+        plans_append = plans.append
         for inp in inputs:
+            principal = inp.principal
+            resource = inp.resource
             sk = (
-                inp.principal.id, inp.principal.scope, inp.principal.policy_version,
-                inp.resource.kind, inp.resource.scope, inp.resource.policy_version,
-                tuple(inp.principal.roles), tuple(inp.actions), lenient,
+                principal.id, principal.scope, principal.policy_version,
+                resource.kind, resource.scope, resource.policy_version,
+                tuple(principal.roles), tuple(inp.actions), lenient,
                 params.default_scope, params.default_policy_version,
             )
             hit = shape_memo.get(sk)
             if hit is None:
-                hit = _memo_put(shape_memo, sk, self._build_shape(inp, params, lenient))
+                hit = self._build_shape(inp, params, lenient)
+                shape_memo[sk] = hit
             (p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists,
-             roles, trivial, oracle, shape_blocks, uniq_actions, K_blk, J_blk,
-             sp_row, chain_len) = hit
+             roles, trivial, oracle, blk_uids, blk_entries, uniq_actions,
+             K_blk, J_blk, sp_uid, chain_len, sig) = hit
             bi = len(plans)
-            plans.append(InputPlan(
+            n = 0
+            if blk_uids is not None:
+                n = len(uniq_actions)
+                ba_action.extend(uniq_actions)
+                uid_chunks.append(blk_uids)
+                cand_entries.extend(blk_entries)
+                if K_blk > K_max:
+                    K_max = K_blk
+                if J_blk > J_max:
+                    J_max = J_blk
+                if chain_len > chain_max:
+                    chain_max = chain_len
+            plans_append(InputPlan(
                 input=inp,
                 principal_scopes=p_scopes,
                 resource_scopes=r_scopes,
@@ -291,73 +338,35 @@ class Packer:
                 roles=roles,
                 trivial=trivial,
                 oracle=oracle,
+                ba_range=(ba_count, ba_count + n),
+                sig=sig,
             ))
-            sp_row_for_plan.append(sp_row)
-            start = len(ba_input)
-            if shape_blocks is not None:
-                ba_input.extend([bi] * len(shape_blocks))
-                ba_action.extend(uniq_actions)
-                blocks.extend(shape_blocks)
-                if K_blk > K_max:
-                    K_max = K_blk
-                if J_blk > J_max:
-                    J_max = J_blk
-                if chain_len > chain_max:
-                    chain_max = chain_len
-            plans[bi].ba_range = (start, len(ba_input))
+            ba_counts.append(n)
+            sp_uids.append(sp_uid)
+            ba_count += n
 
-        BA = len(ba_input)
+        BA = ba_count
         # the depth axis buckets to the batch's real max scope-chain length
         # (pow2 so jit traces are reused), not the configured cap — shallow
         # fleets halve the lattice's per-depth loop
         D = min(_pow2(chain_max), self.D)
         K = min(_pow2(K_max), self.K)
         J = min(_pow2(J_max), self.J)
-        # cells repeat a small number of distinct blocks, so pad each unique
-        # block to (K, J) once and assemble the batch with one fancy-index
-        # gather instead of per-cell copies
-        unique_padded: dict[int, int] = {}
-        padded_arrays: list[tuple] = []
-        block_ids = np.empty(BA, dtype=np.int32)
-        cand_entries: list[list[list[Optional[CandEntry]]]] = []
-        # the padded (K, J) form of a block is reusable across batches while
-        # K/J stay at the same buckets — cached per block identity (cell
-        # blocks themselves live in _cell_cache, so id() is stable)
-        pad_cache = self._padded_block_cache
-        for ci, blk in enumerate(blocks):
-            key = id(blk)
-            uid = unique_padded.get(key)
-            if uid is None:
-                uid = len(padded_arrays)
-                unique_padded[key] = uid
-                cached = pad_cache.get((key, K, J))
-                if cached is None:
-                    kk, jj = blk[0].shape
-                    pc = np.full((K, J), -1, dtype=np.int32)
-                    pd = np.full((K, J), -1, dtype=np.int32)
-                    pe = np.zeros((K, J), dtype=np.int8)
-                    pp = np.zeros((K, J), dtype=np.int8)
-                    pdep = np.full((K, J), -1, dtype=np.int8)
-                    pv = np.zeros((K, J), dtype=bool)
-                    pc[:kk, :jj] = blk[0]
-                    pd[:kk, :jj] = blk[1]
-                    pe[:kk, :jj] = blk[2]
-                    pp[:kk, :jj] = blk[3]
-                    pdep[:kk, :jj] = blk[4]
-                    pv[:kk, :jj] = blk[5]
-                    cached = _memo_put(pad_cache, (key, K, J), (pc, pd, pe, pp, pdep, pv))
-                padded_arrays.append(cached)
-            block_ids[ci] = uid
-            cand_entries.append(blocks[ci][6])
-        if padded_arrays:
-            stacked = [np.stack([p[i] for p in padded_arrays]) for i in range(6)]
-            cand_cond = stacked[0][block_ids]
-            cand_drcond = stacked[1][block_ids]
-            cand_effect = stacked[2][block_ids]
-            cand_pt = stacked[3][block_ids]
-            cand_depth = stacked[4][block_ids]
-            cand_valid = stacked[5][block_ids]
+        if BA:
+            ba_input = np.repeat(
+                np.arange(len(plans), dtype=np.int32),
+                np.asarray(ba_counts, dtype=np.int64),
+            )
+            all_uids = np.concatenate(uid_chunks)
+            stacked = self._stacked_blocks(K, J)
+            cand_cond = stacked[0][all_uids]
+            cand_drcond = stacked[1][all_uids]
+            cand_effect = stacked[2][all_uids]
+            cand_pt = stacked[3][all_uids]
+            cand_depth = stacked[4][all_uids]
+            cand_valid = stacked[5][all_uids]
         else:
+            ba_input = np.zeros(0, dtype=np.int32)
             cand_cond = np.full((0, K, J), -1, dtype=np.int32)
             cand_drcond = np.full((0, K, J), -1, dtype=np.int32)
             cand_effect = np.zeros((0, K, J), dtype=np.int8)
@@ -366,9 +375,9 @@ class Packer:
             cand_valid = np.zeros((0, K, J), dtype=bool)
 
         # scope permissions per input [B, 2, D]: rows precomputed per shape,
-        # assembled with one stack + slice instead of per-input copies
+        # assembled with one gather over the registered-row stack
         if plans:
-            scope_sp = np.stack(sp_row_for_plan)[:, :, :D]
+            scope_sp = self._stacked_sp()[np.asarray(sp_uids, dtype=np.int64)][:, :, :D]
         else:
             scope_sp = np.zeros((0, 2, D), dtype=np.int8)
 
@@ -390,6 +399,74 @@ class Packer:
             J=int(J),
             D=D,
         )
+
+    def _clear_shape_caches(self) -> None:
+        """Evict the shape memo and everything whose identity it anchors."""
+        self._shape_memo.clear()
+        self._cell_cache.clear()
+        self._block_uid.clear()
+        self._block_store.clear()
+        self._block_stacked.clear()
+        self._sp_uid.clear()
+        self._sp_store.clear()
+        self._sp_stacked = None
+
+    def _register_block(self, blk: tuple) -> int:
+        uid = self._block_uid.get(id(blk))
+        if uid is None:
+            uid = len(self._block_store)
+            self._block_uid[id(blk)] = uid
+            self._block_store.append(blk)
+        return uid
+
+    def _register_sp(self, sp_row: np.ndarray) -> int:
+        # content-keyed: distinct scope-permission patterns are few, so the
+        # store stays tiny no matter how many shapes register
+        key = sp_row.tobytes()
+        uid = self._sp_uid.get(key)
+        if uid is None:
+            uid = len(self._sp_store)
+            self._sp_uid[key] = uid
+            self._sp_store.append(sp_row)
+        return uid
+
+    def _stacked_blocks(self, K: int, J: int) -> list[np.ndarray]:
+        """[n_blocks, K, J] stacks of every registered block, padded; cached
+        per (K, J) until new blocks register (steady state: pure cache hit)."""
+        n = len(self._block_store)
+        hit = self._block_stacked.get((K, J))
+        if hit is not None and hit[0] == n:
+            return hit[1]
+        pc = np.full((n, K, J), -1, dtype=np.int32)
+        pd = np.full((n, K, J), -1, dtype=np.int32)
+        pe = np.zeros((n, K, J), dtype=np.int8)
+        pp = np.zeros((n, K, J), dtype=np.int8)
+        pdep = np.full((n, K, J), -1, dtype=np.int8)
+        pv = np.zeros((n, K, J), dtype=bool)
+        for i, blk in enumerate(self._block_store):
+            kk, jj = blk[0].shape
+            # blocks larger than this batch's (K, J) bucket can never be
+            # gathered by it (the bucket covers the batch max), so truncating
+            # them in this stack is safe
+            kk, jj = min(kk, K), min(jj, J)
+            pc[i, :kk, :jj] = blk[0][:kk, :jj]
+            pd[i, :kk, :jj] = blk[1][:kk, :jj]
+            pe[i, :kk, :jj] = blk[2][:kk, :jj]
+            pp[i, :kk, :jj] = blk[3][:kk, :jj]
+            pdep[i, :kk, :jj] = blk[4][:kk, :jj]
+            pv[i, :kk, :jj] = blk[5][:kk, :jj]
+        stacked = [pc, pd, pe, pp, pdep, pv]
+        self._block_stacked[(K, J)] = (n, stacked)
+        return stacked
+
+    def _stacked_sp(self) -> np.ndarray:
+        n = len(self._sp_store)
+        hit = self._sp_stacked
+        if hit is not None and hit[0] == n:
+            return hit[1]
+        stacked = np.stack(self._sp_store) if n else np.zeros((0, 2, self.D), dtype=np.int8)
+        self._sp_stacked = (n, stacked)
+        return stacked
 
     def _build_shape(self, inp: T.CheckInput, params: T.EvalParams, lenient: bool) -> tuple:
         """Resolve the full packing product for one request shape: plan
@@ -445,10 +522,21 @@ class Packer:
                 shape_blocks.append(blk)
                 K_blk = max(K_blk, blk[0].shape[0])
                 J_blk = max(J_blk, blk[0].shape[1])
+        if shape_blocks is not None:
+            blk_uids = np.fromiter(
+                (self._register_block(blk) for blk in shape_blocks),
+                dtype=np.int64, count=len(shape_blocks),
+            )
+            blk_entries = [blk[6] for blk in shape_blocks]
+        else:
+            blk_uids = None
+            blk_entries = None
+        self._sig_seq += 1
         return (
             p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists,
-            roles, trivial, oracle, shape_blocks, uniq_actions, K_blk, J_blk,
-            sp_row, min(chain_len, self.D),
+            roles, trivial, oracle, blk_uids, blk_entries, uniq_actions,
+            K_blk, J_blk, self._register_sp(sp_row),
+            min(chain_len, self.D), self._sig_seq,
         )
 
     def _cell_block(
@@ -737,7 +825,39 @@ class Packer:
         B = cb.size
         interner = self.lt.interner
         memo = self._list_memo
+        from .. import native as native_mod
+
+        native = native_mod.get()
+        use_native = native is not None and hasattr(native, "encode_list_column")
         for p in sorted(self.lt.list_paths):
+            fused = self._fused_mode(p) if use_native else None
+            if fused is not None:
+                # oracle flags may have flipped during scalar encoding;
+                # re-filter so oracled inputs don't intern into device space
+                live = [(bi, plan) for bi, plan in active if not plan.oracle]
+                nl = len(live)
+                mode, root, leaf = fused
+                lstate = np.zeros(nl, dtype=np.uint8)
+                width, sids_bytes = native.encode_list_column(
+                    [plan.input for _, plan in live], mode, root, leaf,
+                    interner.ids, _MISSING_SENTINEL, memoryview(lstate),
+                )
+                arr = np.zeros((B, width), dtype=np.int32)
+                state = np.zeros(B, dtype=np.int8)
+                if nl:
+                    ix = np.fromiter((bi for bi, _ in live), dtype=np.int64, count=nl)
+                    mat = np.frombuffer(sids_bytes, dtype=np.int32).reshape(nl, width)
+                    dicts = lstate == 3
+                    if dicts.any():
+                        for si in np.nonzero(dicts)[0]:
+                            live[int(si)][1].oracle = True
+                        lstate = np.where(dicts, 0, lstate)
+                        mat = np.where(dicts[:, None], 0, mat)
+                    arr[ix] = mat
+                    state[ix] = lstate.astype(np.int8)
+                cb.list_sids[p] = arr
+                cb.list_states[p] = state
+                continue
             accessor = self._path_accessor(p)
             per_input: list[Optional[list[int]]] = [None] * B
             state = np.zeros(B, dtype=np.int8)
@@ -839,20 +959,35 @@ class Packer:
                     # (CEL-distinct) in separate groups
                     groupable &= st != 3
                     cols.extend((t.astype(np.int32), h, l, s, nn.astype(np.int32), st.astype(np.int32)))
-                key_mat = np.stack(cols, axis=1)
+                key_mat = np.ascontiguousarray(np.stack(cols, axis=1), dtype=np.int32)
                 g_idx = np.nonzero(groupable)[0]
                 if g_idx.size:
-                    uniq, rep, inverse = np.unique(
-                        key_mat[g_idx], axis=0, return_index=True, return_inverse=True
-                    )
+                    # group by raw row bytes with one dict pass — O(n) hashing
+                    # beats np.unique's O(n log n) argsort on every batch
+                    rows = np.ascontiguousarray(key_mat[g_idx])
+                    row_w = rows.shape[1] * 4
+                    buf = rows.tobytes()
+                    seen: dict[bytes, int] = {}
+                    n_g = g_idx.size
+                    inverse = np.empty(n_g, dtype=np.int64)
+                    rep: list[int] = []
+                    for i in range(n_g):
+                        rb = buf[i * row_w : (i + 1) * row_w]
+                        u = seen.get(rb)
+                        if u is None:
+                            u = len(rep)
+                            seen[rb] = u
+                            rep.append(i)
+                        inverse[i] = u
                     bis = np.fromiter(
-                        (live[int(i)][0] for i in g_idx), dtype=np.int64, count=g_idx.size
+                        (live[int(i)][0] for i in g_idx), dtype=np.int64, count=n_g
                     )
+                    n_u = len(rep)
                     for spec in group_specs:
                         vals, errs = out[spec.pred_id]
-                        uv = np.empty(len(uniq), dtype=bool)
-                        ue = np.empty(len(uniq), dtype=bool)
-                        for u in range(len(uniq)):
+                        uv = np.empty(n_u, dtype=bool)
+                        ue = np.empty(n_u, dtype=bool)
+                        for u in range(n_u):
                             _, plan_rep = live[int(g_idx[rep[u]])]
                             uv[u], ue[u] = self._eval_pred(spec, plan_rep, params)
                         vals[bis] = uv[inverse]

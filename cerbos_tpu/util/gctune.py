@@ -1,0 +1,47 @@
+"""CPython GC pacing for the serving hot path.
+
+The reference tunes the Go collector around its hot structures: GOGC=10
+while building the rule table (ruletable.go:540-601, env override
+CERBOS_RULE_TABLE_GC_PERCENT) and the stock GOGC=100 while serving, with
+GC costing ~8-9% of CPU under load (loadtest-classic.md:13). CPython's
+analogue hurts more on our batch path: every check() allocates tens of
+thousands of container objects (CheckOutputs, dicts, numpy temporaries),
+so the default gen-0 threshold (700 allocations) fires hundreds of cyclic
+collections per batch, each scanning the long-lived policy-table object
+graph — measured at ~30% of steady-state batch latency.
+
+``tune_for_serving()`` applies the standard CPython remedy after the rule
+table is built and warmed:
+
+- ``gc.freeze()`` moves the (immutable-after-build) table/compiler object
+  graph into the permanent generation so collections never rescan it;
+- gen-0 threshold rises so a 4k-input batch triggers a handful of young
+  collections instead of hundreds.
+
+GC stays ENABLED — request-path cycles (rare, but e.g. exception
+tracebacks make them) are still reclaimed, just at batch granularity.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+
+_TUNED = False
+
+
+def tune_for_serving(gen0: int = 50_000, gen1: int = 50, gen2: int = 50) -> None:
+    """Freeze the current object graph and raise collection thresholds.
+
+    Call once per process after long-lived state (rule table, lowered
+    tables, jit caches) exists. Safe to call again after a reload — the
+    new table is frozen too. Opt out with CERBOS_TPU_NO_GC_TUNE=1.
+    """
+    global _TUNED
+    if os.environ.get("CERBOS_TPU_NO_GC_TUNE"):
+        return
+    gc.collect()
+    gc.freeze()
+    if not _TUNED:
+        gc.set_threshold(gen0, gen1, gen2)
+        _TUNED = True

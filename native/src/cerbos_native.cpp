@@ -396,6 +396,74 @@ PyObject* PyEncodeColumn(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// Shared value gather for the packer's fused column modes (see
+// PyEncodeAttrColumn). Returns a NEW reference (or `missing` borrowed with
+// an extra ref) — caller decrefs.
+PyObject* GatherValue(PyObject* inp, int mode, PyObject* root, PyObject* leaf,
+                      PyObject* missing, PyObject* attr_name,
+                      PyObject* aux_name, PyObject* jwt_name) {
+  if (mode == 0) {
+    PyObject* obj = PyObject_GetAttr(inp, root);
+    if (!obj) {
+      PyErr_Clear();
+    } else {
+      PyObject* attrs = PyObject_GetAttr(obj, attr_name);
+      Py_DECREF(obj);
+      if (!attrs) {
+        PyErr_Clear();
+      } else {
+        if (PyDict_Check(attrs)) {
+          PyObject* got = PyDict_GetItemWithError(attrs, leaf);  // borrowed
+          if (got) {
+            Py_INCREF(got);
+            Py_DECREF(attrs);
+            return got;
+          }
+          if (PyErr_Occurred()) PyErr_Clear();
+        }
+        Py_DECREF(attrs);
+      }
+    }
+  } else if (mode == 1) {
+    PyObject* aux = PyObject_GetAttr(inp, aux_name);
+    if (!aux) {
+      PyErr_Clear();
+    } else {
+      if (aux != Py_None) {
+        PyObject* jwt = PyObject_GetAttr(aux, jwt_name);
+        if (!jwt) {
+          PyErr_Clear();
+        } else {
+          if (PyDict_Check(jwt)) {
+            PyObject* got = PyDict_GetItemWithError(jwt, leaf);  // borrowed
+            if (got) {
+              Py_INCREF(got);
+              Py_DECREF(jwt);
+              Py_DECREF(aux);
+              return got;
+            }
+            if (PyErr_Occurred()) PyErr_Clear();
+          }
+          Py_DECREF(jwt);
+        }
+      }
+      Py_DECREF(aux);
+    }
+  } else {
+    PyObject* obj = PyObject_GetAttr(inp, root);
+    if (obj) {
+      PyObject* got = PyObject_GetAttr(obj, leaf);
+      Py_DECREF(obj);
+      if (got) return got;
+      PyErr_Clear();
+    } else {
+      PyErr_Clear();
+    }
+  }
+  Py_INCREF(missing);
+  return missing;
+}
+
 // encode_attr_column(inputs, mode, root, leaf, interner, missing, err,
 //                    tags_u8, hi_i32, lo_i32, sid_i32, nan_u8
 //                    [, subtype_u8]) -> None
@@ -473,71 +541,8 @@ PyObject* PyEncodeAttrColumn(PyObject*, PyObject* args) {
 
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject* inp = PySequence_Fast_GET_ITEM(seq, i);
-    PyObject* v = missing;  // borrowed or owned via v_owned
-    PyObject* v_owned = nullptr;
-    if (mode == 0) {
-      PyObject* obj = PyObject_GetAttr(inp, root);
-      if (obj) {
-        PyObject* attrs = PyObject_GetAttr(obj, attr_name);
-        Py_DECREF(obj);
-        if (attrs) {
-          if (PyDict_Check(attrs)) {
-            PyObject* got = PyDict_GetItemWithError(attrs, leaf);  // borrowed
-            if (got) {
-              v_owned = got;
-              Py_INCREF(v_owned);
-              v = v_owned;
-            } else if (PyErr_Occurred()) {
-              PyErr_Clear();
-            }
-          }
-          Py_DECREF(attrs);
-        } else {
-          PyErr_Clear();
-        }
-      } else {
-        PyErr_Clear();
-      }
-    } else if (mode == 1) {
-      PyObject* aux = PyObject_GetAttr(inp, aux_name);
-      if (aux) {
-        if (aux != Py_None) {
-          PyObject* jwt = PyObject_GetAttr(aux, jwt_name);
-          if (jwt) {
-            if (PyDict_Check(jwt)) {
-              PyObject* got = PyDict_GetItemWithError(jwt, leaf);  // borrowed
-              if (got) {
-                v_owned = got;
-                Py_INCREF(v_owned);
-                v = v_owned;
-              } else if (PyErr_Occurred()) {
-                PyErr_Clear();
-              }
-            }
-            Py_DECREF(jwt);
-          } else {
-            PyErr_Clear();
-          }
-        }
-        Py_DECREF(aux);
-      } else {
-        PyErr_Clear();
-      }
-    } else {
-      PyObject* obj = PyObject_GetAttr(inp, root);
-      if (obj) {
-        PyObject* got = PyObject_GetAttr(obj, leaf);
-        Py_DECREF(obj);
-        if (got) {
-          v_owned = got;
-          v = v_owned;
-        } else {
-          PyErr_Clear();
-        }
-      } else {
-        PyErr_Clear();
-      }
-    }
+    PyObject* v = GatherValue(inp, mode, root, leaf, missing, attr_name,
+                              aux_name, jwt_name);  // owned
     int rc = EncodeOne(v, interner, missing, err, i, tags, hi, lo, sid, nan);
     if (subtype) {
       uint8_t st = 0;
@@ -566,13 +571,293 @@ PyObject* PyEncodeAttrColumn(PyObject*, PyObject* args) {
       }
       subtype[i] = st;
     }
-    Py_XDECREF(v_owned);
+    Py_DECREF(v);
     if (rc < 0) {
       Py_DECREF(seq);
       return nullptr;
     }
   }
   Py_DECREF(seq);
+  Py_RETURN_NONE;
+}
+
+// encode_list_column(inputs, mode, root, leaf, interner, missing,
+//                    state_u8_buf) -> (width, sids_bytes)
+//
+// Fused gather + intern for string-list membership columns
+// (packer._encode_list_columns semantics): per input
+//   missing attr        -> state 0
+//   dict value          -> state 3 (caller routes the plan to the oracle)
+//   non-list            -> state 2 (CEL error on device)
+//   list                -> state 1; str elements interned, non-str -> sid 0
+// The sid matrix is zero-padded to width = pow2(max_len, >=4) so jit traces
+// reuse across batches; returned as raw little-endian int32 bytes [n, width].
+PyObject* PyEncodeListColumn(PyObject*, PyObject* args) {
+  PyObject* inputs;
+  int mode;
+  PyObject* root;
+  PyObject* leaf;
+  PyObject* interner;
+  PyObject* missing;
+  Py_buffer state_b;
+  if (!PyArg_ParseTuple(args, "OiUUO!Ow*", &inputs, &mode, &root, &leaf,
+                        &PyDict_Type, &interner, &missing, &state_b)) {
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(inputs, "inputs must be a sequence");
+  if (!seq) {
+    PyBuffer_Release(&state_b);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (state_b.len < n) {
+    Py_DECREF(seq);
+    PyBuffer_Release(&state_b);
+    PyErr_SetString(PyExc_ValueError, "state buffer too small");
+    return nullptr;
+  }
+  uint8_t* state = static_cast<uint8_t*>(state_b.buf);
+
+  static PyObject* attr_name = nullptr;
+  static PyObject* aux_name = nullptr;
+  static PyObject* jwt_name = nullptr;
+  if (!attr_name) attr_name = PyUnicode_InternFromString("attr");
+  if (!aux_name) aux_name = PyUnicode_InternFromString("aux_data");
+  if (!jwt_name) jwt_name = PyUnicode_InternFromString("jwt");
+
+  std::vector<PyObject*> vals(static_cast<size_t>(n));
+  Py_ssize_t max_len = 1;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* inp = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject* v = GatherValue(inp, mode, root, leaf, missing, attr_name,
+                              aux_name, jwt_name);
+    vals[static_cast<size_t>(i)] = v;
+    if (PyList_Check(v)) {
+      Py_ssize_t len = PyList_GET_SIZE(v);
+      if (len > max_len) max_len = len;
+    }
+  }
+  Py_ssize_t width = 4;
+  while (width < max_len) width *= 2;
+
+  PyObject* sids_b = PyBytes_FromStringAndSize(nullptr, n * width * 4);
+  if (!sids_b) {
+    for (PyObject* v : vals) Py_DECREF(v);
+    Py_DECREF(seq);
+    PyBuffer_Release(&state_b);
+    return nullptr;
+  }
+  int32_t* sids = reinterpret_cast<int32_t*>(PyBytes_AS_STRING(sids_b));
+  std::memset(sids, 0, static_cast<size_t>(n * width * 4));
+
+  bool fail = false;
+  for (Py_ssize_t i = 0; i < n && !fail; i++) {
+    PyObject* v = vals[static_cast<size_t>(i)];
+    if (v == missing) {
+      state[i] = 0;
+    } else if (PyDict_Check(v)) {
+      state[i] = 3;  // map membership is key membership: oracle territory
+    } else if (!PyList_Check(v)) {
+      state[i] = 2;
+    } else {
+      state[i] = 1;
+      Py_ssize_t len = PyList_GET_SIZE(v);
+      int32_t* row = sids + i * width;
+      for (Py_ssize_t j = 0; j < len; j++) {
+        PyObject* el = PyList_GET_ITEM(v, j);
+        if (!PyUnicode_Check(el)) {
+          row[j] = 0;  // non-string never equals a string constant
+          continue;
+        }
+        PyObject* id_obj = PyDict_GetItem(interner, el);  // borrowed
+        long id;
+        if (id_obj != nullptr) {
+          id = PyLong_AsLong(id_obj);
+        } else {
+          id = static_cast<long>(PyDict_Size(interner)) + 1;
+          PyObject* new_id = PyLong_FromLong(id);
+          if (!new_id || PyDict_SetItem(interner, el, new_id) < 0) {
+            Py_XDECREF(new_id);
+            fail = true;
+            break;
+          }
+          Py_DECREF(new_id);
+        }
+        row[j] = static_cast<int32_t>(id);
+      }
+    }
+  }
+  for (PyObject* v : vals) Py_DECREF(v);
+  Py_DECREF(seq);
+  PyBuffer_Release(&state_b);
+  if (fail) {
+    Py_DECREF(sids_b);
+    return nullptr;
+  }
+  PyObject* width_obj = PyLong_FromSsize_t(width);
+  PyObject* result = PyTuple_Pack(2, width_obj, sids_b);
+  Py_DECREF(width_obj);
+  Py_DECREF(sids_b);
+  return result;
+}
+
+// resolve_effects(BA, K, J, D, C, ba_input_i32, cand_cond_i32, cand_drcond_i32,
+//                 cand_effect_i8, cand_pt_i8, cand_depth_i8, cand_valid_u8,
+//                 scope_sp_i8, sat_cond_u8, allow_code, deny_code, sp_override,
+//                 final_i8[BA*4], role_results_i8[BA*K*2*2], win_j_i8[BA*K*2])
+//
+// The effect-resolution lattice (evaluator._compute's post-sat half) as one
+// fused pass: per (input,action) cell walk roles × depths, first-DENY /
+// first-ALLOW-with-OVERRIDE per depth, then the role/policy-type merge.
+// Semantically identical to the numpy/jax lattice — the numpy fallback calls
+// this to replace ~40 small-array kernel launches with one memory pass; the
+// jax path keeps the XLA lattice for device execution.
+PyObject* PyResolveEffects(PyObject*, PyObject* args) {
+  int BA, K, J, D, C;
+  Py_buffer ba_b, cc_b, cd_b, ce_b, cp_b, cdep_b, cv_b, sp_b, sat_b;
+  int allow_code, deny_code, sp_override;
+  Py_buffer fin_b, rr_b, wj_b;
+  if (!PyArg_ParseTuple(args, "iiiiiy*y*y*y*y*y*y*y*y*iiiw*w*w*", &BA, &K, &J,
+                        &D, &C, &ba_b, &cc_b, &cd_b, &ce_b, &cp_b, &cdep_b,
+                        &cv_b, &sp_b, &sat_b, &allow_code, &deny_code,
+                        &sp_override, &fin_b, &rr_b, &wj_b)) {
+    return nullptr;
+  }
+  struct Bufs {
+    std::vector<Py_buffer*> bufs;
+    ~Bufs() {
+      for (auto* b : bufs) PyBuffer_Release(b);
+    }
+  } release{{&ba_b, &cc_b, &cd_b, &ce_b, &cp_b, &cdep_b, &cv_b, &sp_b, &sat_b,
+             &fin_b, &rr_b, &wj_b}};
+  const Py_ssize_t cells = static_cast<Py_ssize_t>(BA) * K * J;
+  if (ba_b.len < static_cast<Py_ssize_t>(BA * 4) ||
+      cc_b.len < cells * 4 || cd_b.len < cells * 4 || ce_b.len < cells ||
+      cp_b.len < cells || cdep_b.len < cells || cv_b.len < cells ||
+      fin_b.len < static_cast<Py_ssize_t>(BA) * 4 ||
+      rr_b.len < static_cast<Py_ssize_t>(BA) * K * 4 ||
+      wj_b.len < static_cast<Py_ssize_t>(BA) * K * 2) {
+    PyErr_SetString(PyExc_ValueError, "buffer sizes inconsistent");
+    return nullptr;
+  }
+  const int32_t* ba_input = static_cast<const int32_t*>(ba_b.buf);
+  const int32_t* cand_cond = static_cast<const int32_t*>(cc_b.buf);
+  const int32_t* cand_drcond = static_cast<const int32_t*>(cd_b.buf);
+  const int8_t* cand_effect = static_cast<const int8_t*>(ce_b.buf);
+  const int8_t* cand_pt = static_cast<const int8_t*>(cp_b.buf);
+  const int8_t* cand_depth = static_cast<const int8_t*>(cdep_b.buf);
+  const uint8_t* cand_valid = static_cast<const uint8_t*>(cv_b.buf);
+  const int8_t* scope_sp = static_cast<const int8_t*>(sp_b.buf);
+  const uint8_t* sat_cond = static_cast<const uint8_t*>(sat_b.buf);
+  int8_t* fin = static_cast<int8_t*>(fin_b.buf);
+  int8_t* rr = static_cast<int8_t*>(rr_b.buf);
+  int8_t* wj_out = static_cast<int8_t*>(wj_b.buf);
+
+  constexpr int kNoMatch = 0, kAllow = 1, kDeny = 2;
+  constexpr int kBig = 127;
+
+  // scope_sp/sat_cond are indexed by input id b and (for sat) condition
+  // column: validate against the largest b and cond id actually referenced
+  // so a mis-sized array raises instead of reading out of bounds
+  {
+    int32_t max_b = -1;
+    for (int ba = 0; ba < BA; ba++) {
+      if (ba_input[ba] < 0) {
+        PyErr_SetString(PyExc_ValueError, "negative ba_input entry");
+        return nullptr;
+      }
+      if (ba_input[ba] > max_b) max_b = ba_input[ba];
+    }
+    if (sp_b.len < static_cast<Py_ssize_t>(max_b + 1) * 2 * D ||
+        sat_b.len < static_cast<Py_ssize_t>(max_b + 1) * C) {
+      PyErr_SetString(PyExc_ValueError,
+                      "scope_sp/sat buffers too small for referenced inputs");
+      return nullptr;
+    }
+    for (Py_ssize_t idx = 0; idx < cells; idx++) {
+      if (cand_cond[idx] >= C || cand_drcond[idx] >= C) {
+        PyErr_SetString(PyExc_ValueError, "cand cond id out of sat range");
+        return nullptr;
+      }
+    }
+  }
+
+  Py_BEGIN_ALLOW_THREADS
+  for (int ba = 0; ba < BA; ba++) {
+    const int b = ba_input[ba];
+    const uint8_t* sat_row = sat_cond + static_cast<Py_ssize_t>(b) * C;
+    const int8_t* sp_row = scope_sp + static_cast<Py_ssize_t>(b) * 2 * D;
+    // per (k, pt) results
+    for (int pt = 0; pt < 2; pt++) {
+      for (int k = 0; k < K; k++) {
+        int code = kNoMatch, depth_out = D, wj = -1;
+        bool decided = false;
+        const Py_ssize_t cell = (static_cast<Py_ssize_t>(ba) * K + k) * J;
+        for (int d = 0; d < D && !decided; d++) {
+          bool deny_d = false, allow_d = false;
+          int deny_j = kBig;
+          for (int j = 0; j < J; j++) {
+            const Py_ssize_t idx = cell + j;
+            if (!cand_valid[idx]) continue;
+            if (cand_pt[idx] != pt || cand_depth[idx] != d) continue;
+            const int32_t cond = cand_cond[idx];
+            if (cond >= 0 && !sat_row[cond]) continue;
+            const int32_t dr = cand_drcond[idx];
+            if (dr >= 0 && !sat_row[dr]) continue;
+            const int8_t eff = cand_effect[idx];
+            if (eff == deny_code) {
+              deny_d = true;
+              if (j < deny_j) deny_j = j;
+            } else if (eff == allow_code) {
+              allow_d = true;
+            }
+          }
+          const bool allow_ok = allow_d && sp_row[pt * D + d] == sp_override;
+          if (deny_d) {
+            code = kDeny;
+            depth_out = d;
+            wj = deny_j;
+            decided = true;
+          } else if (allow_ok) {
+            code = kAllow;
+            depth_out = d;
+            decided = true;
+          }
+        }
+        const Py_ssize_t rr_idx = ((static_cast<Py_ssize_t>(ba) * K + k) * 2 + pt) * 2;
+        rr[rr_idx] = static_cast<int8_t>(code);
+        rr[rr_idx + 1] = static_cast<int8_t>(depth_out);
+        wj_out[(static_cast<Py_ssize_t>(ba) * K + k) * 2 + pt] =
+            static_cast<int8_t>(wj);
+      }
+    }
+    // merge: principal pass uses role 0 only; resource pass picks the first
+    // role with ALLOW, else the first role with any non-NO_MATCH, else 0
+    const Py_ssize_t base = static_cast<Py_ssize_t>(ba) * K;
+    const int p_code = rr[(base * 2 + 0) * 2];
+    const int p_depth = rr[(base * 2 + 0) * 2 + 1];
+    int r_pick = 0;
+    {
+      int allow_k = kBig, nonmatch_k = kBig;
+      for (int k = 0; k < K; k++) {
+        const int code = rr[((base + k) * 2 + 1) * 2];
+        if (code == kAllow && allow_k == kBig) allow_k = k;
+        if (code != kNoMatch && nonmatch_k == kBig) nonmatch_k = k;
+      }
+      r_pick = allow_k < kBig ? allow_k : (nonmatch_k < kBig ? nonmatch_k : 0);
+    }
+    const int r_code = rr[((base + r_pick) * 2 + 1) * 2];
+    const int r_depth = rr[((base + r_pick) * 2 + 1) * 2 + 1];
+    const bool use_p = p_code != kNoMatch;
+    fin[static_cast<Py_ssize_t>(ba) * 4] =
+        static_cast<int8_t>(use_p ? p_code : r_code);
+    fin[static_cast<Py_ssize_t>(ba) * 4 + 1] = static_cast<int8_t>(use_p ? 0 : 1);
+    fin[static_cast<Py_ssize_t>(ba) * 4 + 2] =
+        static_cast<int8_t>(use_p ? p_depth : r_depth);
+    fin[static_cast<Py_ssize_t>(ba) * 4 + 3] =
+        static_cast<int8_t>(use_p ? 0 : r_pick);
+  }
+  Py_END_ALLOW_THREADS
   Py_RETURN_NONE;
 }
 
@@ -588,6 +873,12 @@ PyMethodDef kMethods[] = {
     {"encode_attr_column", PyEncodeAttrColumn, METH_VARARGS,
      "encode_attr_column(inputs, mode, root, leaf, interner, missing, err, "
      "tags, hi, lo, sid, nan) — fused gather + encode"},
+    {"encode_list_column", PyEncodeListColumn, METH_VARARGS,
+     "encode_list_column(inputs, mode, root, leaf, interner, missing, state) "
+     "-> (width, sids_bytes) — fused gather + intern for string lists"},
+    {"resolve_effects", PyResolveEffects, METH_VARARGS,
+     "resolve_effects(...) — fused effect-resolution lattice over the "
+     "candidate tensors (numpy-path replacement for _compute's second half)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

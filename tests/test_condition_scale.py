@@ -89,3 +89,56 @@ def test_scale_corpus_parity(scale_table, use_jax):
         assert {a: (e.effect, e.policy) for a, e in g.actions.items()} == {
             a: (e.effect, e.policy) for a, e in w.actions.items()
         }
+
+
+N_BIG = 5_000  # 10,000 distinct condition kernels
+
+
+@pytest.fixture(scope="module")
+def big_scale_table():
+    return build_rule_table(
+        compile_policy_set(list(parse_policies(distinct_condition_corpus(N_BIG))))
+    )
+
+
+def _steady_seconds(ev, inputs, params, iters=5) -> float:
+    import time
+
+    ev.check(inputs, params)  # warm: jit trace / caches
+    ev.check(inputs, params)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.process_time()
+        ev.check(inputs, params)
+        best = min(best, time.process_time() - t0)
+    return best
+
+
+@pytest.mark.parametrize("use_jax", [False, True])
+def test_10k_kernel_steady_state_within_2x(scale_table, big_scale_table, use_jax):
+    """VERDICT r3 item 2: a batch referencing a sparse slice of a 10k-kernel
+    table must run within 2x of the same batch against a 100-kernel table —
+    on BOTH backends. The group-member variants make sat (and the jit trace)
+    O(active conditions), so table size stops being a per-batch cost."""
+    params = EvalParams()
+    # same request slice (kinds 0..N-1) against both tables
+    inputs = scale_inputs(N, 512)
+
+    ev_small = TpuEvaluator(scale_table, use_jax=use_jax, min_device_batch=0)
+    ev_big = TpuEvaluator(big_scale_table, use_jax=use_jax, min_device_batch=0)
+
+    # parity first: the big table must decide the slice identically
+    got = ev_big.check(inputs, params)
+    assert ev_big.stats["oracle_inputs"] == 0
+    for inp, g in zip(inputs, got):
+        w = check_input(big_scale_table, inp, params)
+        assert {a: (e.effect, e.policy) for a, e in g.actions.items()} == {
+            a: (e.effect, e.policy) for a, e in w.actions.items()
+        }
+
+    t_small = _steady_seconds(ev_small, inputs, params)
+    t_big = _steady_seconds(ev_big, inputs, params)
+    assert t_big <= 2.0 * t_small + 0.005, (
+        f"10k-kernel steady state {t_big * 1e3:.1f}ms vs "
+        f"100-kernel {t_small * 1e3:.1f}ms exceeds 2x"
+    )
