@@ -238,6 +238,145 @@ def _next_bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
+class _StackLayout:
+    """Static description of how column families were stacked for transfer.
+
+    ``sig`` participates in the jit-cache key: two batches share a trace only
+    when the path orders, list widths and presence flags line up."""
+
+    __slots__ = ("paths", "ts_paths", "list_paths", "list_widths", "pred_ids",
+                 "D", "has_now", "sig")
+
+    def __init__(self, paths, ts_paths, list_paths, list_widths, pred_ids, D, has_now):
+        self.paths = paths
+        self.ts_paths = ts_paths
+        self.list_paths = list_paths
+        self.list_widths = list_widths
+        self.pred_ids = pred_ids
+        self.D = D
+        self.has_now = has_now
+        self.sig = (paths, ts_paths, list_paths, list_widths, pred_ids, D, has_now)
+
+
+def _stack_padded(padded: dict) -> tuple[dict, _StackLayout]:
+    """Fuse the per-path column dicts into a handful of typed matrices so a
+    device dispatch costs O(1) host->device transfers (see _device_eval)."""
+    paths = tuple(sorted(padded["tags"]))
+    ts_paths = tuple(sorted(padded["ts_his"]))
+    list_paths = tuple(sorted(padded["list_sids"]))
+    list_widths = tuple(int(padded["list_sids"][p].shape[1]) for p in list_paths)
+    pred_ids = tuple(sorted(padded["pred_vals"]))
+    scope_sp = padded["scope_sp"]
+    B = scope_sp.shape[0]
+    D = scope_sp.shape[2]
+    has_now = padded["now_hi"] is not None
+
+    i32_rows = (
+        [padded["his"][p] for p in paths]
+        + [padded["los"][p] for p in paths]
+        + [padded["sids"][p] for p in paths]
+        + [padded["ts_his"][p] for p in ts_paths]
+        + [padded["ts_los"][p] for p in ts_paths]
+    )
+    i32_cols = np.stack(i32_rows) if i32_rows else np.zeros((0, B), dtype=np.int32)
+    i8_rows = (
+        [padded["tags"][p] for p in paths]
+        + [padded["ts_states"][p] for p in ts_paths]
+        + [padded["list_states"][p] for p in list_paths]
+    )
+    i8_cols = np.concatenate(
+        [
+            np.stack(i8_rows).astype(np.int8) if i8_rows else np.zeros((0, B), np.int8),
+            np.ascontiguousarray(scope_sp.transpose(1, 2, 0).reshape(2 * D, B)),
+        ]
+    )
+    bool_rows = (
+        [padded["nans"][p] for p in paths]
+        + [padded["pred_vals"][q] for q in pred_ids]
+        + [padded["pred_errs"][q] for q in pred_ids]
+    )
+    bool_cols = np.stack(bool_rows) if bool_rows else np.zeros((0, B), dtype=bool)
+    if list_paths:
+        wmax = max(list_widths)
+        lists = np.zeros((len(list_paths), B, wmax), dtype=np.int32)
+        for i, p in enumerate(list_paths):
+            a = padded["list_sids"][p]
+            lists[i, :, : a.shape[1]] = a
+    else:
+        lists = np.zeros((0, B, 1), dtype=np.int32)
+    cand_i32 = np.stack([padded["cand_cond"], padded["cand_drcond"]])
+    cand_i8 = np.stack(
+        [
+            padded["cand_effect"],
+            padded["cand_pt"],
+            padded["cand_depth"],
+            padded["cand_valid"].astype(np.int8),
+        ]
+    )
+    now = (
+        np.asarray([int(padded["now_hi"]), int(padded["now_lo"])], dtype=np.int32)
+        if has_now
+        else np.zeros(2, dtype=np.int32)
+    )
+    layout = _StackLayout(paths, ts_paths, list_paths, list_widths, pred_ids, D, has_now)
+    stacked = dict(
+        i32_cols=i32_cols,
+        i8_cols=i8_cols,
+        bool_cols=bool_cols,
+        lists=lists,
+        cand_i32=cand_i32,
+        cand_i8=cand_i8,
+        ba_input=padded["ba_input"],
+        now=now,
+    )
+    return stacked, layout
+
+
+def _unstack_padded(xp, lay: _StackLayout, kw: dict) -> dict:
+    """Inverse of _stack_padded, executed INSIDE the traced graph (slices of
+    traced arrays are free — XLA fuses them into the consumers)."""
+    i32 = kw["i32_cols"]
+    i8 = kw["i8_cols"]
+    bools = kw["bool_cols"]
+    lists = kw["lists"]
+    cand_i32 = kw["cand_i32"]
+    cand_i8 = kw["cand_i8"]
+    P = len(lay.paths)
+    T = len(lay.ts_paths)
+    L = len(lay.list_paths)
+    his = {p: i32[i] for i, p in enumerate(lay.paths)}
+    los = {p: i32[P + i] for i, p in enumerate(lay.paths)}
+    sids = {p: i32[2 * P + i] for i, p in enumerate(lay.paths)}
+    ts_his = {p: i32[3 * P + i] for i, p in enumerate(lay.ts_paths)}
+    ts_los = {p: i32[3 * P + T + i] for i, p in enumerate(lay.ts_paths)}
+    tags = {p: i8[i] for i, p in enumerate(lay.paths)}
+    ts_states = {p: i8[P + i] for i, p in enumerate(lay.ts_paths)}
+    list_states = {p: i8[P + T + i] for i, p in enumerate(lay.list_paths)}
+    B = i8.shape[1]
+    scope_sp = i8[P + T + L :].reshape(2, lay.D, B).transpose(2, 0, 1)
+    nans = {p: bools[i] for i, p in enumerate(lay.paths)}
+    Q = len(lay.pred_ids)
+    pred_vals = {q: bools[P + i] for i, q in enumerate(lay.pred_ids)}
+    pred_errs = {q: bools[P + Q + i] for i, q in enumerate(lay.pred_ids)}
+    list_sids = {
+        p: lists[i][:, : lay.list_widths[i]] for i, p in enumerate(lay.list_paths)
+    }
+    now_hi = kw["now"][0] if lay.has_now else None
+    now_lo = kw["now"][1] if lay.has_now else None
+    return dict(
+        tags=tags, his=his, los=los, sids=sids, nans=nans,
+        pred_vals=pred_vals, pred_errs=pred_errs,
+        ba_input=kw["ba_input"],
+        cand_cond=cand_i32[0], cand_drcond=cand_i32[1],
+        cand_effect=cand_i8[0], cand_pt=cand_i8[1], cand_depth=cand_i8[2],
+        cand_valid=cand_i8[3].astype(bool),
+        scope_sp=scope_sp,
+        list_sids=list_sids, list_states=list_states,
+        ts_his=ts_his, ts_los=ts_los, ts_states=ts_states,
+        now_hi=now_hi, now_lo=now_lo,
+    )
+
+
 def _variant_remap(variant, compiler, C, cand_cond, cand_drcond):
     """col_map + compact-space remap of the candidate id arrays for one
     group-member variant. Single source of truth for both the primary
@@ -340,12 +479,17 @@ def _device_eval(
         B_pad = _next_bucket(B)
         BA_pad = _next_bucket(BA)
         full_variant = tuple((gi, None) for gi in range(len(compiler.groups)))
+        # budget DISTINCT VARIANTS, not cache entries: shape-bucket churn must
+        # not evict sparse variants that are already compiled
+        seen_variants = jit_cache.setdefault(("_variant_budget",), set())
         if (
             variant_key != full_variant
-            and (B_pad, BA_pad, K, J, D, variant_key) not in jit_cache
-            and len(jit_cache) >= 32
+            and variant_key not in seen_variants
+            and len(seen_variants) >= 32
         ):
             variant_key = full_variant
+        else:
+            seen_variants.add(variant_key)
 
     # remap candidate cond ids into compact columns (-1 preserved); by the
     # active-set construction every referenced id has a compact column
@@ -448,24 +592,71 @@ def _device_eval(
     )
 
     if mesh is not None:
+        # multi-chip path: per-path arrays shard independently over the
+        # mesh's batch axis; transfer fusion doesn't apply (and would fight
+        # the shardings), so call _compute directly
         from ..parallel.mesh import shard_packed_arrays
 
         padded = shard_packed_arrays(padded, mesh)
+        key = (B_pad, BA_pad, K, J, D, variant_key)
+        fn = jit_cache.get(key)
+        if fn is None:
+            vt = variant_key  # bind the static variant into the trace
+            fn = jax.jit(lambda **kw: _compute(jnp, compiler, K, J, D, variant=vt, **kw))
+            jit_cache[key] = fn
+        final, role_results, win_j, sat_arr = fn(**padded)
+        return (
+            np.asarray(final)[:BA],
+            np.asarray(role_results)[:BA],
+            np.asarray(win_j)[:BA],
+            np.asarray(sat_arr)[:B],
+            col_map,
+        )
 
-    key = (B_pad, BA_pad, K, J, D, variant_key)
+    # single-chip path: FUSE TRANSFERS. Every host->device put and
+    # device->host fetch pays the interconnect's per-transfer latency (on a
+    # tunneled chip, milliseconds each), and the naive call ships ~5 arrays
+    # per column path (100+ puts) and fetches 4 results. Stack all per-path
+    # columns into a handful of typed matrices host-side — slicing them back
+    # apart INSIDE the traced graph is free (XLA fuses) — and pack every
+    # result into one int8 vector on device, so a batch costs ~8 puts + 1
+    # fetch regardless of how many columns the table has.
+    stacked, layout = _stack_padded(padded)
+    key = (B_pad, BA_pad, K, J, D, variant_key, layout.sig)
     fn = jit_cache.get(key)
     if fn is None:
-        vt = variant_key  # bind the static variant into the trace
-        fn = jax.jit(lambda **kw: _compute(jnp, compiler, K, J, D, variant=vt, **kw))
+        vt = variant_key
+        lay = layout
+
+        def run(**kw):
+            parts = _unstack_padded(jnp, lay, kw)
+            final, role_results, win_j, sat_arr = _compute(
+                jnp, compiler, K, J, D, variant=vt, **parts
+            )
+            out = jnp.concatenate(
+                [
+                    final.reshape(BA_pad, -1).astype(jnp.int8),
+                    role_results.reshape(BA_pad, -1).astype(jnp.int8),
+                    win_j.reshape(BA_pad, -1).astype(jnp.int8),
+                ],
+                axis=1,
+            )
+            return jnp.concatenate(
+                [out.ravel(), sat_arr.astype(jnp.int8).ravel()]
+            )
+
+        fn = jax.jit(run)
         jit_cache[key] = fn
-    final, role_results, win_j, sat_arr = fn(**padded)
-    return (
-        np.asarray(final)[:BA],
-        np.asarray(role_results)[:BA],
-        np.asarray(win_j)[:BA],
-        np.asarray(sat_arr)[:B],
-        col_map,
-    )
+    flat = np.asarray(fn(**stacked))  # ONE device->host fetch
+    per_ba = 4 + K * 2 * 2 + K * 2
+    cut = BA_pad * per_ba
+    out_mat = flat[:cut].reshape(BA_pad, per_ba)
+    A_sat = max((flat.size - cut) // B_pad, 1)
+    final = out_mat[:BA, :4]
+    role_results = out_mat[:BA, 4 : 4 + K * 4].reshape(BA, K, 2, 2)
+    win_j = out_mat[:BA, 4 + K * 4 :].reshape(BA, K, 2)
+    sat_arr = flat[cut:].reshape(B_pad, A_sat)[:B].astype(bool)
+    return final, role_results, win_j, sat_arr, col_map
 
 
 class TpuEvaluator:

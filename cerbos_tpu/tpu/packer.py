@@ -297,18 +297,25 @@ class Packer:
         K_max, J_max, chain_max = 1, 1, 1
         sp_uids: list[int] = []
         plans_append = plans.append
+        idx_principal = self.lt.table.idx.principal
         for inp in inputs:
             principal = inp.principal
             resource = inp.resource
+            # principals with no principal policy anywhere canonicalize to
+            # one shape: the id cannot influence any decision (the index has
+            # no rows for it), so per-request-unique ids share the shape
+            # memo, the assembly memo AND the jit variant instead of
+            # rebuilding everything per request
+            pid = principal.id if principal.id in idx_principal else ""
             sk = (
-                principal.id, principal.scope, principal.policy_version,
+                pid, principal.scope, principal.policy_version,
                 resource.kind, resource.scope, resource.policy_version,
                 tuple(principal.roles), tuple(inp.actions), lenient,
                 params.default_scope, params.default_policy_version,
             )
             hit = shape_memo.get(sk)
             if hit is None:
-                hit = self._build_shape(inp, params, lenient)
+                hit = self._build_shape(inp, params, lenient, pid)
                 shape_memo[sk] = hit
             (p_scopes, r_scopes, p_key, r_key, r_fqn, sp_exists, sr_exists,
              roles, trivial, oracle, blk_uids, blk_entries, uniq_actions,
@@ -431,33 +438,48 @@ class Packer:
         return uid
 
     def _stacked_blocks(self, K: int, J: int) -> list[np.ndarray]:
-        """[n_blocks, K, J] stacks of every registered block, padded; cached
-        per (K, J) until new blocks register (steady state: pure cache hit)."""
+        """[n_blocks, K, J] stacks of every registered block, padded.
+
+        Grows INCREMENTALLY per (K, J) bucket: new registrations append into
+        capacity-doubled arrays (amortized O(new blocks), not O(all blocks)
+        per batch). Buckets are few (pow2 K/J), but evict wholesale past a
+        small cap so stale buckets don't pin old full-size stacks."""
         n = len(self._block_store)
         hit = self._block_stacked.get((K, J))
         if hit is not None and hit[0] == n:
-            return hit[1]
-        pc = np.full((n, K, J), -1, dtype=np.int32)
-        pd = np.full((n, K, J), -1, dtype=np.int32)
-        pe = np.zeros((n, K, J), dtype=np.int8)
-        pp = np.zeros((n, K, J), dtype=np.int8)
-        pdep = np.full((n, K, J), -1, dtype=np.int8)
-        pv = np.zeros((n, K, J), dtype=bool)
-        for i, blk in enumerate(self._block_store):
+            return [a[:n] for a in hit[1]]
+        if hit is not None and hit[1][0].shape[0] >= n:
+            start, arrays = hit[0], hit[1]
+        else:
+            cap = max(16, 1 << (n - 1).bit_length()) if n else 16
+            arrays = [
+                np.full((cap, K, J), -1, dtype=np.int32),
+                np.full((cap, K, J), -1, dtype=np.int32),
+                np.zeros((cap, K, J), dtype=np.int8),
+                np.zeros((cap, K, J), dtype=np.int8),
+                np.full((cap, K, J), -1, dtype=np.int8),
+                np.zeros((cap, K, J), dtype=bool),
+            ]
+            if hit is not None:
+                old_n = hit[0]
+                for a, old in zip(arrays, hit[1]):
+                    a[:old_n] = old[:old_n]
+                start = old_n
+            else:
+                start = 0
+        for i in range(start, n):
+            blk = self._block_store[i]
             kk, jj = blk[0].shape
             # blocks larger than this batch's (K, J) bucket can never be
             # gathered by it (the bucket covers the batch max), so truncating
             # them in this stack is safe
             kk, jj = min(kk, K), min(jj, J)
-            pc[i, :kk, :jj] = blk[0][:kk, :jj]
-            pd[i, :kk, :jj] = blk[1][:kk, :jj]
-            pe[i, :kk, :jj] = blk[2][:kk, :jj]
-            pp[i, :kk, :jj] = blk[3][:kk, :jj]
-            pdep[i, :kk, :jj] = blk[4][:kk, :jj]
-            pv[i, :kk, :jj] = blk[5][:kk, :jj]
-        stacked = [pc, pd, pe, pp, pdep, pv]
-        self._block_stacked[(K, J)] = (n, stacked)
-        return stacked
+            for a, src in zip(arrays, blk[:6]):
+                a[i, :kk, :jj] = src[:kk, :jj]
+        if len(self._block_stacked) > 8 and (K, J) not in self._block_stacked:
+            self._block_stacked.clear()
+        self._block_stacked[(K, J)] = (n, arrays)
+        return [a[:n] for a in arrays]
 
     def _stacked_sp(self) -> np.ndarray:
         n = len(self._sp_store)
@@ -468,18 +490,20 @@ class Packer:
         self._sp_stacked = (n, stacked)
         return stacked
 
-    def _build_shape(self, inp: T.CheckInput, params: T.EvalParams, lenient: bool) -> tuple:
+    def _build_shape(self, inp: T.CheckInput, params: T.EvalParams, lenient: bool, pid: str) -> tuple:
         """Resolve the full packing product for one request shape: plan
         fields, candidate blocks per unique action, scope-permission row and
         K/J/D extents. Runs once per distinct shape; every input with the
-        same shape reuses the result verbatim."""
+        same shape reuses the result verbatim. ``pid`` is the CANONICAL
+        principal id ("" when the id has no principal policy rows — see
+        pack(); such ids cannot influence decisions)."""
         rt = self.lt.table
         principal_scope = T.effective_scope(inp.principal.scope, params)
         principal_version = T.effective_version(inp.principal.policy_version, params)
         resource_scope = T.effective_scope(inp.resource.scope, params)
         resource_version = T.effective_version(inp.resource.policy_version, params)
         p_scopes, p_key, _p_fqn = self._get_all_scopes(
-            KIND_PRINCIPAL, principal_scope, inp.principal.id, principal_version, lenient
+            KIND_PRINCIPAL, principal_scope, pid, principal_version, lenient
         )
         r_scopes, r_key, r_fqn = self._get_all_scopes(
             KIND_RESOURCE, resource_scope, inp.resource.kind, resource_version, lenient
@@ -511,7 +535,7 @@ class Packer:
                     continue
                 seen.add(a)
                 blk = self._cell_block(
-                    inp, p_scopes, r_scopes, roles, a, resource_version, resource_scope
+                    inp, pid, p_scopes, r_scopes, roles, a, resource_version, resource_scope
                 )
                 if blk is None:
                     oracle = True
@@ -542,6 +566,7 @@ class Packer:
     def _cell_block(
         self,
         inp: T.CheckInput,
+        pid: str,
         p_scopes: list[str],
         r_scopes: list[str],
         roles: list[str],
@@ -550,10 +575,10 @@ class Packer:
         resource_scope: str,
     ) -> Optional[tuple]:
         """Candidate cell for one (shape, action); memoized across shapes
-        that share the dimension tuple. None → oracle fallback."""
+        that share the dimension tuple. None → oracle fallback. ``pid`` is
+        already canonical (see pack())."""
         cell_blocks = self._cell_cache
-        pid = inp.principal.id
-        pid_key = pid if pid in self.lt.table.idx.principal else ""
+        pid_key = pid
         key = (
             resource_version, inp.resource.kind, tuple(p_scopes),
             tuple(r_scopes), tuple(roles), action, pid_key, resource_scope,
@@ -572,6 +597,11 @@ class Packer:
             ):
                 if pt == PT_PRINCIPAL and k > 0:
                     continue  # principal pass uses only the first role
+                if pt == PT_PRINCIPAL and not qpid:
+                    # canonical "" = this principal id has no rows anywhere
+                    # (see pack()); an empty id would mean match-all to
+                    # Index.query, so don't query at all
+                    continue
                 cands = self._candidates(
                     pt, resource_version, sanitized, chain, action, role, qpid, resource_scope
                 )

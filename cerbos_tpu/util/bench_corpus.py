@@ -458,6 +458,69 @@ def _diverse_request(rng: random.Random, i: int) -> CheckInput:
     )
 
 
+def requests_unique(n: int, n_mods: int, seed: int = 7) -> list[CheckInput]:
+    """Adversarial (memo-cold) variant of ``requests``: every request carries
+    globally-unique attribute values and a unique principal id, defeating the
+    evaluator's value-level memos (encode/list/ts/pred caches), the assembly
+    memo AND the shape memo — while preserving each condition's truth value,
+    so the decision mix matches the replay workload:
+
+    - principal id and resource owner get the SAME unique suffix, keeping
+      ``R.attr.owner == P.id`` outcomes intact while making both unique;
+    - numeric attrs get an epsilon jitter far below any compared constant's
+      granularity;
+    - ip_address is drawn uniquely inside (or outside) the compared CIDR;
+    - tag lists gain a unique extra element (membership tests unaffected);
+    - timestamps jitter at second granularity within the same day.
+    """
+    rng = random.Random(seed * 7919 + 13)
+    out = []
+    for i, inp in enumerate(requests(n, n_mods, seed)):
+        uid = f"u{seed}-{i}"
+        p, r = inp.principal, inp.resource
+        pattr = dict(p.attr)
+        rattr = dict(r.attr)
+        pid = p.id
+        if "owner" in rattr:
+            rattr["owner"] = f"{rattr['owner']}-{uid}"
+            if pid == rattr.get("owner", "").rsplit("-", 2)[0]:
+                pid = rattr["owner"]
+        if pid == p.id:
+            pid = f"{p.id}-{uid}"
+        for k in ("level", "score", "priority", "clearance", "sensitivity"):
+            if k in rattr and isinstance(rattr[k], float):
+                rattr[k] = rattr[k] + rng.random() * 1e-4
+            if k in pattr and isinstance(pattr[k], float):
+                pattr[k] = pattr[k] + rng.random() * 1e-4
+        if "ip_address" in pattr:
+            if pattr["ip_address"].startswith("10.20."):
+                pattr["ip_address"] = f"10.20.{rng.randrange(256)}.{rng.randrange(1, 255)}"
+            else:
+                pattr["ip_address"] = f"192.168.{rng.randrange(256)}.{rng.randrange(1, 255)}"
+        if isinstance(rattr.get("tags"), list):
+            rattr["tags"] = list(rattr["tags"]) + [f"tag-{uid}"]
+        if isinstance(rattr.get("created"), str) and rattr["created"].endswith("T10:00:00Z"):
+            rattr["created"] = rattr["created"].replace(
+                "T10:00:00Z", f"T10:{rng.randrange(60):02d}:{rng.randrange(60):02d}Z"
+            )
+        out.append(
+            CheckInput(
+                request_id=f"{inp.request_id}-{uid}",
+                principal=Principal(
+                    id=pid, scope=p.scope, policy_version=p.policy_version,
+                    roles=list(p.roles), attr=pattr,
+                ),
+                resource=Resource(
+                    kind=r.kind, id=f"{r.id}-{uid}", scope=r.scope,
+                    policy_version=r.policy_version, attr=rattr,
+                ),
+                actions=list(inp.actions),
+                aux_data=inp.aux_data,
+            )
+        )
+    return out
+
+
 def requests(n: int, n_mods: int, seed: int = 7) -> list[CheckInput]:
     """Mirror the cr_req01/cr_req02 request mix, one resource per CheckInput
     (the batcher recombines them): mostly 20210210 [view:public, approve]

@@ -73,10 +73,14 @@ def _hs256_token(claims: dict) -> str:
     return (header + b"." + payload + b"." + sig).decode()
 
 
-def _make_bodies(n_mods: int, n: int = 512) -> list[bytes]:
+def _make_bodies(n_mods: int, n: int = 512, unique: bool = False) -> list[bytes]:
     from cerbos_tpu.util import bench_corpus
 
-    inputs = bench_corpus.requests(n, n_mods)
+    inputs = (
+        bench_corpus.requests_unique(n, n_mods)
+        if unique
+        else bench_corpus.requests(n, n_mods)
+    )
     bodies = []
     for i in inputs:
         body = {
@@ -218,16 +222,22 @@ def _read_http_response(sock: socket.socket, buf: bytearray) -> bytes:
         buf.extend(chunk)
 
 
-def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int) -> dict:
+def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False) -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
     proc, http_port, grpc_port = spawn_server(tmp, workers, use_tpu)
-    bodies = _make_bodies(n_mods)
+    # --cold: a large pool of per-request-unique bodies (unique attr values
+    # and principal ids) so the server's value/shape/assembly memos miss;
+    # once the run exhausts the pool, repeats re-warm — the pool is sized so
+    # that only matters on very long runs
+    bodies = _make_bodies(n_mods, n=16384 if cold else 512, unique=cold)
 
     # warmup: every request shape once, before the timed window (the
     # reference's ghz harness runs a throughput probe before the sustained
-    # measurement, loadtest-classic.md:4-6)
-    warm_reqs = _http_request_bytes(bodies)
+    # measurement, loadtest-classic.md:4-6). In --cold mode the warmup uses
+    # the STANDARD replay set so jit/structural caches warm but the cold
+    # pool's value memos stay cold.
+    warm_reqs = _http_request_bytes(_make_bodies(n_mods) if cold else bodies)
     ws = socket.create_connection(("127.0.0.1", http_port))
     ws.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     wbuf = bytearray()
@@ -332,6 +342,7 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         "p99_ms": round(pct(0.99), 2),
         "connections": connections,
         "workers": workers,
+        "cold": cold,
         "host_cores": len(os.sched_getaffinity(0)),
         "policies": n_mods * 9,  # 9 policy documents per name-mod
         "duration_s": round(elapsed, 1),
@@ -346,8 +357,9 @@ def main() -> None:
     ap.add_argument("--workers", type=int, default=1, help="server worker processes")
     ap.add_argument("--grpc", action="store_true")
     ap.add_argument("--tpu", action="store_true", help="enable the TPU engine path")
+    ap.add_argument("--cold", action="store_true", help="per-request-unique bodies (memo-cold)")
     args = ap.parse_args()
-    result = run(args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers)
+    result = run(args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers, cold=args.cold)
     print(json.dumps(result))
 
 
