@@ -12,7 +12,10 @@ Two payload versions:
   parse, import/variable resolution) — the analogue of the reference's
   serialized rule table. Loading it skips the parse+compile pipeline
   entirely: at the 900-doc classic corpus cold start drops ~2.0s → ~0.06s,
-  at 8k docs ~12.6s → ~0.8s.
+  at 8k docs ~12.6s → ~0.35s (round 4: msgpack container, the native
+  linear node-pool decoder ``cerbos_native.decode_node_pool``, and
+  ``util/gctune.build_phase`` GC pacing took the 8k decode+build from
+  ~0.9s to ~0.35s; docs/PERF.md "Cold start" has the breakdown).
 
 The compiled IR is a structured, versioned encoding
 (``cerbos_tpu.bundle_codec``: tagged JSON over a closed node vocabulary) —
@@ -165,6 +168,12 @@ class BundleStore(Store):
         self._load(verify_checksum)
 
     def _load(self, verify_checksum: bool) -> None:
+        from .util import gctune
+
+        with gctune.build_phase():
+            self._load_inner(verify_checksum)
+
+    def _load_inner(self, verify_checksum: bool) -> None:
         with gzip.open(self.path, "rb") as f:
             data = f.read()
         entries: list[tuple[str, bytes]] = []

@@ -1,68 +1,406 @@
-"""Interactive CEL condition REPL.
+"""Interactive REPL: CEL expressions, variables, and policy-rule execution.
 
-Behavioral reference: cmd/cerbos/repl — evaluate CEL expressions with
-request variables, set P/R attributes with :let-style commands.
+Behavioral reference: cmd/cerbos/repl (directives in
+cmd/cerbos/repl/internal/help.txt) — evaluate CEL at the prompt with the
+result bound to ``_``, define variables with ``:let`` (special Cerbos
+variables take JSON), load policies with ``:load``, inspect rules with
+``:rules`` and execute a rule's condition with ``:exec #N``. Beyond the
+reference: when a condition references attributes the current P/R fixtures
+don't carry, ``:exec`` prints the RESIDUAL condition (via the query
+planner's partial evaluator) instead of just an error.
 """
 
 from __future__ import annotations
 
+import datetime as _dt
 import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
 
 from .cel import CelError, evaluate, parse
 from .cel.errors import CelParseError
-from .cel.interp import Activation, Message
+from .cel.interp import Activation
 from .cel.values import Timestamp
-import datetime as _dt
+from .engine import types as T
+from .ruletable.check import EvalContext, build_request_messages
+
+_HELP = """\
+Directives (reference: cmd/cerbos/repl help.txt):
+  :h | :help          Show this help
+  :q | :quit | :exit  Exit
+  :let x = <expr>     Define variable x (special vars take JSON:
+                      request, request.principal, request.resource,
+                      P, R, V, variables, G, globals)
+  :vars               View defined variables
+  :reset              Clear all variables and loaded rules
+  :load <path>        Load rules from a policy file or directory
+  :rules              View loaded rules (with their conditions)
+  :exec #N            Execute rule #N's condition against P/R; prints
+                      true/false, an error, or the RESIDUAL condition
+                      when attributes are missing
+Any other input is evaluated as a CEL expression; the result is bound to _.
+"""
+
+_SPECIALS = {
+    "request", "request.principal", "request.resource",
+    "P", "R", "V", "variables", "G", "globals",
+}
 
 
-def run_repl() -> int:
-    principal: dict = {"id": "user", "roles": ["user"], "attr": {}, "policyVersion": "", "scope": ""}
-    resource: dict = {"kind": "resource", "id": "r1", "attr": {}, "policyVersion": "", "scope": ""}
+@dataclass
+class LoadedRule:
+    label: str  # e.g. resource.leave_request.vdefault#rule-001
+    detail: str  # actions/roles/effect summary
+    condition: Any  # CompiledCondition | None
+    params: Any  # PolicyParams | None
+    cond_text: str
 
-    print("cerbos-tpu REPL — CEL expressions over request/P/R.")
-    print("Commands: :P.attr <json> | :R.attr <json> | :roles a,b | :vars | :q")
 
-    def build_activation() -> Activation:
-        p = Message(dict(principal))
-        r = Message(dict(resource))
-        jwt = Message({"jwt": {}})
-        req = Message({"principal": p, "resource": r, "auxData": jwt, "aux_data": jwt})
+@dataclass
+class ReplState:
+    principal: dict = field(default_factory=lambda: {
+        "id": "user", "roles": ["user"], "attr": {}, "policyVersion": "", "scope": "",
+    })
+    resource: dict = field(default_factory=lambda: {
+        "kind": "resource", "id": "r1", "attr": {}, "policyVersion": "", "scope": "",
+    })
+    aux_data: dict = field(default_factory=dict)  # jwt claims
+    user_vars: dict = field(default_factory=dict)
+    v_map: dict = field(default_factory=dict)
+    globals_map: dict = field(default_factory=dict)
+    rules: list[LoadedRule] = field(default_factory=list)
+
+
+def _cond_text(cond) -> str:
+    if cond is None:
+        return "(none)"
+    if cond.kind == "expr":
+        return cond.expr.original
+    inner = ", ".join(_cond_text(c) for c in cond.children)
+    return f"{cond.kind}({inner})"
+
+
+class Repl:
+    def __init__(self, out: Callable[[str], None] = print):
+        self.state = ReplState()
+        self.out = out
+
+    # -- evaluation plumbing ----------------------------------------------
+
+    def _check_input(self) -> T.CheckInput:
+        s = self.state
+        return T.CheckInput(
+            principal=T.Principal(
+                id=s.principal.get("id", ""),
+                roles=list(s.principal.get("roles", [])),
+                attr=dict(s.principal.get("attr", {})),
+                policy_version=s.principal.get("policyVersion", ""),
+                scope=s.principal.get("scope", ""),
+            ),
+            resource=T.Resource(
+                kind=s.resource.get("kind", ""),
+                id=s.resource.get("id", ""),
+                attr=dict(s.resource.get("attr", {})),
+                policy_version=s.resource.get("policyVersion", ""),
+                scope=s.resource.get("scope", ""),
+            ),
+            actions=[],
+            aux_data=T.AuxData(jwt=dict(s.aux_data)) if s.aux_data else None,
+        )
+
+    def _activation(self, constants: Optional[dict] = None, variables: Optional[dict] = None) -> Activation:
+        s = self.state
+        request, principal, resource = build_request_messages(self._check_input())
+        v = dict(s.v_map)
+        if variables:
+            v.update(variables)
+        base = {
+            "request": request, "P": principal, "R": resource,
+            "V": v, "variables": v,
+            "C": constants or {}, "constants": constants or {},
+            "G": s.globals_map, "globals": s.globals_map,
+        }
+        base.update(s.user_vars)
         return Activation(
-            {"request": req, "P": p, "R": r, "V": {}, "variables": {}, "C": {}, "constants": {}, "G": {}, "globals": {}},
+            base,
             now_fn=lambda: Timestamp.from_datetime(_dt.datetime.now(_dt.timezone.utc)),
         )
 
+    def _eval_expr(self, text: str) -> Any:
+        return evaluate(parse(text), self._activation())
+
+    # -- directives --------------------------------------------------------
+
+    def handle(self, line: str) -> bool:
+        """Process one line; returns False when the REPL should exit."""
+        line = line.strip()
+        if not line:
+            return True
+        try:
+            if line in (":q", ":quit", ":exit"):
+                return False
+            if line in (":h", ":help"):
+                self.out(_HELP)
+            elif line == ":vars":
+                self._cmd_vars()
+            elif line == ":reset":
+                self.state = ReplState()
+                self.out("state cleared")
+            elif line.startswith(":let "):
+                self._cmd_let(line[len(":let "):])
+            elif line.startswith(":load "):
+                self._cmd_load(line[len(":load "):].strip())
+            elif line == ":rules":
+                self._cmd_rules()
+            elif line.startswith(":exec "):
+                self._cmd_exec(line[len(":exec "):].strip())
+            elif line.startswith(":"):
+                self.out(f"unknown directive {line.split()[0]} (try :help)")
+            else:
+                result = self._eval_expr(line)
+                self.state.user_vars["_"] = result
+                self.out(_render(result))
+        except (CelError, CelParseError) as e:
+            self.out(f"error: {e}")
+        except OSError as e:
+            self.out(f"error: {e}")
+        return True
+
+    def _cmd_vars(self) -> None:
+        s = self.state
+        view = {
+            "request": {"principal": s.principal, "resource": s.resource,
+                        "auxData": {"jwt": s.aux_data}},
+            "V": s.v_map,
+            "G": s.globals_map,
+        }
+        for name, val in sorted(s.user_vars.items()):
+            view[name] = _jsonable(val)
+        self.out(json.dumps(view, indent=2, default=str))
+
+    def _cmd_let(self, rest: str) -> None:
+        name, eq, value = rest.partition("=")
+        name = name.strip()
+        value = value.strip()
+        if not eq or not name or not value:
+            self.out("usage: :let <name> = <expression | JSON for special vars>")
+            return
+        s = self.state
+        if name in _SPECIALS:
+            try:
+                data = json.loads(value)
+            except json.JSONDecodeError as e:
+                self.out(f"special variable {name} takes JSON: {e}")
+                return
+            if name == "request":
+                s.principal = _merged_entity(s.principal, data.get("principal", {}))
+                s.resource = _merged_entity(s.resource, data.get("resource", {}))
+                aux = data.get("auxData") or data.get("aux_data") or {}
+                s.aux_data = dict(aux.get("jwt", {})) if isinstance(aux, dict) else {}
+            elif name in ("P", "request.principal"):
+                s.principal = _merged_entity(s.principal, data)
+            elif name in ("R", "request.resource"):
+                s.resource = _merged_entity(s.resource, data)
+            elif name in ("V", "variables"):
+                s.v_map = dict(data)
+            else:  # G / globals
+                s.globals_map = dict(data)
+            self.out(f"{name} set")
+            return
+        result = self._eval_expr(value)
+        s.user_vars[name] = result
+        self.out(f"{name} = {_render(result)}")
+
+    def _cmd_load(self, path: str) -> None:
+        from .compile import compile_policy_set
+        from .compile.compiler import CompileError
+        from .policy.parser import ParseError, parse_policies
+
+        path = os.path.expanduser(path)
+        files: list[str] = []
+        if os.path.isdir(path):
+            for root, _dirs, fns in os.walk(path):
+                if "_schemas" in root.split(os.sep):
+                    continue
+                for fn in sorted(fns):
+                    if fn.startswith(".") or not fn.endswith((".yaml", ".yml", ".json")):
+                        continue
+                    files.append(os.path.join(root, fn))
+        else:
+            files.append(path)
+        policies = []
+        try:
+            for fp in files:
+                with open(fp, encoding="utf-8") as f:
+                    policies.extend(parse_policies(f.read(), source=fp))
+        except ParseError as e:
+            self.out(f"parse error: {e}")
+            return
+        try:
+            compiled = compile_policy_set(policies)
+        except CompileError as e:
+            self.out(f"compile error: {e}")
+            return
+        n_before = len(self.state.rules)
+        for cp in compiled:
+            self._ingest_compiled(cp)
+        added = len(self.state.rules) - n_before
+        self.out(f"loaded {added} rules from {len(compiled)} policies (total {len(self.state.rules)})")
+
+    def _ingest_compiled(self, cp) -> None:
+        from . import namer
+        from .compile.compiler import (
+            CompiledPrincipalPolicy,
+            CompiledResourcePolicy,
+            CompiledRolePolicy,
+        )
+
+        rules = self.state.rules
+        key = namer.policy_key_from_fqn(cp.fqn)
+        if isinstance(cp, CompiledResourcePolicy):
+            for name, dr in sorted(cp.derived_roles.items()):
+                rules.append(LoadedRule(
+                    label=f"{key}#derived:{name}",
+                    detail=f"derived role, parentRoles={sorted(dr.parent_roles)}",
+                    condition=dr.condition,
+                    params=dr.params,
+                    cond_text=_cond_text(dr.condition),
+                ))
+            for rule in cp.rules:
+                who = list(rule.roles) + [f"dr:{d}" for d in rule.derived_roles]
+                rules.append(LoadedRule(
+                    label=f"{key}#{rule.name}",
+                    detail=f"{rule.effect} actions={list(rule.actions)} roles={who}",
+                    condition=rule.condition,
+                    params=cp.params,
+                    cond_text=_cond_text(rule.condition),
+                ))
+        elif isinstance(cp, CompiledPrincipalPolicy):
+            for rule in cp.rules:
+                rules.append(LoadedRule(
+                    label=f"{key}#{rule.name}",
+                    detail=f"{rule.effect} resource={rule.resource} action={rule.action}",
+                    condition=rule.condition,
+                    params=cp.params,
+                    cond_text=_cond_text(rule.condition),
+                ))
+        elif isinstance(cp, CompiledRolePolicy):
+            for i, rule in enumerate(cp.rules):
+                rules.append(LoadedRule(
+                    label=f"{key}#rule-{i:03d}",
+                    detail=f"ALLOW resource={rule.resource} actions={sorted(rule.allow_actions)}",
+                    condition=rule.condition,
+                    params=cp.params,
+                    cond_text=_cond_text(rule.condition),
+                ))
+
+    def _cmd_rules(self) -> None:
+        if not self.state.rules:
+            self.out("no rules loaded (use :load <path>)")
+            return
+        for i, r in enumerate(self.state.rules, start=1):
+            self.out(f"#{i:<4} {r.label}")
+            self.out(f"      {r.detail}")
+            self.out(f"      condition: {r.cond_text}")
+
+    def _cmd_exec(self, ref: str) -> None:
+        if not ref.startswith("#"):
+            self.out("usage: :exec #N")
+            return
+        try:
+            n = int(ref[1:])
+        except ValueError:
+            self.out("usage: :exec #N")
+            return
+        if not 1 <= n <= len(self.state.rules):
+            self.out(f"no rule {ref} (have {len(self.state.rules)}; see :rules)")
+            return
+        rule = self.state.rules[n - 1]
+        self.out(f"{rule.label}")
+        self.out(f"condition: {rule.cond_text}")
+        if rule.condition is None:
+            self.out("result: true (unconditional)")
+            return
+        constants = rule.params.constants if rule.params is not None else {}
+        request, principal, resource = build_request_messages(self._check_input())
+        ec = EvalContext(T.EvalParams(), request, principal, resource)
+        # partial evaluation with the CURRENT R.attr as the known set: a
+        # decidable condition prints true/false; one referencing attributes
+        # the fixtures don't carry prints its residual (the oracle's
+        # error-as-false would hide the difference)
+        self._show_residual(rule, ec, constants)
+
+    def _show_residual(self, rule: LoadedRule, ec, constants) -> None:
+        from .plan import planner as pl
+        from .plan.partial import PartialEvaluator, Residual
+
+        var_defs = {}
+        if rule.params is not None:
+            var_defs = {v.name: v.expr.node for v in rule.params.ordered_variables}
+        act = ec.activation(constants, {})
+        pe = PartialEvaluator(act, dict(self.state.resource.get("attr", {})), var_defs)
+
+        def walk(cond):
+            if cond.kind == "expr":
+                try:
+                    r = pe.run(cond.expr.node)
+                except CelError:
+                    return pl.FALSE
+                if isinstance(r, Residual):
+                    return r.node
+                return pl.TRUE if r is True else pl.FALSE
+            children = [walk(c) for c in cond.children]
+            if cond.kind == "all":
+                return pl._and(children)
+            if cond.kind == "any":
+                return pl._or(children)
+            return pl._and([pl._not(c) for c in children])  # none
+
+        node = walk(rule.condition)
+        if node is pl.TRUE:
+            self.out("result: true")
+        elif node is pl.FALSE:
+            self.out("result: false")
+        else:
+            self.out(f"residual: {pl.ast_to_operand(node).debug_str()}")
+
+
+def _merged_entity(cur: dict, data: dict) -> dict:
+    out = dict(cur)
+    out.update(data)
+    return out
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except TypeError:
+        return str(v)
+
+
+def _render(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, (dict, list, str, int, float)):
+        try:
+            return json.dumps(v)
+        except TypeError:
+            return repr(v)
+    return repr(v)
+
+
+def run_repl() -> int:
+    repl = Repl()
+    print("cerbos-tpu REPL — type :help for directives, :q to quit.")
     while True:
         try:
-            line = input("> ").strip()
+            line = input("> ")
         except (EOFError, KeyboardInterrupt):
             print()
             return 0
-        if not line:
-            continue
-        if line in (":q", ":quit", ":exit"):
+        if not repl.handle(line):
             return 0
-        if line == ":vars":
-            print(json.dumps({"principal": principal, "resource": resource}, indent=2, default=str))
-            continue
-        if line.startswith(":P.attr "):
-            try:
-                principal["attr"] = json.loads(line[len(":P.attr "):])
-            except json.JSONDecodeError as e:
-                print(f"invalid JSON: {e}")
-            continue
-        if line.startswith(":R.attr "):
-            try:
-                resource["attr"] = json.loads(line[len(":R.attr "):])
-            except json.JSONDecodeError as e:
-                print(f"invalid JSON: {e}")
-            continue
-        if line.startswith(":roles "):
-            principal["roles"] = [r.strip() for r in line[len(":roles "):].split(",") if r.strip()]
-            continue
-        try:
-            result = evaluate(parse(line), build_activation())
-            print(repr(result))
-        except (CelError, CelParseError) as e:
-            print(f"error: {e}")
-    return 0

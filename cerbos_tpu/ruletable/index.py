@@ -25,6 +25,10 @@ from ..compile.compiler import CompiledOutput
 from ..policy.model import SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT
 
 
+# pattern -> is-glob memo (role/action vocabularies repeat heavily at build)
+_GLOB_KIND: dict[str, bool] = {}
+
+
 class _GlobDim:
     """Literal + glob pattern buckets (ref: index/glob_dimension.go)."""
 
@@ -37,10 +41,18 @@ class _GlobDim:
         self._multi_cache: dict[tuple[str, ...], frozenset[int]] = {}
 
     def add(self, value: str, rid: int) -> None:
-        bucket = self.globs if globs.is_glob(value) or value == "*" else self.literals
+        kind = _GLOB_KIND.get(value)
+        if kind is None:
+            kind = globs.is_glob(value) or value == "*"
+            if len(_GLOB_KIND) > 65536:
+                _GLOB_KIND.clear()
+            _GLOB_KIND[value] = kind
+        bucket = self.globs if kind else self.literals
         bucket.setdefault(value, set()).add(rid)
-        self._cache.clear()
-        self._multi_cache.clear()
+        if self._cache:
+            self._cache.clear()
+        if self._multi_cache:
+            self._multi_cache.clear()
 
     def remove(self, value: str, rid: int) -> None:
         bucket = self.globs if globs.is_glob(value) or value == "*" else self.literals
@@ -113,8 +125,12 @@ class Index:
         self._exists_cache: dict[tuple, bool] = {}
 
     def _invalidate_memos(self) -> None:
-        self._query_cache.clear()
-        self._exists_cache.clear()
+        # bulk build ingests thousands of policies before the first query:
+        # skip the clears while the memos are empty
+        if self._query_cache:
+            self._query_cache.clear()
+        if self._exists_cache:
+            self._exists_cache.clear()
 
     # -- building ---------------------------------------------------------
 

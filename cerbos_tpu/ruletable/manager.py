@@ -37,15 +37,18 @@ class RuleTableManager:
         store.subscribe(self.on_storage_event)
 
     def _build(self) -> RuleTable:
-        # a BinaryStore-style bundle can carry the compiled IR, skipping the
-        # parse+compile pipeline (the RuleTableStore fast path)
-        get_compiled = getattr(self.store, "get_compiled", None)
-        if get_compiled is not None:
-            compiled = get_compiled()
-            if compiled is not None:
-                return build_rule_table(compiled)
-        policies = self.store.get_all()
-        return build_rule_table(compile_policy_set(policies))
+        from ..util import gctune
+
+        with gctune.build_phase():
+            # a BinaryStore-style bundle can carry the compiled IR, skipping
+            # the parse+compile pipeline (the RuleTableStore fast path)
+            get_compiled = getattr(self.store, "get_compiled", None)
+            if get_compiled is not None:
+                compiled = get_compiled()
+                if compiled is not None:
+                    return build_rule_table(compiled)
+            policies = self.store.get_all()
+            return build_rule_table(compile_policy_set(policies))
 
     def on_storage_event(self, events: list[Event]) -> None:
         """Rebuild into a fresh table and swap the pointer atomically, so

@@ -467,11 +467,19 @@ def requests_unique(n: int, n_mods: int, seed: int = 7) -> list[CheckInput]:
 
     - principal id and resource owner get the SAME unique suffix, keeping
       ``R.attr.owner == P.id`` outcomes intact while making both unique;
-    - numeric attrs get an epsilon jitter far below any compared constant's
-      granularity;
+    - numeric jitter is applied ONLY where it provably cannot flip a
+      comparison: ``score`` (compared with ``<= X.5`` where equality keeps
+      its outcome under a negative shift, and ``> int`` where values sit
+      0.5 away) and ``clearance``/``sensitivity`` (compared only against
+      each other, so one SHARED negative epsilon preserves the ordering).
+      ``level`` faces ``>``/``>=``/``<`` against integer constants — no
+      shift direction is safe at equality — and ``priority`` is
+      list-membership-compared; neither is jittered;
     - ip_address is drawn uniquely inside (or outside) the compared CIDR;
     - tag lists gain a unique extra element (membership tests unaffected);
     - timestamps jitter at second granularity within the same day.
+    ``tests/test_bench_corpus.py`` pins decision parity with the unjittered
+    workload.
     """
     rng = random.Random(seed * 7919 + 13)
     out = []
@@ -487,11 +495,12 @@ def requests_unique(n: int, n_mods: int, seed: int = 7) -> list[CheckInput]:
                 pid = rattr["owner"]
         if pid == p.id:
             pid = f"{p.id}-{uid}"
-        for k in ("level", "score", "priority", "clearance", "sensitivity"):
+        eps = (rng.random() * 0.9 + 0.1) * 1e-4  # one shift per request
+        for k in ("score", "clearance", "sensitivity"):
             if k in rattr and isinstance(rattr[k], float):
-                rattr[k] = rattr[k] + rng.random() * 1e-4
+                rattr[k] = rattr[k] - eps
             if k in pattr and isinstance(pattr[k], float):
-                pattr[k] = pattr[k] + rng.random() * 1e-4
+                pattr[k] = pattr[k] - eps
         if "ip_address" in pattr:
             if pattr["ip_address"].startswith("10.20."):
                 pattr["ip_address"] = f"10.20.{rng.randrange(256)}.{rng.randrange(1, 255)}"

@@ -861,6 +861,290 @@ PyObject* PyResolveEffects(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// decode_node_pool(raw_nodes, class_map, dec_value) -> list
+//
+// Linear decode of the bundle codec's node pool (bundle_codec._Decoder
+// semantics): one forward pass, children strictly before parents, instances
+// created WITHOUT running __init__ (tp_new) and fields installed with
+// PyObject_GenericSetAttr (bypasses the frozen-dataclass __setattr__ guard —
+// these are freshly built objects we own). Scalars pass through; tagged
+// value payloads ({"$B"/"$L"/"$S"/"$M"}) go through the Python `dec_value`
+// callback. Malformed structure raises ValueError, which the Python wrapper
+// maps to CodecError.
+namespace nodepool {
+
+struct Names {
+  PyObject *value, *name, *operand, *field, *index, *fn, *args, *target;
+  PyObject *items, *entries, *init, *body, *kind, *iter_range, *iter_var;
+  PyObject *step, *iter_var2, *step2, *original, *node, *expr, *children;
+  PyObject *rule_activated, *condition_not_met, *constants, *ordered_variables;
+};
+
+Names* GetNames() {
+  static Names* names = nullptr;
+  if (!names) {
+    names = new Names{
+        PyUnicode_InternFromString("value"),
+        PyUnicode_InternFromString("name"),
+        PyUnicode_InternFromString("operand"),
+        PyUnicode_InternFromString("field"),
+        PyUnicode_InternFromString("index"),
+        PyUnicode_InternFromString("fn"),
+        PyUnicode_InternFromString("args"),
+        PyUnicode_InternFromString("target"),
+        PyUnicode_InternFromString("items"),
+        PyUnicode_InternFromString("entries"),
+        PyUnicode_InternFromString("init"),
+        PyUnicode_InternFromString("body"),
+        PyUnicode_InternFromString("kind"),
+        PyUnicode_InternFromString("iter_range"),
+        PyUnicode_InternFromString("iter_var"),
+        PyUnicode_InternFromString("step"),
+        PyUnicode_InternFromString("iter_var2"),
+        PyUnicode_InternFromString("step2"),
+        PyUnicode_InternFromString("original"),
+        PyUnicode_InternFromString("node"),
+        PyUnicode_InternFromString("expr"),
+        PyUnicode_InternFromString("children"),
+        PyUnicode_InternFromString("rule_activated"),
+        PyUnicode_InternFromString("condition_not_met"),
+        PyUnicode_InternFromString("constants"),
+        PyUnicode_InternFromString("ordered_variables"),
+    };
+  }
+  return names;
+}
+
+bool BadRef(Py_ssize_t i) {
+  PyErr_Format(PyExc_ValueError, "bad node ref in node %zd", i);
+  return false;
+}
+
+// cache[j] for child ref j (must be int < i); None passes through.
+// Returns BORROWED reference or nullptr with error set.
+PyObject* Child(PyObject* cache, Py_ssize_t i, PyObject* j) {
+  if (j == Py_None) return Py_None;
+  if (!PyLong_Check(j)) {
+    BadRef(i);
+    return nullptr;
+  }
+  Py_ssize_t idx = PyLong_AsSsize_t(j);
+  if (idx < 0 || idx >= i) {
+    BadRef(i);
+    return nullptr;
+  }
+  return PyList_GET_ITEM(cache, idx);
+}
+
+// decode a value payload: scalar passes through (new ref); dict -> callback
+PyObject* Value(PyObject* dec_value, PyObject* v) {
+  if (v == Py_None || PyBool_Check(v) || PyLong_Check(v) ||
+      PyFloat_Check(v) || PyUnicode_Check(v)) {
+    Py_INCREF(v);
+    return v;
+  }
+  return PyObject_CallFunctionObjArgs(dec_value, v, nullptr);
+}
+
+// tuple of child refs from a list payload; new reference
+PyObject* ChildTuple(PyObject* cache, Py_ssize_t i, PyObject* lst) {
+  if (!PyList_Check(lst)) {
+    BadRef(i);
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(lst);
+  PyObject* out = PyTuple_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t k = 0; k < n; k++) {
+    PyObject* c = Child(cache, i, PyList_GET_ITEM(lst, k));
+    if (!c) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_INCREF(c);
+    PyTuple_SET_ITEM(out, k, c);
+  }
+  return out;
+}
+
+PyObject* NewInstance(PyObject* cls) {
+  PyTypeObject* tp = reinterpret_cast<PyTypeObject*>(cls);
+  static PyObject* empty_args = nullptr;
+  if (!empty_args) empty_args = PyTuple_New(0);
+  return tp->tp_new(tp, empty_args, nullptr);
+}
+
+// set attr bypassing the class __setattr__ override (frozen dataclasses)
+inline int Set(PyObject* obj, PyObject* name, PyObject* value) {
+  return PyObject_GenericSetAttr(obj, name, value);
+}
+
+// steal-style helper: set then drop our reference
+inline int SetSteal(PyObject* obj, PyObject* name, PyObject* value) {
+  if (!value) return -1;
+  int rc = PyObject_GenericSetAttr(obj, name, value);
+  Py_DECREF(value);
+  return rc;
+}
+
+}  // namespace nodepool
+
+PyObject* PyDecodeNodePool(PyObject*, PyObject* args) {
+  PyObject* raw;
+  PyObject* class_map;
+  PyObject* dec_value;
+  if (!PyArg_ParseTuple(args, "O!O!O", &PyList_Type, &raw, &PyDict_Type,
+                        &class_map, &dec_value)) {
+    return nullptr;
+  }
+  using namespace nodepool;
+  Names* N = GetNames();
+  Py_ssize_t n = PyList_GET_SIZE(raw);
+  PyObject* cache = PyList_New(n);
+  if (!cache) return nullptr;
+  for (Py_ssize_t k = 0; k < n; k++) {
+    Py_INCREF(Py_None);
+    PyList_SET_ITEM(cache, k, Py_None);
+  }
+
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* e = PyList_GET_ITEM(raw, i);
+    if (!PyList_Check(e) || PyList_GET_SIZE(e) < 2) {
+      PyErr_Format(PyExc_ValueError, "malformed node %zd", i);
+      Py_DECREF(cache);
+      return nullptr;
+    }
+    PyObject* tag = PyList_GET_ITEM(e, 0);
+    if (!PyUnicode_Check(tag)) {
+      PyErr_Format(PyExc_ValueError, "malformed node tag at %zd", i);
+      Py_DECREF(cache);
+      return nullptr;
+    }
+    PyObject* cls = PyDict_GetItem(class_map, tag);  // borrowed
+    if (!cls || !PyType_Check(cls)) {
+      PyErr_Format(PyExc_ValueError, "unknown node tag at %zd", i);
+      Py_DECREF(cache);
+      return nullptr;
+    }
+    PyObject* obj = NewInstance(cls);
+    if (!obj) {
+      Py_DECREF(cache);
+      return nullptr;
+    }
+    const char* t = PyUnicode_AsUTF8(tag);
+    const Py_ssize_t sz = PyList_GET_SIZE(e);
+    bool ok = true;
+    auto item = [&](Py_ssize_t k) -> PyObject* {  // borrowed; None if short
+      return k < sz ? PyList_GET_ITEM(e, k) : Py_None;
+    };
+    auto child_at = [&](Py_ssize_t k) -> PyObject* {
+      return Child(cache, i, item(k));
+    };
+    if (std::strcmp(t, "sel") == 0 || std::strcmp(t, "has") == 0) {
+      PyObject* op = child_at(1);
+      ok = op && Set(obj, N->operand, op) == 0 &&
+           Set(obj, N->field, item(2)) == 0;
+    } else if (std::strcmp(t, "id") == 0) {
+      ok = Set(obj, N->name, item(1)) == 0;
+    } else if (std::strcmp(t, "lit") == 0) {
+      ok = SetSteal(obj, N->value, Value(dec_value, item(1))) == 0;
+    } else if (std::strcmp(t, "call") == 0) {
+      PyObject* tgt = child_at(3);
+      ok = Set(obj, N->fn, item(1)) == 0 &&
+           SetSteal(obj, N->args, ChildTuple(cache, i, item(2))) == 0 &&
+           tgt && Set(obj, N->target, tgt) == 0;
+    } else if (std::strcmp(t, "ix") == 0) {
+      PyObject* op = child_at(1);
+      PyObject* ix = child_at(2);
+      ok = op && ix && Set(obj, N->operand, op) == 0 &&
+           Set(obj, N->index, ix) == 0;
+    } else if (std::strcmp(t, "list") == 0) {
+      ok = SetSteal(obj, N->items, ChildTuple(cache, i, item(1))) == 0;
+    } else if (std::strcmp(t, "map") == 0) {
+      PyObject* lst = item(1);
+      ok = PyList_Check(lst);
+      if (ok) {
+        Py_ssize_t m = PyList_GET_SIZE(lst);
+        PyObject* entries = PyTuple_New(m);
+        ok = entries != nullptr;
+        for (Py_ssize_t k = 0; ok && k < m; k++) {
+          PyObject* pair = PyList_GET_ITEM(lst, k);
+          if (!PyList_Check(pair) || PyList_GET_SIZE(pair) != 2) {
+            ok = false;
+            break;
+          }
+          PyObject* pk = Child(cache, i, PyList_GET_ITEM(pair, 0));
+          PyObject* pv = Child(cache, i, PyList_GET_ITEM(pair, 1));
+          if (!pk || !pv) {
+            ok = false;
+            break;
+          }
+          PyObject* tup = PyTuple_Pack(2, pk, pv);
+          if (!tup) {
+            ok = false;
+            break;
+          }
+          PyTuple_SET_ITEM(entries, k, tup);
+        }
+        if (ok) {
+          ok = Set(obj, N->entries, entries) == 0;
+        }
+        Py_XDECREF(entries);
+      } else {
+        BadRef(i);
+      }
+    } else if (std::strcmp(t, "bind") == 0) {
+      PyObject* ini = child_at(2);
+      PyObject* body = child_at(3);
+      ok = ini && body && Set(obj, N->name, item(1)) == 0 &&
+           Set(obj, N->init, ini) == 0 && Set(obj, N->body, body) == 0;
+    } else if (std::strcmp(t, "comp") == 0) {
+      PyObject* rng = child_at(2);
+      PyObject* step = child_at(4);
+      PyObject* step2 = child_at(6);
+      ok = rng && step && step2 &&
+           Set(obj, N->kind, item(1)) == 0 &&
+           Set(obj, N->iter_range, rng) == 0 &&
+           Set(obj, N->iter_var, item(3)) == 0 &&
+           Set(obj, N->step, step) == 0 &&
+           Set(obj, N->iter_var2, item(5)) == 0 &&
+           Set(obj, N->step2, step2) == 0;
+    } else if (std::strcmp(t, "E") == 0) {
+      PyObject* nd = child_at(2);
+      ok = nd && Set(obj, N->original, item(1)) == 0 &&
+           Set(obj, N->node, nd) == 0;
+    } else if (std::strcmp(t, "C") == 0) {
+      PyObject* ex = child_at(2);
+      ok = ex && Set(obj, N->kind, item(1)) == 0 &&
+           Set(obj, N->expr, ex) == 0 &&
+           SetSteal(obj, N->children, ChildTuple(cache, i, item(3))) == 0;
+    } else if (std::strcmp(t, "V") == 0) {
+      PyObject* ex = child_at(2);
+      ok = ex && Set(obj, N->name, item(1)) == 0 &&
+           Set(obj, N->expr, ex) == 0;
+    } else if (std::strcmp(t, "O") == 0) {
+      PyObject* ra = child_at(1);
+      PyObject* cm = child_at(2);
+      ok = ra && cm && Set(obj, N->rule_activated, ra) == 0 &&
+           Set(obj, N->condition_not_met, cm) == 0;
+    } else if (std::strcmp(t, "P") == 0) {
+      ok = SetSteal(obj, N->constants, Value(dec_value, item(1))) == 0 &&
+           SetSteal(obj, N->ordered_variables, ChildTuple(cache, i, item(2))) == 0;
+    } else {
+      PyErr_Format(PyExc_ValueError, "unknown node tag at %zd", i);
+      ok = false;
+    }
+    if (!ok) {
+      if (!PyErr_Occurred()) BadRef(i);
+      Py_DECREF(obj);
+      Py_DECREF(cache);
+      return nullptr;
+    }
+    PyList_SetItem(cache, i, obj);  // steals obj, drops the None placeholder
+  }
+  return cache;
+}
+
 PyMethodDef kMethods[] = {
     {"glob_match", PyGlobMatch, METH_VARARGS,
      "glob_match(pattern, value) -> bool — gobwas-style glob with ':' separator"},
@@ -879,6 +1163,9 @@ PyMethodDef kMethods[] = {
     {"resolve_effects", PyResolveEffects, METH_VARARGS,
      "resolve_effects(...) — fused effect-resolution lattice over the "
      "candidate tensors (numpy-path replacement for _compute's second half)"},
+    {"decode_node_pool", PyDecodeNodePool, METH_VARARGS,
+     "decode_node_pool(raw_nodes, class_map, dec_value) -> list — linear "
+     "decode of the bundle codec node pool without running __init__"},
     {nullptr, nullptr, 0, nullptr},
 };
 
