@@ -305,7 +305,13 @@ def encode_compiled(policies: list[CompiledPolicy]) -> bytes:
         # decode, same as the JSON form)
         return msgpack.packb(doc, use_bin_type=True, default=_json_default)
     except ImportError:
-        return json.dumps(doc, separators=(",", ":"), default=_json_default).encode()
+        pass
+    except OverflowError:
+        # msgpack ints are 64-bit; YAML integer literals are arbitrary
+        # precision. The JSON container has no such limit, so fall back to
+        # it rather than failing the build (decode sniffs the container).
+        pass
+    return json.dumps(doc, separators=(",", ":"), default=_json_default).encode()
 
 
 # -- decoder ------------------------------------------------------------------

@@ -85,9 +85,14 @@ class PartialEvaluator:
         known_attrs: dict[str, Any],
         var_defs: dict[str, A.Node],
         derived_roles_list=None,
+        known_fields: frozenset = frozenset({"kind", "scope"}),
     ):
         self.act = act
         self.known_attrs = known_attrs
+        # resource head fields resolvable from the activation; the planner
+        # keeps id/policyVersion symbolic (planner.go), the REPL's :exec
+        # evaluates them concretely against the loaded fixtures
+        self.known_fields = known_fields
         self.var_defs = var_defs  # variable name -> definition AST (inlined on use)
         # (name, condition-node) pairs for runtime.effectiveDerivedRoles
         # substitution (planner.go:795-851): the select is replaced by
@@ -320,7 +325,7 @@ class PartialEvaluator:
         if not steps:
             return True  # bare R / request.resource
         head = steps[0]
-        if head in ("kind", "scope"):
+        if head in self.known_fields:
             return False
         if head == "attr" and len(steps) >= 2 and isinstance(steps[1], str) and steps[1] in self.known_attrs:
             return False

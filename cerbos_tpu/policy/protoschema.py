@@ -1,0 +1,585 @@
+"""Proto message schemas driving the strict YAML/JSON unmarshaller.
+
+Hand-built from the reference proto definitions (field names, json names,
+buf.validate constraints):
+  - api/public/cerbos/policy/v1/policy.proto (Policy, TestSuite, TestFixture)
+  - api/public/cerbos/engine/v1/engine.proto (Principal, Resource, AuxData)
+Each message is a :class:`Msg` of named :class:`F` fields; constraints mirror
+protovalidate semantics (required, const, pattern, min_len, repeated/map
+rules) and message-level CEL rules carry their custom messages verbatim.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Optional
+
+# Field kinds
+STR = "str"
+BOOL = "bool"
+INT = "int"
+UINT64_VALUE = "uint64value"
+VALUE = "value"  # google.protobuf.Value: any YAML value
+STRUCT = "struct"  # google.protobuf.Struct: mapping required
+LIST_VALUE = "listvalue"  # google.protobuf.ListValue: sequence required
+NULL_VALUE = "nullvalue"  # google.protobuf.NullValue
+EMPTY = "empty"  # google.protobuf.Empty
+TIMESTAMP = "timestamp"
+ENUM = "enum"
+MSG = "msg"
+
+
+@dataclass
+class F:
+    """One proto field: scalar kind or message ref, plus validate rules."""
+
+    kind: str
+    msg: Optional["Msg"] = None  # kind == MSG
+    repeated: bool = False
+    map_of: bool = False  # map<string, kind/msg>
+    json_name: Optional[str] = None  # overrides camelCase derivation
+    enum_values: tuple[str, ...] = ()  # kind == ENUM: name list in tag order
+    # validate rules
+    required: bool = False
+    const: Optional[str] = None
+    pattern: Optional[str] = None
+    min_len: Optional[int] = None
+    min_items: Optional[int] = None
+    min_pairs: Optional[int] = None
+    unique: bool = False
+    item_pattern: Optional[str] = None
+    item_min_len: Optional[int] = None
+    enum_in: tuple[str, ...] = ()  # allowed enum value NAMES
+    value_enum_in: tuple[str, ...] = ()  # map value enum restriction
+    key_min_len: Optional[int] = None
+    deprecated: bool = False
+
+
+@dataclass
+class Cel:
+    """Message-level CEL rule: a Python predicate + custom message."""
+
+    check: Callable[[dict], bool]  # True = ok
+    message: str
+
+
+@dataclass
+class Msg:
+    name: str
+    fields: dict[str, F] = dc_field(default_factory=dict)
+    oneofs: list[tuple[str, tuple[str, ...], bool]] = dc_field(default_factory=list)
+    cel: list[Cel] = dc_field(default_factory=list)
+
+    def __post_init__(self):
+        self._by_accepted: dict[str, tuple[str, F]] = {}
+        for fname, f in self.fields.items():
+            jname = f.json_name or _camel(fname)
+            self._by_accepted[jname] = (jname, f)
+            # protojson/protoyaml accept the original proto name too
+            self._by_accepted.setdefault(fname, (jname, f))
+
+    def lookup(self, key: str) -> Optional[tuple[str, F]]:
+        """Resolve a YAML key to (canonical json name, field spec)."""
+        return self._by_accepted.get(key)
+
+
+def _camel(snake: str) -> str:
+    parts = snake.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+SCOPE_PATTERN = r"^(^$|\.|[0-9a-zA-Z][\w\-]*(\.\w[\w\-]*)*)$"
+NAME_PATTERN = r"^[\w\-\.]+$"
+RULE_NAME_PATTERN = r"^([a-zA-Z][\w\@\.\-]*)*$"
+RESOURCE_NAME_PATTERN = r"^[^!*?\[\]{}]+$"
+VERSION_PATTERN = r"^[\w]+$"
+
+EFFECT_NAMES = ("EFFECT_UNSPECIFIED", "EFFECT_ALLOW", "EFFECT_DENY", "EFFECT_NO_MATCH")
+SCOPE_PERMISSIONS_NAMES = (
+    "SCOPE_PERMISSIONS_UNSPECIFIED",
+    "SCOPE_PERMISSIONS_OVERRIDE_PARENT",
+    "SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT_FOR_ALLOWS",
+)
+
+# -- conditions ------------------------------------------------------------
+
+MATCH = Msg("Match")
+EXPR_LIST = Msg(
+    "Match.ExprList",
+    fields={"of": F(MSG, msg=MATCH, repeated=True, required=True, min_items=1)},
+)
+MATCH.fields.update(
+    {
+        "all": F(MSG, msg=EXPR_LIST),
+        "any": F(MSG, msg=EXPR_LIST),
+        "none": F(MSG, msg=EXPR_LIST),
+        "expr": F(STR),
+    }
+)
+MATCH.oneofs.append(("op", ("all", "any", "none", "expr"), True))
+MATCH.__post_init__()
+
+CONDITION = Msg(
+    "Condition",
+    fields={"match": F(MSG, msg=MATCH), "script": F(STR)},
+    oneofs=[("condition", ("match", "script"), True)],
+)
+
+OUTPUT_WHEN = Msg(
+    "Output.When",
+    fields={"rule_activated": F(STR), "condition_not_met": F(STR)},
+)
+OUTPUT = Msg(
+    "Output",
+    fields={"expr": F(STR, deprecated=True), "when": F(MSG, msg=OUTPUT_WHEN)},
+)
+
+# -- schemas ---------------------------------------------------------------
+
+SCHEMAS_IGNORE_WHEN = Msg(
+    "Schemas.IgnoreWhen",
+    fields={
+        "actions": F(STR, repeated=True, required=True, min_items=1, unique=True, item_min_len=1)
+    },
+)
+SCHEMAS_SCHEMA = Msg(
+    "Schemas.Schema",
+    fields={
+        "ref": F(STR, required=True, min_len=1),
+        "ignore_when": F(MSG, msg=SCHEMAS_IGNORE_WHEN),
+    },
+)
+SCHEMAS = Msg(
+    "Schemas",
+    fields={
+        "principal_schema": F(MSG, msg=SCHEMAS_SCHEMA),
+        "resource_schema": F(MSG, msg=SCHEMAS_SCHEMA),
+    },
+)
+
+# -- variables / constants -------------------------------------------------
+
+VARIABLES = Msg(
+    "Variables",
+    fields={
+        "import": F(STR, repeated=True, unique=True, item_pattern=NAME_PATTERN),
+        "local": F(STR, map_of=True),
+    },
+)
+CONSTANTS = Msg(
+    "Constants",
+    fields={
+        "import": F(STR, repeated=True, unique=True, item_pattern=NAME_PATTERN),
+        "local": F(VALUE, map_of=True),
+    },
+)
+
+# -- resource policy -------------------------------------------------------
+
+RESOURCE_RULE = Msg(
+    "ResourceRule",
+    fields={
+        "actions": F(STR, repeated=True, required=True, min_items=1, unique=True, item_min_len=1),
+        "derived_roles": F(STR, repeated=True, unique=True, item_pattern=NAME_PATTERN),
+        "roles": F(STR, repeated=True, unique=True, item_min_len=1),
+        "condition": F(MSG, msg=CONDITION),
+        "effect": F(ENUM, enum_values=EFFECT_NAMES, required=True, enum_in=("EFFECT_ALLOW", "EFFECT_DENY")),
+        "name": F(STR, pattern=RULE_NAME_PATTERN),
+        "output": F(MSG, msg=OUTPUT),
+    },
+)
+
+RESOURCE_POLICY = Msg(
+    "ResourcePolicy",
+    fields={
+        "resource": F(STR, required=True, pattern=RESOURCE_NAME_PATTERN),
+        "version": F(STR, required=True, pattern=VERSION_PATTERN),
+        "import_derived_roles": F(STR, repeated=True, unique=True, item_pattern=NAME_PATTERN),
+        "rules": F(MSG, msg=RESOURCE_RULE, repeated=True),
+        "scope": F(STR, pattern=SCOPE_PATTERN),
+        "schemas": F(MSG, msg=SCHEMAS),
+        "variables": F(MSG, msg=VARIABLES),
+        "scope_permissions": F(ENUM, enum_values=SCOPE_PERMISSIONS_NAMES),
+        "constants": F(MSG, msg=CONSTANTS),
+    },
+)
+
+# -- role policy -----------------------------------------------------------
+
+ROLE_RULE = Msg(
+    "RoleRule",
+    fields={
+        "resource": F(STR, required=True, min_len=1),
+        "allow_actions": F(STR, repeated=True, required=True, min_items=1, unique=True, item_min_len=1),
+        "condition": F(MSG, msg=CONDITION),
+        "name": F(STR, pattern=RULE_NAME_PATTERN),
+        "output": F(MSG, msg=OUTPUT),
+    },
+)
+
+ROLE_POLICY = Msg(
+    "RolePolicy",
+    fields={
+        "role": F(STR, pattern=RESOURCE_NAME_PATTERN),
+        "version": F(STR, pattern=r"^[\w]*$"),
+        "parent_roles": F(STR, repeated=True, unique=True, item_min_len=1),
+        "scope": F(STR, pattern=SCOPE_PATTERN),
+        "rules": F(MSG, msg=ROLE_RULE, repeated=True),
+        "scope_permissions": F(
+            ENUM,
+            enum_values=SCOPE_PERMISSIONS_NAMES,
+            enum_in=("SCOPE_PERMISSIONS_UNSPECIFIED", "SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT_FOR_ALLOWS"),
+            deprecated=True,
+        ),
+        "variables": F(MSG, msg=VARIABLES),
+        "constants": F(MSG, msg=CONSTANTS),
+    },
+    oneofs=[("policy_type", ("role",), True)],
+)
+
+# -- principal policy ------------------------------------------------------
+
+PRINCIPAL_RULE_ACTION = Msg(
+    "PrincipalRule.Action",
+    fields={
+        "action": F(STR, required=True, min_len=1),
+        "condition": F(MSG, msg=CONDITION),
+        "effect": F(ENUM, enum_values=EFFECT_NAMES, required=True, enum_in=("EFFECT_ALLOW", "EFFECT_DENY")),
+        "name": F(STR, pattern=RULE_NAME_PATTERN),
+        "output": F(MSG, msg=OUTPUT),
+    },
+)
+
+PRINCIPAL_RULE = Msg(
+    "PrincipalRule",
+    fields={
+        "resource": F(STR, required=True, min_len=1),
+        "actions": F(MSG, msg=PRINCIPAL_RULE_ACTION, repeated=True, required=True, min_items=1),
+    },
+)
+
+PRINCIPAL_POLICY = Msg(
+    "PrincipalPolicy",
+    fields={
+        "principal": F(STR, required=True, pattern=RESOURCE_NAME_PATTERN),
+        "version": F(STR, required=True, pattern=VERSION_PATTERN),
+        "rules": F(MSG, msg=PRINCIPAL_RULE, repeated=True),
+        "scope": F(STR, pattern=SCOPE_PATTERN),
+        "variables": F(MSG, msg=VARIABLES),
+        "scope_permissions": F(ENUM, enum_values=SCOPE_PERMISSIONS_NAMES),
+        "constants": F(MSG, msg=CONSTANTS),
+    },
+)
+
+# -- derived roles / exports ----------------------------------------------
+
+ROLE_DEF = Msg(
+    "RoleDef",
+    fields={
+        "name": F(STR, required=True, pattern=NAME_PATTERN),
+        "parent_roles": F(STR, repeated=True, required=True, min_items=1, unique=True, item_min_len=1),
+        "condition": F(MSG, msg=CONDITION),
+    },
+)
+
+DERIVED_ROLES = Msg(
+    "DerivedRoles",
+    fields={
+        "name": F(STR, required=True, pattern=NAME_PATTERN, min_len=1),
+        "definitions": F(MSG, msg=ROLE_DEF, repeated=True, required=True, min_items=1),
+        "variables": F(MSG, msg=VARIABLES),
+        "constants": F(MSG, msg=CONSTANTS),
+    },
+)
+
+EXPORT_VARIABLES = Msg(
+    "ExportVariables",
+    fields={
+        "name": F(STR, required=True, pattern=NAME_PATTERN, min_len=1),
+        "definitions": F(STR, map_of=True),
+    },
+)
+
+EXPORT_CONSTANTS = Msg(
+    "ExportConstants",
+    fields={
+        "name": F(STR, required=True, pattern=NAME_PATTERN, min_len=1),
+        "definitions": F(VALUE, map_of=True),
+    },
+)
+
+# -- metadata --------------------------------------------------------------
+
+SOURCE_ATTRIBUTES = Msg(
+    "SourceAttributes",
+    fields={"attributes": F(VALUE, map_of=True)},
+)
+
+METADATA = Msg(
+    "Metadata",
+    fields={
+        "source_file": F(STR),
+        "annotations": F(STR, map_of=True),
+        "hash": F(UINT64_VALUE),
+        "store_identifer": F(STR, deprecated=True),
+        "store_identifier": F(STR),
+        "source_attributes": F(MSG, msg=SOURCE_ATTRIBUTES),
+    },
+)
+
+POLICY = Msg(
+    "Policy",
+    fields={
+        "api_version": F(STR, required=True, const="api.cerbos.dev/v1"),
+        "disabled": F(BOOL),
+        "description": F(STR),
+        "metadata": F(MSG, msg=METADATA),
+        "resource_policy": F(MSG, msg=RESOURCE_POLICY),
+        "principal_policy": F(MSG, msg=PRINCIPAL_POLICY),
+        "derived_roles": F(MSG, msg=DERIVED_ROLES),
+        "export_variables": F(MSG, msg=EXPORT_VARIABLES),
+        "role_policy": F(MSG, msg=ROLE_POLICY),
+        "export_constants": F(MSG, msg=EXPORT_CONSTANTS),
+        "variables": F(STR, map_of=True, deprecated=True),
+        "json_schema": F(STR, json_name="$schema"),
+    },
+    oneofs=[
+        (
+            "policy_type",
+            (
+                "resource_policy",
+                "principal_policy",
+                "derived_roles",
+                "export_variables",
+                "role_policy",
+                "export_constants",
+            ),
+            True,
+        )
+    ],
+)
+
+# -- engine fixtures (verify test suites) ----------------------------------
+
+ENGINE_PRINCIPAL = Msg(
+    "engine.Principal",
+    fields={
+        "id": F(STR, required=True, min_len=1),
+        "policy_version": F(STR, pattern=r"^[\w]*$"),
+        "roles": F(STR, repeated=True, required=True, min_items=1, unique=True, item_pattern=r"^[\w\-\.@!$\+]+(:[\w\-\.@!$\+]+)*$"),
+        "attr": F(VALUE, map_of=True),
+        "scope": F(STR, pattern=SCOPE_PATTERN),
+    },
+)
+
+ENGINE_RESOURCE = Msg(
+    "engine.Resource",
+    fields={
+        "kind": F(STR, required=True, min_len=1),
+        "policy_version": F(STR, pattern=r"^[\w]*$"),
+        "id": F(STR, required=True, min_len=1),
+        "attr": F(VALUE, map_of=True),
+        "scope": F(STR, pattern=SCOPE_PATTERN),
+    },
+)
+
+AUX_DATA_JWT = Msg(
+    "AuxData.JWT",
+    fields={"token": F(STR), "key_set_id": F(STR)},
+)
+
+# In test fixtures, auxData.jwt is a free-form claims object (the reference's
+# TestFixture uses engine.AuxData whose jwt field in fixtures carries claims
+# as a Value map via the test harness); model it as map<string, Value>.
+ENGINE_AUX_DATA = Msg(
+    "engine.AuxData",
+    fields={"jwt": F(VALUE, map_of=True)},
+)
+
+TEST_FIXTURE_GROUP_PRINCIPALS = Msg(
+    "TestFixtureGroup.Principals",
+    fields={"principals": F(STR, repeated=True, required=True, min_items=1, unique=True, item_min_len=1)},
+)
+TEST_FIXTURE_GROUP_RESOURCES = Msg(
+    "TestFixtureGroup.Resources",
+    fields={"resources": F(STR, repeated=True, required=True, min_items=1, unique=True, item_min_len=1)},
+)
+
+TEST_FIXTURE_PRINCIPALS = Msg(
+    "TestFixture.Principals",
+    fields={
+        "principals": F(MSG, msg=ENGINE_PRINCIPAL, map_of=True),
+        "json_schema": F(STR, json_name="$schema"),
+        "principal_groups": F(MSG, msg=TEST_FIXTURE_GROUP_PRINCIPALS, map_of=True),
+    },
+)
+TEST_FIXTURE_RESOURCES = Msg(
+    "TestFixture.Resources",
+    fields={
+        "resources": F(MSG, msg=ENGINE_RESOURCE, map_of=True),
+        "json_schema": F(STR, json_name="$schema"),
+        "resource_groups": F(MSG, msg=TEST_FIXTURE_GROUP_RESOURCES, map_of=True),
+    },
+)
+TEST_FIXTURE_AUX_DATA = Msg(
+    "TestFixture.AuxData",
+    fields={
+        "aux_data": F(MSG, msg=ENGINE_AUX_DATA, map_of=True),
+        "json_schema": F(STR, json_name="$schema"),
+    },
+)
+
+TEST_OPTIONS = Msg(
+    "TestOptions",
+    fields={
+        "now": F(TIMESTAMP),
+        "lenient_scope_search": F(BOOL),
+        "globals": F(VALUE, map_of=True),
+        "default_policy_version": F(STR),
+        "default_scope": F(STR),
+    },
+)
+
+OUTPUT_ENTRY = Msg(
+    "OutputEntry",
+    fields={"src": F(STR), "val": F(VALUE), "action": F(STR), "error": F(STR)},
+)
+
+TEST_TABLE_INPUT = Msg(
+    "TestTable.Input",
+    fields={
+        "principals": F(STR, repeated=True, unique=True, item_min_len=1),
+        "resources": F(STR, repeated=True, unique=True, item_min_len=1),
+        "actions": F(STR, repeated=True, required=True, min_items=1, unique=True, item_min_len=1),
+        "aux_data": F(STR),
+        "principal_groups": F(STR, repeated=True, unique=True, item_min_len=1),
+        "resource_groups": F(STR, repeated=True, unique=True, item_min_len=1),
+    },
+    cel=[
+        Cel(
+            lambda m: bool(m.get("principals")) or bool(m.get("principalGroups")),
+            "principals or principalGroups must be present",
+        ),
+        Cel(
+            lambda m: bool(m.get("resources")) or bool(m.get("resourceGroups")),
+            "resources or resourceGroups must be present",
+        ),
+    ],
+)
+
+TEST_TABLE_OUTPUT_EXPECTATIONS = Msg(
+    "TestTable.OutputExpectations",
+    fields={
+        "action": F(STR, required=True, min_len=1),
+        "expected": F(MSG, msg=OUTPUT_ENTRY, repeated=True, required=True, min_items=1),
+    },
+)
+
+TEST_TABLE_EXPECTATION = Msg(
+    "TestTable.Expectation",
+    fields={
+        "principal": F(STR),
+        "resource": F(STR),
+        "actions": F(
+            ENUM,
+            map_of=True,
+            enum_values=EFFECT_NAMES,
+            required=True,
+            min_pairs=1,
+            key_min_len=1,
+            value_enum_in=("EFFECT_ALLOW", "EFFECT_DENY"),
+        ),
+        "outputs": F(MSG, msg=TEST_TABLE_OUTPUT_EXPECTATIONS, repeated=True),
+        "principals": F(STR, repeated=True, unique=True, item_min_len=1),
+        "resources": F(STR, repeated=True, unique=True, item_min_len=1),
+        "principal_groups": F(STR, repeated=True, unique=True, item_min_len=1),
+        "resource_groups": F(STR, repeated=True, unique=True, item_min_len=1),
+    },
+    cel=[
+        Cel(
+            lambda m: bool(m.get("principal")) or bool(m.get("principals")) or bool(m.get("principalGroups")),
+            "principal, principals, or principalGroups must be present",
+        ),
+        Cel(
+            lambda m: not (bool(m.get("principal")) and bool(m.get("principals"))),
+            "principal and principals may not both be present",
+        ),
+        Cel(
+            lambda m: bool(m.get("resource")) or bool(m.get("resources")) or bool(m.get("resourceGroups")),
+            "resource, resources, or resourceGroups must be present",
+        ),
+        Cel(
+            lambda m: not (bool(m.get("resource")) and bool(m.get("resources"))),
+            "resource and resources may not both be present",
+        ),
+    ],
+)
+
+TEST_TABLE = Msg(
+    "TestTable",
+    fields={
+        "name": F(STR, required=True, min_len=1),
+        "description": F(STR),
+        "skip": F(BOOL),
+        "skip_reason": F(STR),
+        "input": F(MSG, msg=TEST_TABLE_INPUT, required=True),
+        "expected": F(MSG, msg=TEST_TABLE_EXPECTATION, repeated=True, required=True, min_items=1),
+        "options": F(MSG, msg=TEST_OPTIONS),
+    },
+)
+
+TEST_SUITE = Msg(
+    "TestSuite",
+    fields={
+        "name": F(STR, required=True, min_len=1),
+        "description": F(STR),
+        "skip": F(BOOL),
+        "skip_reason": F(STR),
+        "tests": F(MSG, msg=TEST_TABLE, repeated=True, required=True, min_items=1),
+        "principals": F(MSG, msg=ENGINE_PRINCIPAL, map_of=True),
+        "resources": F(MSG, msg=ENGINE_RESOURCE, map_of=True),
+        "aux_data": F(MSG, msg=ENGINE_AUX_DATA, map_of=True),
+        "options": F(MSG, msg=TEST_OPTIONS),
+        "json_schema": F(STR, json_name="$schema"),
+        "principal_groups": F(MSG, msg=TEST_FIXTURE_GROUP_PRINCIPALS, map_of=True),
+        "resource_groups": F(MSG, msg=TEST_FIXTURE_GROUP_RESOURCES, map_of=True),
+    },
+)
+
+
+# -- well-known-type coverage (parser_wkt corpus) --------------------------
+
+WELL_KNOWN_TYPES = Msg(
+    "WellKnownTypes",
+    fields={
+        "list_value": F(LIST_VALUE),
+        "repeated_list_value": F(LIST_VALUE, repeated=True),
+        "list_value_map": F(LIST_VALUE, map_of=True),
+        "null_value": F(NULL_VALUE),
+        "repeated_null_value": F(NULL_VALUE, repeated=True),
+        "null_value_map": F(NULL_VALUE, map_of=True),
+        "struct": F(STRUCT),
+        "repeated_struct": F(STRUCT, repeated=True),
+        "struct_map": F(STRUCT, map_of=True),
+        "value_null": F(VALUE),
+        "value_number": F(VALUE),
+        "value_string": F(VALUE),
+        "value_bool": F(VALUE),
+        "value_struct": F(VALUE),
+        "value_list": F(VALUE),
+        "repeated_value": F(VALUE, repeated=True),
+        "value_map": F(VALUE, map_of=True),
+        "uint64_wrapper_number": F(UINT64_VALUE),
+        "uint64_wrapper_string": F(UINT64_VALUE),
+        "repeated_uint64_wrapper": F(UINT64_VALUE, repeated=True),
+        "uint64_wrapper_map": F(UINT64_VALUE, map_of=True),
+        "empty": F(EMPTY),
+        "repeated_empty": F(EMPTY, repeated=True),
+        "empty_map": F(EMPTY, map_of=True),
+        "timestamp": F(TIMESTAMP),
+        "repeated_timestamp": F(TIMESTAMP, repeated=True),
+        "timestamp_map": F(TIMESTAMP, map_of=True),
+    },
+)
+WELL_KNOWN_TYPES.fields["nested"] = F(MSG, msg=WELL_KNOWN_TYPES)
+WELL_KNOWN_TYPES.__post_init__()

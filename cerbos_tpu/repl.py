@@ -193,6 +193,9 @@ class Repl:
             except json.JSONDecodeError as e:
                 self.out(f"special variable {name} takes JSON: {e}")
                 return
+            if not isinstance(data, dict):
+                self.out(f"special variable {name} takes a JSON object, got {type(data).__name__}")
+                return
             if name == "request":
                 s.principal = _merged_entity(s.principal, data.get("principal", {}))
                 s.resource = _merged_entity(s.resource, data.get("resource", {}))
@@ -339,7 +342,12 @@ class Repl:
         if rule.params is not None:
             var_defs = {v.name: v.expr.node for v in rule.params.ordered_variables}
         act = ec.activation(constants, {})
-        pe = PartialEvaluator(act, dict(self.state.resource.get("attr", {})), var_defs)
+        pe = PartialEvaluator(
+            act,
+            dict(self.state.resource.get("attr", {})),
+            var_defs,
+            known_fields=frozenset({"kind", "scope", "id", "policyVersion"}),
+        )
 
         def walk(cond):
             if cond.kind == "expr":

@@ -10,6 +10,7 @@ mask tensors instead.
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable, Optional
 
 from .. import globs, namer
@@ -26,7 +27,9 @@ from ..policy.model import SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT
 
 
 # pattern -> is-glob memo (role/action vocabularies repeat heavily at build)
-_GLOB_KIND: dict[str, bool] = {}
+@functools.lru_cache(maxsize=65536)
+def _is_glob_value(value: str) -> bool:
+    return globs.is_glob(value) or value == "*"
 
 
 class _GlobDim:
@@ -41,13 +44,7 @@ class _GlobDim:
         self._multi_cache: dict[tuple[str, ...], frozenset[int]] = {}
 
     def add(self, value: str, rid: int) -> None:
-        kind = _GLOB_KIND.get(value)
-        if kind is None:
-            kind = globs.is_glob(value) or value == "*"
-            if len(_GLOB_KIND) > 65536:
-                _GLOB_KIND.clear()
-            _GLOB_KIND[value] = kind
-        bucket = self.globs if kind else self.literals
+        bucket = self.globs if _is_glob_value(value) else self.literals
         bucket.setdefault(value, set()).add(rid)
         if self._cache:
             self._cache.clear()
@@ -55,7 +52,7 @@ class _GlobDim:
             self._multi_cache.clear()
 
     def remove(self, value: str, rid: int) -> None:
-        bucket = self.globs if globs.is_glob(value) or value == "*" else self.literals
+        bucket = self.globs if _is_glob_value(value) else self.literals
         ids = bucket.get(value)
         if ids is not None:
             ids.discard(rid)

@@ -1,0 +1,112 @@
+"""Reference parser_wkt corpus: well-known-type unmarshalling.
+
+Mirrors internal/parser/wkt_test.go TestUnmarshalWKT: ListValue, NullValue,
+Struct, Value, UInt64Value, Empty and Timestamp fields in plain, repeated
+and map positions, plus a nested message, parsed identically from YAML and
+JSON; type mismatches report goccy-style errors with positions.
+
+Representation notes vs the Go test (which compares proto objects):
+  - singular NullValue / null-valued Value fields are unset in our dict form
+    (protojson also omits them), so they are absent from WANT;
+  - UInt64Value renders as a decimal string (protojson convention);
+  - Timestamps normalize to canonical protojson form (UTC, Z suffix,
+    0/3/6/9 fractional digits).
+"""
+
+import os
+
+import pytest
+
+from cerbos_tpu.policy import protoschema as S
+from cerbos_tpu.policy.protoyaml import unmarshal
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden", "parser_wkt")
+
+_LIST = [None, None, None, 1, "two", True, False,
+         {"three": "four", "five": 6},
+         ["seven", 8, {"nine": 10}]]
+_STRUCT = {
+    "one": None, "two": 3, "four": "five", "six": True, "seven": False,
+    "eight": {"nine": 10, "eleven": "twelve"},
+    "thirteen": [14, "fifteen"],
+}
+
+WANT = {
+    "listValue": _LIST,
+    "repeatedListValue": [[None, 1, "two"], [True, False],
+                          [{"three": "four", "five": 6}, ["seven", 8, {"nine": 10}]]],
+    "listValueMap": {"foo": [None, 1, "two"], "bar": [True, False],
+                     "baz": [{"three": "four", "five": 6}, ["seven", 8, {"nine": 10}]]},
+    "repeatedNullValue": [None, None, None],
+    "nullValueMap": {"foo": None, "bar": None, "baz": None},
+    "struct": _STRUCT,
+    "repeatedStruct": [
+        {"one": None, "two": 3, "four": "five"},
+        {"six": True, "seven": False},
+        {"eight": {"nine": 10, "eleven": "twelve"}},
+        {"thirteen": [14, "fifteen"]},
+    ],
+    "structMap": {
+        "foo": {"one": None, "two": 3, "four": "five"},
+        "bar": {"six": True, "seven": False},
+        "baz": {"eight": {"nine": 10, "eleven": "twelve"}},
+        "qux": {"thirteen": [14, "fifteen"]},
+    },
+    "valueNumber": 1,
+    "valueString": "two",
+    "valueBool": True,
+    "valueStruct": {"three": 4, "five": "six"},
+    "valueList": [7, "eight"],
+    "repeatedValue": [None, 1, "two", True, False,
+                      {"three": "four", "five": 6},
+                      ["seven", 8, {"nine": 10}]],
+    "valueMap": {"foo": None, "bar": 1, "baz": "two", "qux": True, "quux": False,
+                 "quuux": {"three": "four", "five": 6},
+                 "quuuux": ["seven", 8, {"nine": 10}]},
+    "uint64WrapperNumber": "1",
+    "uint64WrapperString": "2",
+    "repeatedUint64Wrapper": ["1", "2"],
+    "uint64WrapperMap": {"foo": "1", "bar": "2"},
+    "empty": {},
+    "repeatedEmpty": [{}, {}],
+    "emptyMap": {"foo": {}, "bar": {}},
+    "timestamp": "2026-06-15T10:31:01.121Z",
+    "repeatedTimestamp": ["2026-06-15T10:31:01Z", "2026-06-15T10:31:01.121161Z"],
+    "timestampMap": {"foo": "2026-06-15T10:31:01Z", "bar": "2026-06-15T10:31:01.121161239Z"},
+}
+WANT["nested"] = {k: v for k, v in WANT.items()}
+
+
+def _norm(v):
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in sorted(v.items())}
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    return v
+
+
+@pytest.mark.parametrize("name", ["valid.yaml", "valid.json"])
+def test_wkt_valid(name):
+    with open(os.path.join(CORPUS, name), "rb") as f:
+        res = unmarshal(f.read(), S.WELL_KNOWN_TYPES)
+    assert not res.errors, [e.render() for e in res.errors]
+    assert len(res.docs) == 1
+    assert _norm(res.docs[0].message) == _norm(WANT)
+
+
+@pytest.mark.parametrize(
+    "name,line,column",
+    [("invalid.yaml", 2, 9), ("invalid.json", 2, 13)],
+)
+def test_wkt_invalid(name, line, column):
+    with open(os.path.join(CORPUS, name), "rb") as f:
+        res = unmarshal(f.read(), S.WELL_KNOWN_TYPES)
+    assert len(res.errors) == 1
+    e = res.errors[0]
+    assert e.kind == "KIND_PARSE_ERROR"
+    assert e.message == "expected map got String"
+    assert (e.line, e.column, e.path) == (line, column, "$.struct")
