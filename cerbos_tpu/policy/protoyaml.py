@@ -144,9 +144,6 @@ def _is_null(node) -> bool:
     )
 
 
-_QUOTE_LINT_RE = re.compile(r"""^(?P<prefix>\s*(?:-\s+)?(?:[^:\n]+:\s+|-\s+)?)(?P<quote>["']).*$""")
-
-
 def _scan_quote_lint(text: str) -> list[SrcError]:
     """The reference's quoted-string lint (parser.go:294-316): a quoted
     scalar with trailing non-comment content on the same line means the
@@ -268,6 +265,12 @@ class _Walker:
                 SrcError(KIND_PARSE, f"expected mapping value got {_node_kind(node)}", line, col, path or "$")
             )
         out: dict[str, Any] = {}
+        oneof_seen: dict[str, str] = {}  # oneof name -> first member set
+        member_oneof = {
+            schema.fields[m].json_name or S._camel(m): oname
+            for oname, members, _req in schema.oneofs
+            for m in members
+        }
         for key_node, value_node in self.pairs(node):
             if not isinstance(key_node, yaml.ScalarNode):
                 line, col = _mark(key_node)
@@ -279,6 +282,19 @@ class _Walker:
                 line, col = _mark(key_node)
                 raise _DocAbort(SrcError(KIND_PARSE, f'unknown field "{key}"', line, col, kpath))
             jname, fspec = hit
+            oname = member_oneof.get(jname)
+            if oname is not None and not _is_null(value_node):
+                first = oneof_seen.get(oname)
+                if first is not None and first != jname:
+                    line, col = _mark(key_node)
+                    raise _DocAbort(
+                        SrcError(
+                            KIND_PARSE,
+                            f'oneof "{oname}" is already set by field "{first}"',
+                            line, col, kpath,
+                        )
+                    )
+                oneof_seen[oname] = jname
             jpath = f"{path}.{jname}" if path else f"$.{jname}"
             self.pos[jpath] = _mark(key_node)
             try:
@@ -310,7 +326,8 @@ class _Walker:
         out = []
         for i, item in enumerate(node.value):
             ipath = f"{path}[{i}]"
-            self.pos[ipath] = _mark(item)
+            # goccy anchors mapping items at their first key's colon
+            self.pos[ipath] = _type_error_pos(item)
             out.append(self.walk_single(item, f, ipath))
         return out
 
@@ -323,8 +340,10 @@ class _Walker:
         out = {}
         for key_node, value_node in self.pairs(node):
             key = str(key_node.value) if isinstance(key_node, yaml.ScalarNode) else ""
-            kpath = f'{path}["{key}"]'
-            self.pos[kpath] = _mark(key_node)
+            # protoyaml-go camelizes every path segment, map keys included,
+            # and anchors the entry at its VALUE node (verify corpus 014/026)
+            kpath = f'{path}["{S._camel(key)}"]'
+            self.pos[kpath] = _type_error_pos(value_node)
             out[key] = self.walk_single(value_node, f, kpath)
         return out
 
@@ -377,7 +396,33 @@ class _Walker:
         if f.kind == S.STR:
             return node.value
         if f.kind == S.TIMESTAMP:
-            return _normalize_timestamp(node.value)
+            line, col = _mark(node)
+            if _TS_RE.match(node.value.strip()) is None:
+                raise _DocAbort(
+                    SrcError(
+                        KIND_PARSE,
+                        f'invalid timestamp value "{node.value}": {_go_time_parse_error(node.value)}',
+                        line,
+                        col,
+                        path,
+                    )
+                )
+            try:
+                return _normalize_timestamp(node.value)
+            except ValueError as e:
+                # in-pattern but out-of-range components (month 13, hour 25):
+                # Go reports e.g. `...: month out of range`
+                component = str(e).split(" must be", 1)[0].split()[-1]
+                raise _DocAbort(
+                    SrcError(
+                        KIND_PARSE,
+                        f'invalid timestamp value "{node.value}": parsing time '
+                        f'"{node.value}" as "{_RFC3339_LAYOUT}": {component} out of range',
+                        line,
+                        col,
+                        path,
+                    )
+                ) from None
         if f.kind == S.BOOL:
             v = self.loader.construct_object(node)
             if not isinstance(v, bool):
@@ -400,6 +445,39 @@ class _Walker:
                 raise _DocAbort(SrcError(KIND_PARSE, f'unknown value "{v}" for enum', line, col, path))
             return v
         raise AssertionError(f"unhandled field kind {f.kind}")
+
+
+_RFC3339_LAYOUT = "2006-01-02T15:04:05.999999999Z07:00"
+
+
+def _go_time_parse_error(v: str) -> str:
+    """Reproduce Go time.Parse's error text for RFC3339 failures: the first
+    layout element that cannot consume the remaining input is reported as
+    `cannot parse "<rest>" as "<element>"`."""
+    elements = [
+        ("2006", 4), ("-", 1), ("01", 2), ("-", 1), ("02", 2),
+        ("T", 1), ("15", 2), (":", 1), ("04", 2), (":", 1), ("05", 2),
+    ]
+    rest = v
+    for elem, width in elements:
+        if elem in ("-", ":", "T"):
+            ok = rest.startswith(elem)
+        else:
+            ok = len(rest) >= width and rest[:width].isdigit()
+        if not ok:
+            return (
+                f'parsing time "{v}" as "{_RFC3339_LAYOUT}": '
+                f'cannot parse "{rest}" as "{elem}"'
+            )
+        rest = rest[width:]
+    if rest.startswith("."):
+        frac = re.match(r"\.\d+", rest)
+        if frac:
+            rest = rest[frac.end():]
+    return (
+        f'parsing time "{v}" as "{_RFC3339_LAYOUT}": '
+        f'cannot parse "{rest}" as "Z07:00"'
+    )
 
 
 _TS_RE = re.compile(
@@ -483,7 +561,7 @@ def _validate_scalar(errors, pos_map, f: S.F, value, path: str, present: bool) -
             else:
                 _violation(errors, pos_map, path, "value is required")
             return
-        if present and f.enum_in and value not in f.enum_in and value != f.enum_values[0]:
+        if present and f.enum_in and value not in f.enum_in:
             _violation(errors, pos_map, path, "must be one of [%s]" % ", ".join(f.enum_in))
 
 
@@ -506,7 +584,7 @@ def _validate_msg(errors, pos_map, msg: dict, schema: S.Msg, path: str) -> None:
             if not present:
                 continue
             for key, item in value.items():
-                ipath = f'{fpath}["{key}"]'
+                ipath = f'{fpath}["{S._camel(key)}"]'
                 if f.kind == S.MSG:
                     _validate_msg(errors, pos_map, item, f.msg, ipath)
                 else:

@@ -1,1 +1,3 @@
-from .runner import SuiteResults, discover_and_run, run_suite  # noqa: F401
+from .junit import build as build_junit  # noqa: F401
+from .results import Config, FilterConfig, TestFixture, VerifyError, verify  # noqa: F401
+from .runner import SuiteResults, discover_and_run  # noqa: F401

@@ -1,0 +1,905 @@
+"""Policy-test execution producing the reference's TestResults structure.
+
+Behavioral reference: internal/verify/{verify,run_test_suite,test_matrix,
+test_suite_results,test_filter,test_fixture}.go. Test suites
+(``*_test.{yaml,yml,json}``) and their ``testdata`` fixtures load through the
+strict protoyaml parser (identical error text, incl. positions); the matrix
+expands principals × resources with group support and merged expectations;
+results accumulate into the protojson TestResults shape (suites → testCases
+→ principals → resources → actions → details) with per-suite and overall
+summaries/tallies — byte-compatible with the reference's verify corpus.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .. import globs as globs_mod
+from .. import namer
+from ..cel.values import Timestamp
+from ..engine import types as T
+from ..policy import protoschema as S
+from ..policy.protoyaml import unmarshal
+
+# TestResults.Result enum (policy.proto:585-591)
+R_UNSPECIFIED, R_SKIPPED, R_PASSED, R_FAILED, R_ERRORED = range(5)
+RESULT_NAMES = (
+    "RESULT_UNSPECIFIED",
+    "RESULT_SKIPPED",
+    "RESULT_PASSED",
+    "RESULT_FAILED",
+    "RESULT_ERRORED",
+)
+
+# test_filter.go skip reasons
+SKIP_REASON_NAME = "Test name did not match the provided pattern"
+SKIP_REASON_RESOURCE = "Resource matched a policy that was excluded from the bundle"
+SKIP_REASON_PRINCIPAL = "Principal matched a policy that was excluded from the bundle"
+SKIP_REASON_FILTER_SUITE = "Suite did not match the test filter"
+SKIP_REASON_FILTER_TEST = "Test did not match the test filter"
+SKIP_REASON_FILTER_PRINCIPAL = "Principal did not match the test filter"
+SKIP_REASON_FILTER_RESOURCE = "Resource did not match the test filter"
+SKIP_REASON_FILTER_ACTION = "No actions matched the test filter"
+
+_FILTER_SKIP_REASONS = {
+    SKIP_REASON_FILTER_SUITE,
+    SKIP_REASON_FILTER_TEST,
+    SKIP_REASON_FILTER_PRINCIPAL,
+    SKIP_REASON_FILTER_RESOURCE,
+    SKIP_REASON_FILTER_ACTION,
+}
+
+ERR_USED_DEFAULT_NOW = (
+    "a policy used a time-based condition, but `now` was not provided in the test options"
+)
+
+TESTDATA_DIR = "testdata"
+_SUITE_SUFFIXES = ("_test.yaml", "_test.yml", "_test.json")
+_FIXTURE_EXTS = (".yaml", ".yml", ".json")
+
+
+class VerifyError(Exception):
+    """Fatal fixture/suite problem surfaced as a suite-level error."""
+
+
+@dataclass
+class FilterConfig:
+    suite: list[str] = field(default_factory=list)
+    test: list[str] = field(default_factory=list)
+    principal: list[str] = field(default_factory=list)
+    resource: list[str] = field(default_factory=list)
+    action: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Config:
+    excluded_resource_policy_fqns: set[str] = field(default_factory=set)
+    excluded_principal_policy_fqns: set[str] = field(default_factory=set)
+    included_test_names_regexp: str = ""
+    filter: Optional[FilterConfig] = None
+    trace: bool = False
+    skip_batching: bool = False
+
+
+# -- fixtures (test_fixture.go) --------------------------------------------
+
+
+@dataclass
+class TestFixture:
+    __test__ = False  # not a pytest class
+
+    principals: dict[str, dict] = field(default_factory=dict)
+    principal_groups: dict[str, list[str]] = field(default_factory=dict)
+    resources: dict[str, dict] = field(default_factory=dict)
+    resource_groups: dict[str, list[str]] = field(default_factory=dict)
+    aux_data: dict[str, dict] = field(default_factory=dict)
+
+
+def _find_fixture_file(dirpath: str, stem: str) -> Optional[str]:
+    for ext in _FIXTURE_EXTS:
+        p = os.path.join(dirpath, stem + ext)
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def _load_one(path: str, schema: S.Msg) -> dict:
+    with open(path, "rb") as f:
+        res = unmarshal(f.read(), schema)
+    if res.errors:
+        raise VerifyError(res.render_errors())
+    return res.docs[0].message if res.docs else {}
+
+
+def _check_group_definitions(groups: dict, member_key: str, exists: Callable[[str], bool]) -> dict[str, list[str]]:
+    resolved: dict[str, list[str]] = {}
+    for group_name, group_def in (groups or {}).items():
+        members = list(group_def.get(member_key, []))
+        for fixture_name in members:
+            if not exists(fixture_name):
+                raise VerifyError(
+                    f'missing fixture "{fixture_name}" referenced in group "{group_name}"'
+                )
+        resolved[group_name] = members
+    return resolved
+
+
+def load_test_fixture(dirpath: str) -> TestFixture:
+    tf = TestFixture()
+    p_file = _find_fixture_file(dirpath, "principals")
+    if p_file:
+        try:
+            doc = _load_one(p_file, S.TEST_FIXTURE_PRINCIPALS)
+        except VerifyError as e:
+            raise VerifyError(f"failed to load principals:\n{e}") from None
+        tf.principals = doc.get("principals", {})
+        try:
+            tf.principal_groups = _check_group_definitions(
+                doc.get("principalGroups"), "principals", lambda n: n in tf.principals
+            )
+        except VerifyError as e:
+            raise VerifyError(f"failed to load principals: {e}") from None
+    r_file = _find_fixture_file(dirpath, "resources")
+    if r_file:
+        try:
+            doc = _load_one(r_file, S.TEST_FIXTURE_RESOURCES)
+        except VerifyError as e:
+            raise VerifyError(f"failed to load resources:\n{e}") from None
+        tf.resources = doc.get("resources", {})
+        try:
+            tf.resource_groups = _check_group_definitions(
+                doc.get("resourceGroups"), "resources", lambda n: n in tf.resources
+            )
+        except VerifyError as e:
+            raise VerifyError(f"failed to load resources: {e}") from None
+    for stem in ("auxdata", "auxData", "aux_data"):
+        a_file = _find_fixture_file(dirpath, stem)
+        if a_file:
+            try:
+                doc = _load_one(a_file, S.TEST_FIXTURE_AUX_DATA)
+            except VerifyError as e:
+                raise VerifyError(f"failed to load aux data:\n{e}") from None
+            tf.aux_data = doc.get("auxData", {})
+            break
+    return tf
+
+
+# -- summary / tallies (test_suite_results.go) -----------------------------
+
+
+def _new_summary() -> dict:
+    return {"overallResult": R_UNSPECIFIED, "testsCount": 0, "resultCounts": []}
+
+
+def _increment_tally(summary: dict, result: int, delta: int) -> None:
+    for tally in summary["resultCounts"]:
+        if tally["result"] == result:
+            tally["count"] += delta
+            return
+    summary["resultCounts"].append({"result": result, "count": delta})
+    summary["resultCounts"].sort(key=lambda t: t["result"])
+
+
+def _add_result(suite: dict, name: dict, action: str, details: dict) -> None:
+    tc = _find_or_append(suite.setdefault("testCases", []), name["testTableName"])
+    principal = _find_or_append(tc.setdefault("principals", []), name["principalKey"])
+    resource = _find_or_append(principal.setdefault("resources", []), name["resourceKey"])
+    act = None
+    for a in resource.setdefault("actions", []):
+        if a["name"] == action:
+            act = a
+            break
+    if act is None:
+        act = {"name": action, "details": {}}
+        resource["actions"].append(act)
+    act["details"] = details
+
+    if details.get("skipReason") not in _FILTER_SKIP_REASONS:
+        suite["summary"]["testsCount"] += 1
+        _increment_tally(suite["summary"], details["result"], 1)
+    if details["result"] > suite["summary"]["overallResult"]:
+        suite["summary"]["overallResult"] = details["result"]
+
+
+def _find_or_append(items: list[dict], name: str) -> dict:
+    for it in items:
+        if it["name"] == name:
+            return it
+    it = {"name": name}
+    items.append(it)
+    return it
+
+
+# -- matrix (test_matrix.go) -----------------------------------------------
+
+
+@dataclass
+class _Expectations:
+    actions: dict[str, str] = field(default_factory=dict)  # action -> effect name
+    outputs: dict[str, dict[str, Any]] = field(default_factory=dict)  # action -> src -> val
+
+
+@dataclass
+class _Test:
+    name: dict
+    skip: bool
+    skip_reason: str
+    principal: dict
+    resource: dict
+    actions: list[str]
+    aux_data: Optional[dict]
+    expected: dict[str, str]
+    expected_outputs: dict[str, dict[str, Any]]
+    options: dict
+
+
+class _SuiteRun:
+    def __init__(self, suite: dict, fixture: TestFixture):
+        self.suite = suite
+        self.fixture = fixture
+        self.principal_groups: dict[str, list[str]] = {}
+        self.resource_groups: dict[str, list[str]] = {}
+
+    def _has_principal(self, name: str) -> bool:
+        return name in (self.suite.get("principals") or {}) or name in self.fixture.principals
+
+    def _has_resource(self, name: str) -> bool:
+        return name in (self.suite.get("resources") or {}) or name in self.fixture.resources
+
+    def lookup_principal(self, name: str) -> dict:
+        p = (self.suite.get("principals") or {}).get(name) or self.fixture.principals.get(name)
+        if p is None:
+            raise VerifyError(f'principal "{name}" not found')
+        return p
+
+    def lookup_resource(self, name: str) -> dict:
+        r = (self.suite.get("resources") or {}).get(name) or self.fixture.resources.get(name)
+        if r is None:
+            raise VerifyError(f'resource "{name}" not found')
+        return r
+
+    def lookup_principal_group(self, name: str) -> list[str]:
+        g = self.principal_groups.get(name)
+        if g is None:
+            g = self.fixture.principal_groups.get(name)
+        if g is None:
+            raise VerifyError(f'principal group "{name}" not found')
+        return g
+
+    def lookup_resource_group(self, name: str) -> list[str]:
+        g = self.resource_groups.get(name)
+        if g is None:
+            g = self.fixture.resource_groups.get(name)
+        if g is None:
+            # mirrors the reference's copy-pasted message (run_test_suite.go:249)
+            raise VerifyError(f'principal group "{name}" not found')
+        return g
+
+    def lookup_aux_data(self, name: str) -> Optional[dict]:
+        if not name:
+            return None
+        a = (self.suite.get("auxData") or {}).get(name)
+        if a is None:
+            a = self.fixture.aux_data.get(name)
+        if a is None:
+            raise VerifyError(f'auxData "{name}" not found')
+        return a
+
+    def check_unique_test_names(self) -> None:
+        seen: set[str] = set()
+        dupes: list[str] = []
+        for t in self.suite.get("tests", []):
+            name = t.get("name", "")
+            if name in seen:
+                dupes.append(f"another test named {name} already exists")
+            seen.add(name)
+        if dupes:
+            raise VerifyError("; ".join(dupes))
+
+    def collect_fixtures(self, fixture: str, fixtures: list[str], groups: list[str], lookup) -> list[str]:
+        if fixture:
+            fixtures = [fixture]
+        else:
+            fixtures = list(fixtures)
+        seen = set(fixtures)
+        for group in groups:
+            for name in lookup(group):
+                if name not in seen:
+                    fixtures.append(name)
+                    seen.add(name)
+        return fixtures
+
+    def build_test_matrix(self, table: dict) -> list[tuple[str, str, _Expectations]]:
+        lookup = self.build_expectation_lookup(table)
+        default = _Expectations(
+            actions={a: "EFFECT_DENY" for a in table.get("input", {}).get("actions", [])}
+        )
+        tin = table.get("input", {})
+        principals = self.collect_fixtures(
+            "", tin.get("principals", []), tin.get("principalGroups", []), self.lookup_principal_group
+        )
+        resources = self.collect_fixtures(
+            "", tin.get("resources", []), tin.get("resourceGroups", []), self.lookup_resource_group
+        )
+        matrix = []
+        for principal in principals:
+            for resource in resources:
+                key = (principal, resource)
+                exp = lookup.pop(key, default)
+                matrix.append((principal, resource, exp))
+        for principal, resource in lookup:
+            raise VerifyError(
+                f'found an expectation for principal "{principal}" and resource "{resource}", '
+                "but at least one of these is not present in input"
+            )
+        return matrix
+
+    def build_expectation_lookup(self, table: dict) -> dict[tuple[str, str], _Expectations]:
+        input_actions = set(table.get("input", {}).get("actions", []))
+        lookup: dict[tuple[str, str], _Expectations] = {}
+        for expectation in table.get("expected", []):
+            outputs: dict[str, dict[str, Any]] = {}
+            for oe in expectation.get("outputs", []):
+                entries = {e.get("src", ""): e.get("val") for e in oe.get("expected", [])}
+                outputs[oe.get("action", "")] = entries
+
+            unreachable = [a for a in outputs if a not in input_actions]
+            if unreachable:
+                raise VerifyError(
+                    "found output expectations for actions that are not in the input actions "
+                    f"list: [{','.join(unreachable)}]"
+                )
+
+            principals = self.collect_fixtures(
+                expectation.get("principal", ""),
+                expectation.get("principals", []),
+                expectation.get("principalGroups", []),
+                self.lookup_principal_group,
+            )
+            resources = self.collect_fixtures(
+                expectation.get("resource", ""),
+                expectation.get("resources", []),
+                expectation.get("resourceGroups", []),
+                self.lookup_resource_group,
+            )
+
+            actions = expectation.get("actions", {})
+            for principal in principals:
+                for resource in resources:
+                    extra = sorted(a for a in actions if a not in input_actions)
+                    if extra:
+                        raise VerifyError(
+                            "found expectations for actions that do not exist in the input "
+                            f"actions list: [{','.join(extra)}]"
+                        )
+                    key = (principal, resource)
+                    lookup[key] = self._merge_expectations(key, lookup.get(key), actions, outputs)
+        return lookup
+
+    def _merge_expectations(self, key, target: Optional[_Expectations], actions, outputs) -> _Expectations:
+        if target is None:
+            target = _Expectations()
+        for action, new_effect in actions.items():
+            old = target.actions.get(action)
+            if old is not None and old != new_effect:
+                raise VerifyError(
+                    f'found inconsistent expectations for principal "{key[0]}" performing '
+                    f'action "{action}" on resource "{key[1]}"'
+                )
+            target.actions[action] = new_effect
+        for action, entries in outputs.items():
+            tgt = target.outputs.setdefault(action, {})
+            for src, new_val in entries.items():
+                if src in tgt and not _values_equal(tgt[src], new_val):
+                    raise VerifyError(
+                        f'found inconsistent expectations for output "{src}" from principal '
+                        f'"{key[0]}" performing action "{action}" on resource "{key[1]}"'
+                    )
+                tgt[src] = new_val
+        return target
+
+    def get_tests(self) -> list[_Test]:
+        all_tests: list[_Test] = []
+        for table in self.suite.get("tests", []):
+            try:
+                matrix = self.build_test_matrix(table)
+                for principal_key, resource_key, exp in matrix:
+                    all_tests.append(self._build_test(table, principal_key, resource_key, exp))
+            except VerifyError as e:
+                raise VerifyError(f'invalid test "{table.get("name", "")}": {e}') from None
+        return all_tests
+
+    def _build_test(self, table: dict, principal_key: str, resource_key: str, exp: _Expectations) -> _Test:
+        principal = self.lookup_principal(principal_key)
+        resource = self.lookup_resource(resource_key)
+        aux_data = self.lookup_aux_data(table.get("input", {}).get("auxData", ""))
+        # the table's options REPLACE the suite's when present, even if every
+        # field in them is default-valued (run_test_suite.go:189-192)
+        options = table["options"] if "options" in table else (self.suite.get("options") or {})
+        return _Test(
+            name={
+                "testTableName": table.get("name", ""),
+                "principalKey": principal_key,
+                "resourceKey": resource_key,
+            },
+            skip=bool(table.get("skip")),
+            skip_reason=table.get("skipReason", ""),
+            principal=principal,
+            resource=resource,
+            actions=list(table.get("input", {}).get("actions", [])),
+            aux_data=aux_data,
+            expected=exp.actions,
+            expected_outputs=exp.outputs,
+            options=options,
+        )
+
+
+def _values_equal(a: Any, b: Any) -> bool:
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return float(a) == float(b)
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(_values_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(_values_equal(a[k], b[k]) for k in a)
+    return a == b
+
+
+# -- filter (test_filter.go) -----------------------------------------------
+
+
+class _TestFilter:
+    def __init__(self, conf: Config):
+        self.conf = conf
+        self.name_rx = None
+        if conf.included_test_names_regexp:
+            try:
+                self.name_rx = re.compile(conf.included_test_names_regexp)
+            except re.error as e:
+                raise VerifyError(f"invalid run specification: {e}") from None
+
+    def apply(self, test: _Test, suite: dict) -> Optional[dict]:
+        def skip(reason: str) -> dict:
+            return {"result": R_SKIPPED, "skipReason": reason}
+
+        if self.name_rx is not None:
+            n = test.name
+            # suite name + "/" + prototext rendering of Test.TestName
+            rendered = (
+                f'{suite.get("name", "")}/test_table_name:"{n["testTableName"]}"'
+                f'  principal_key:"{n["principalKey"]}"  resource_key:"{n["resourceKey"]}"'
+            )
+            if not self.name_rx.search(rendered):
+                return skip(SKIP_REASON_NAME)
+
+        if self.conf.excluded_resource_policy_fqns:
+            fqn = namer.resource_policy_fqn(
+                test.resource.get("kind", ""),
+                _policy_version(test.resource, test.options),
+                _scope(test.resource, test.options),
+            )
+            if fqn in self.conf.excluded_resource_policy_fqns:
+                return skip(SKIP_REASON_RESOURCE)
+
+        if self.conf.excluded_principal_policy_fqns:
+            fqn = namer.principal_policy_fqn(
+                test.principal.get("id", ""),
+                _policy_version(test.principal, test.options),
+                _scope(test.principal, test.options),
+            )
+            if fqn in self.conf.excluded_principal_policy_fqns:
+                return skip(SKIP_REASON_PRINCIPAL)
+
+        f = self.conf.filter
+        if f is not None:
+            if f.suite and not _matches_any_glob(f.suite, suite.get("name", "")):
+                return skip(SKIP_REASON_FILTER_SUITE)
+            if f.test and not _matches_any_glob(f.test, test.name["testTableName"]):
+                return skip(SKIP_REASON_FILTER_TEST)
+            if f.principal and not _matches_any_glob(f.principal, test.name["principalKey"]):
+                return skip(SKIP_REASON_FILTER_PRINCIPAL)
+            if f.resource and not _matches_any_glob(f.resource, test.name["resourceKey"]):
+                return skip(SKIP_REASON_FILTER_RESOURCE)
+            matched, _ = self.partition_actions(test.actions)
+            if not matched:
+                return skip(SKIP_REASON_FILTER_ACTION)
+
+        if test.skip:
+            return skip(test.skip_reason)
+        return None
+
+    def partition_actions(self, actions: list[str]) -> tuple[list[str], list[str]]:
+        f = self.conf.filter
+        if f is None or not f.action:
+            return list(actions), []
+        matched, skipped = [], []
+        for action in actions:
+            (matched if _matches_any_glob(f.action, action) else skipped).append(action)
+        return matched, skipped
+
+
+def _matches_any_glob(patterns: list[str], value: str) -> bool:
+    return any(globs_mod.matches_glob(g, value) for g in patterns)
+
+
+def _policy_version(fixture: dict, options: dict) -> str:
+    return fixture.get("policyVersion") or options.get("defaultPolicyVersion") or "default"
+
+
+def _scope(fixture: dict, options: dict) -> str:
+    return fixture.get("scope") or options.get("defaultScope") or ""
+
+
+# -- test execution (run_test_suite.go runTest/performCheck) ---------------
+
+
+def _principal_from(d: dict) -> T.Principal:
+    return T.Principal(
+        id=d.get("id", ""),
+        roles=list(d.get("roles", [])),
+        attr=d.get("attr", {}) or {},
+        policy_version=str(d.get("policyVersion", "")),
+        scope=d.get("scope", ""),
+    )
+
+
+def _resource_from(d: dict) -> T.Resource:
+    return T.Resource(
+        kind=d.get("kind", ""),
+        id=d.get("id", ""),
+        attr=d.get("attr", {}) or {},
+        policy_version=str(d.get("policyVersion", "")),
+        scope=d.get("scope", ""),
+    )
+
+
+def _params_for(options: dict) -> tuple[T.EvalParams, list]:
+    """EvalParams from TestOptions; the returned flag list records whether
+    the default (unset) now was consulted (errUsedDefaultNow)."""
+    used_default_now: list[bool] = []
+    params = T.EvalParams(
+        globals=options.get("globals", {}) or {},
+        default_policy_version=options.get("defaultPolicyVersion") or "default",
+        default_scope=options.get("defaultScope", ""),
+        lenient_scope_search=bool(options.get("lenientScopeSearch", False)),
+    )
+    now = options.get("now")
+    if now:
+        fixed = Timestamp.parse(str(now))
+        params.now_fn = lambda: fixed
+    else:
+        def flagging_now():
+            used_default_now.append(True)
+            return Timestamp.from_datetime(__import__('datetime').datetime(1970, 1, 1))
+
+        params.now_fn = flagging_now
+    return params, used_default_now
+
+
+def _run_test(engine, test: _Test, actions: list[str], trace: bool) -> dict[str, dict]:
+    results: dict[str, dict] = {}
+    params, used_default_now = _params_for(test.options)
+    aux = None
+    if test.aux_data is not None:
+        aux = T.AuxData(jwt=dict(test.aux_data.get("jwt", {}) or {}))
+    inp = T.CheckInput(
+        principal=_principal_from(test.principal),
+        resource=_resource_from(test.resource),
+        actions=actions,
+        aux_data=aux,
+    )
+    err: Optional[str] = None
+    actual: list[T.CheckOutput] = []
+    try:
+        actual = engine.check([inp], params=params)
+    except Exception as e:  # engine-level failure -> per-action error
+        err = str(e)
+    if err is None and used_default_now:
+        err = ERR_USED_DEFAULT_NOW
+
+    if err is not None:
+        for action in actions:
+            results[action] = {"result": R_ERRORED, "error": err}
+        return results
+    if not actual:
+        for action in actions:
+            results[action] = {"result": R_ERRORED, "error": "Empty response from server"}
+        return results
+
+    out = actual[0]
+    for action in actions:
+        outputs = [o for o in out.outputs if o.action == action]
+        actual_outputs = {o.src: o for o in outputs}
+        details: dict = {}
+        expected_effect = test.expected.get(action, "EFFECT_DENY")
+        ae = out.actions.get(action)
+        if ae is None:
+            details["result"] = R_ERRORED
+            details["error"] = f'no result for action "{action}"'
+            results[action] = details
+            continue
+        if expected_effect != ae.effect:
+            details["result"] = R_FAILED
+            details["failure"] = {"expected": expected_effect, "actual": ae.effect}
+            results[action] = details
+            continue
+        failures = []
+        for want_key, want_value in (test.expected_outputs.get(action) or {}).items():
+            got = actual_outputs.get(want_key)
+            if got is None:
+                failures.append(
+                    {"src": want_key, "missing": {"expected": want_value}}
+                )
+                continue
+            if got.error:
+                failures.append(
+                    {"src": want_key, "errored": {"expected": want_value, "error": got.error}}
+                )
+                continue
+            if not _values_equal(want_value, got.val):
+                failures.append(
+                    {"src": want_key, "mismatched": {"actual": got.val, "expected": want_value}}
+                )
+        if failures:
+            details["result"] = R_FAILED
+            details["failure"] = {
+                "expected": expected_effect,
+                "actual": ae.effect,
+                "outputs": failures,
+            }
+            results[action] = details
+            continue
+        details["result"] = R_PASSED
+        success: dict = {"effect": ae.effect}
+        if outputs:
+            success["outputs"] = [_output_entry_dict(o) for o in outputs]
+        details["success"] = success
+        results[action] = details
+    return results
+
+
+def _output_entry_dict(o: T.OutputEntry) -> dict:
+    d: dict = {}
+    if o.src:
+        d["src"] = o.src
+    if o.val is not None:
+        d["val"] = o.val
+    if o.action:
+        d["action"] = o.action
+    if o.error:
+        d["error"] = o.error
+    return d
+
+
+# -- suite runner (run_test_suite.go) --------------------------------------
+
+
+def run_test_suite(engine, test_filter: _TestFilter, file: str, suite: dict, fixture: TestFixture, trace: bool, skip_batching: bool) -> dict:
+    summary = _new_summary()
+    results: dict = {"file": file, "name": suite.get("name", ""), "summary": summary}
+    if suite.get("description"):
+        results["description"] = suite["description"]
+
+    run = _SuiteRun(suite, fixture)
+    try:
+        run.principal_groups = _check_group_definitions(
+            suite.get("principalGroups"), "principals", run._has_principal
+        )
+    except VerifyError as e:
+        summary["overallResult"] = R_ERRORED
+        results["error"] = f"Invalid principal groups in test suite: {e}"
+        return results
+    try:
+        run.resource_groups = _check_group_definitions(
+            suite.get("resourceGroups"), "resources", run._has_resource
+        )
+    except VerifyError as e:
+        summary["overallResult"] = R_ERRORED
+        results["error"] = f"Invalid resource groups in test suite: {e}"
+        return results
+
+    if suite.get("skip"):
+        summary["overallResult"] = R_SKIPPED
+        if suite.get("skipReason"):
+            results["skipReason"] = suite["skipReason"]
+        return results
+
+    try:
+        run.check_unique_test_names()
+    except VerifyError as e:
+        summary["overallResult"] = R_ERRORED
+        results["error"] = f"Invalid test suite: {e}"
+        return results
+
+    try:
+        tests = run.get_tests()
+    except VerifyError as e:
+        summary["overallResult"] = R_ERRORED
+        results["error"] = f"Failed to load the test suite: {e}"
+        return results
+
+    for test in tests:
+        skipped = test_filter.apply(test, suite)
+        if skipped is not None:
+            for action in test.actions:
+                _add_result(results, test.name, action, dict(skipped))
+            continue
+
+        actions, skipped_actions = test_filter.partition_actions(test.actions)
+
+        if not skip_batching:
+            action_results = _run_test(engine, test, actions, trace)
+            for action in actions:
+                _add_result(results, test.name, action, action_results[action])
+        else:
+            for action in actions:
+                action_results = _run_test(engine, test, [action], trace)
+                _add_result(results, test.name, action, action_results[action])
+
+        for action in skipped_actions:
+            _add_result(
+                results, test.name, action,
+                {"result": R_SKIPPED, "skipReason": SKIP_REASON_FILTER_ACTION},
+            )
+
+    return results
+
+
+# -- top level (verify.go) -------------------------------------------------
+
+
+def discover_test_files(root: str) -> tuple[list[str], set[str]]:
+    suites: list[str] = []
+    fixture_dirs: set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+        if os.path.basename(dirpath) == TESTDATA_DIR:
+            fixture_dirs.add(os.path.relpath(dirpath, root))
+            dirnames[:] = []
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(_SUITE_SUFFIXES) and not fn.startswith("."):
+                suites.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return suites, fixture_dirs
+
+
+def verify(root: str, engine, conf: Optional[Config] = None) -> dict:
+    """Run every test suite under ``root``, returning the TestResults dict."""
+    conf = conf or Config()
+    suite_files, fixture_dirs = discover_test_files(root)
+    test_filter = _TestFilter(conf)  # raises VerifyError on a bad regexp
+
+    fixtures: dict[str, Optional[TestFixture]] = {}
+
+    def get_fixture(path: str) -> Optional[TestFixture]:
+        if path in fixtures:
+            return fixtures[path]
+        if path not in fixture_dirs:
+            fixtures[path] = None
+            return None
+        tf = load_test_fixture(os.path.join(root, path))
+        fixtures[path] = tf
+        return tf
+
+    results: dict = {"suites": [], "summary": _new_summary()}
+
+    for file in suite_files:
+        with open(os.path.join(root, file), "rb") as f:
+            res = unmarshal(f.read(), S.TEST_SUITE)
+        if res.errors or not res.docs:
+            suite_result = {
+                "file": file,
+                "name": "Unknown",
+                "summary": {**_new_summary(), "overallResult": R_ERRORED},
+                "error": f"failed to load test suite:\n{res.render_errors()}",
+            }
+        else:
+            suite = res.docs[0].message
+            fixture_dir = os.path.join(os.path.dirname(file), TESTDATA_DIR)
+            fixture_dir = os.path.normpath(fixture_dir)
+            try:
+                fixture = get_fixture(fixture_dir) or TestFixture()
+            except VerifyError as e:
+                suite_result = {
+                    "file": file,
+                    "name": suite.get("name", ""),
+                    "summary": {**_new_summary(), "overallResult": R_ERRORED},
+                    "error": f"failed to load test fixtures from {fixture_dir}: {e}",
+                }
+                if suite.get("description"):
+                    suite_result["description"] = suite["description"]
+                _append_suite(results, suite_result)
+                continue
+            suite_result = run_test_suite(
+                engine, test_filter, file, suite, fixture, conf.trace, conf.skip_batching
+            )
+        _append_suite(results, suite_result)
+
+    results["suites"].sort(key=lambda s: s["file"])
+    return _render_results(results)
+
+
+def _append_suite(results: dict, suite: dict) -> None:
+    results["suites"].append(suite)
+    results["summary"]["testsCount"] += suite["summary"]["testsCount"]
+    for tally in suite["summary"]["resultCounts"]:
+        _increment_tally(results["summary"], tally["result"], tally["count"])
+    if suite["summary"]["overallResult"] > results["summary"]["overallResult"]:
+        results["summary"]["overallResult"] = suite["summary"]["overallResult"]
+
+
+# -- protojson rendering ---------------------------------------------------
+
+
+def _render_results(results: dict) -> dict:
+    """Internal dict → protojson conventions (enum names, defaults omitted)."""
+
+    def render_summary(s: dict) -> dict:
+        out: dict = {}
+        if s["overallResult"]:
+            out["overallResult"] = RESULT_NAMES[s["overallResult"]]
+        if s["testsCount"]:
+            out["testsCount"] = s["testsCount"]
+        if s["resultCounts"]:
+            out["resultCounts"] = [
+                {
+                    **({"result": RESULT_NAMES[t["result"]]} if t["result"] else {}),
+                    **({"count": t["count"]} if t["count"] else {}),
+                }
+                for t in s["resultCounts"]
+            ]
+        return out
+
+    def render_details(d: dict) -> dict:
+        out: dict = {}
+        if d.get("result"):
+            out["result"] = RESULT_NAMES[d["result"]]
+        for oneof in ("failure", "error", "success"):
+            if oneof in d:
+                out[oneof] = d[oneof]
+        if "skipReason" in d:
+            out["skipReason"] = d["skipReason"]
+        return out
+
+    def render_suite(s: dict) -> dict:
+        out: dict = {"file": s["file"], "name": s["name"]}
+        if s.get("description"):
+            out["description"] = s["description"]
+        out["summary"] = render_summary(s["summary"])
+        if s.get("error"):
+            out["error"] = s["error"]
+        if s.get("skipReason"):
+            out["skipReason"] = s["skipReason"]
+        if s.get("testCases"):
+            out["testCases"] = [
+                {
+                    "name": tc["name"],
+                    "principals": [
+                        {
+                            "name": p["name"],
+                            "resources": [
+                                {
+                                    "name": r["name"],
+                                    "actions": [
+                                        {"name": a["name"], "details": render_details(a["details"])}
+                                        for a in r.get("actions", [])
+                                    ],
+                                }
+                                for r in p.get("resources", [])
+                            ],
+                        }
+                        for p in tc.get("principals", [])
+                    ],
+                }
+                for tc in s["testCases"]
+            ]
+        return out
+
+    return {
+        "suites": [render_suite(s) for s in results["suites"]],
+        "summary": render_summary(results["summary"]),
+    }

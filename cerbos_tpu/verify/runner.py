@@ -1,289 +1,95 @@
 """Policy test framework: YAML test suites against the real engine.
 
-Behavioral reference: internal/verify — ``*_test.yaml`` suites with
-``testdata/{principals,resources,auxdata}.yaml`` fixtures, matrix expansion
-over principals × resources (test_matrix.go), fixed ``now`` and eval options,
-expectations default to DENY for unlisted (principal, resource) pairs.
-Exposed through ``cerbos-tpu compile`` (exit code 4 on failure).
+Behavioral reference: internal/verify — the execution engine lives in
+:mod:`cerbos_tpu.verify.results` (reference-faithful TestResults structure,
+gated on the verify corpus) and :mod:`cerbos_tpu.verify.junit` (byte-exact
+JUnit XML). This module is the CLI-facing adapter: discovery rooted at a
+policy dir, human-readable summary, JSON and JUnit renderings, and the
+exit-code contract (``cerbos compile`` exits 4 on test failure).
 """
 
 from __future__ import annotations
 
-import datetime as _dt
 import os
-import re
-from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Optional
 
-import yaml
-
-from ..cel.values import Timestamp
-from ..compile import compile_policy_set
-from ..engine import AuxData, CheckInput, EvalParams, Principal, Resource
-from ..engine.engine import Engine
-from ..storage.disk import DiskStore
+from .junit import build as build_junit
+from .results import Config, verify
 
 
-@dataclass
-class TestResult:
-    suite: str
-    name: str
-    principal: str
-    resource: str
-    passed: bool
-    skipped: bool = False
-    failures: list[str] = field(default_factory=list)
-    # rendered engine trace for failed tests under --verbose
-    # (ref: internal/engine/tracer/sink.go surfaced in verify results)
-    traces: list[dict] = field(default_factory=list)
-
-
-@dataclass
 class SuiteResults:
-    results: list[TestResult] = field(default_factory=list)
+    """TestResults dict + presentation helpers (summary/junit/json)."""
+
+    def __init__(self, results: dict):
+        self.results = results
 
     @property
     def failed(self) -> bool:
-        return any(not r.passed and not r.skipped for r in self.results)
+        overall = self.results.get("summary", {}).get("overallResult", "")
+        return overall in ("RESULT_FAILED", "RESULT_ERRORED")
+
+    def to_json(self) -> dict:
+        return self.results
+
+    def to_junit(self, verbose: bool = False) -> str:
+        return build_junit(self.results, verbose=verbose)
 
     def summary(self) -> str:
-        lines = []
-        by_suite: dict[str, list[TestResult]] = {}
-        for r in self.results:
-            by_suite.setdefault(r.suite, []).append(r)
-        for suite, rs in by_suite.items():
-            n_pass = sum(1 for r in rs if r.passed)
-            n_skip = sum(1 for r in rs if r.skipped)
-            lines.append(f"{suite}: {n_pass}/{len(rs)} passed, {n_skip} skipped")
-            for r in rs:
-                if not r.passed and not r.skipped:
-                    lines.append(f"  FAIL {r.name} [{r.principal} / {r.resource}]")
-                    for f in r.failures:
-                        lines.append(f"    {f}")
-                    for t in r.traces:
-                        comps = " > ".join(c.get("id", "") for c in t.get("components", []))
-                        ev = t.get("event", {})
-                        detail = ev.get("effect") or ev.get("status") or ""
-                        msg = ev.get("message", "")
-                        lines.append(f"      trace: {comps}: {detail} {msg}".rstrip())
+        lines: list[str] = []
+        for suite in self.results.get("suites", []):
+            s = suite.get("summary", {})
+            counts = {t.get("result", ""): t.get("count", 0) for t in s.get("resultCounts", [])}
+            n_pass = counts.get("RESULT_PASSED", 0)
+            n_skip = counts.get("RESULT_SKIPPED", 0)
+            total = s.get("testsCount", 0)
+            name = suite.get("name", suite.get("file", ""))
+            if suite.get("error"):
+                lines.append(f"{name}: ERROR {suite['error']}")
+                continue
+            if s.get("overallResult") == "RESULT_SKIPPED":
+                lines.append(f"{name}: skipped ({suite.get('skipReason', '')})".rstrip())
+                continue
+            lines.append(f"{name}: {n_pass}/{total} passed, {n_skip} skipped")
+            for tc in suite.get("testCases", []):
+                for p in tc.get("principals", []):
+                    for r in p.get("resources", []):
+                        for a in r.get("actions", []):
+                            d = a.get("details", {})
+                            if d.get("result") in ("RESULT_FAILED", "RESULT_ERRORED"):
+                                lines.append(
+                                    f"  FAIL {tc['name']} [{p['name']} / {r['name']}] {a['name']}"
+                                )
+                                f = d.get("failure")
+                                if f:
+                                    lines.append(
+                                        f"    expected {f.get('expected')}, got {f.get('actual')}"
+                                    )
+                                    for o in f.get("outputs", []):
+                                        lines.append(f"    output {o.get('src', '')!r} unsatisfied")
+                                if d.get("error"):
+                                    lines.append(f"    {d['error']}")
         status = "FAILED" if self.failed else "OK"
         lines.append(status)
         return "\n".join(lines)
-
-    def to_json(self) -> dict:
-        return {
-            "failed": self.failed,
-            "results": [
-                {
-                    "suite": r.suite,
-                    "name": r.name,
-                    "principal": r.principal,
-                    "resource": r.resource,
-                    "passed": r.passed,
-                    "skipped": r.skipped,
-                    "failures": r.failures,
-                    "traces": r.traces,
-                }
-                for r in self.results
-            ],
-        }
-
-    def to_junit(self) -> str:
-        """JUnit XML (ref: internal/verify/junit)."""
-        import xml.etree.ElementTree as ET
-
-        root = ET.Element("testsuites")
-        by_suite: dict[str, list[TestResult]] = {}
-        for r in self.results:
-            by_suite.setdefault(r.suite, []).append(r)
-        for suite, rs in by_suite.items():
-            ts = ET.SubElement(root, "testsuite", name=suite, tests=str(len(rs)),
-                               failures=str(sum(1 for r in rs if not r.passed and not r.skipped)),
-                               skipped=str(sum(1 for r in rs if r.skipped)))
-            for r in rs:
-                tc = ET.SubElement(ts, "testcase", name=f"{r.name} [{r.principal}/{r.resource}]")
-                if r.skipped:
-                    ET.SubElement(tc, "skipped")
-                elif not r.passed:
-                    f = ET.SubElement(tc, "failure")
-                    f.text = "\n".join(r.failures)
-        return ET.tostring(root, encoding="unicode")
-
-
-def _load_fixtures(testdata_dir: str) -> dict[str, dict]:
-    out = {"principals": {}, "resources": {}, "auxData": {},
-           "principalGroups": {}, "resourceGroups": {}}
-    if not os.path.isdir(testdata_dir):
-        return out
-    for name in ("principals", "resources", "auxdata", "auxData"):
-        for ext in (".yaml", ".yml", ".json"):
-            path = os.path.join(testdata_dir, name.lower() + ext)
-            if os.path.isfile(path):
-                with open(path, encoding="utf-8") as f:
-                    doc = yaml.safe_load(f) or {}
-                for key in ("principals", "resources", "auxData", "principalGroups", "resourceGroups"):
-                    if key in doc:
-                        out[key].update(doc[key] or {})
-    return out
-
-
-def _principal_from(d: dict) -> Principal:
-    return Principal(
-        id=d.get("id", ""),
-        roles=list(d.get("roles", [])),
-        attr=d.get("attr", {}) or {},
-        policy_version=str(d.get("policyVersion", "")),
-        scope=d.get("scope", ""),
-    )
-
-
-def _resource_from(d: dict) -> Resource:
-    return Resource(
-        kind=d.get("kind", ""),
-        id=d.get("id", ""),
-        attr=d.get("attr", {}) or {},
-        policy_version=str(d.get("policyVersion", "")),
-        scope=d.get("scope", ""),
-    )
-
-
-def _expand_names(names: list[str], groups: dict[str, Any]) -> list[str]:
-    out: list[str] = []
-    for n in names:
-        grp = groups.get(n)
-        if grp is not None:
-            members = grp.get("principals") or grp.get("resources") or []
-            out.extend(members)
-        else:
-            out.append(n)
-    return out
-
-
-def run_suite(path: str, engine: Engine, run_filter: str = "", verbose: bool = False) -> SuiteResults:
-    with open(path, encoding="utf-8") as f:
-        suite = yaml.safe_load(f) or {}
-    testdata_dir = os.path.join(os.path.dirname(path), "testdata")
-    fixtures = _load_fixtures(testdata_dir)
-
-    suite_name = suite.get("name", os.path.basename(path))
-    results = SuiteResults()
-    if suite.get("skip"):
-        results.results.append(
-            TestResult(suite=suite_name, name=suite.get("skipReason", "skipped"), principal="", resource="", passed=True, skipped=True)
-        )
-        return results
-
-    principals = dict(fixtures["principals"])
-    principals.update(suite.get("principals", {}) or {})
-    resources = dict(fixtures["resources"])
-    resources.update(suite.get("resources", {}) or {})
-    aux_data = dict(fixtures["auxData"])
-    aux_data.update(suite.get("auxData", {}) or {})
-    p_groups = dict(fixtures["principalGroups"])
-    p_groups.update(suite.get("principalGroups", {}) or {})
-    r_groups = dict(fixtures["resourceGroups"])
-    r_groups.update(suite.get("resourceGroups", {}) or {})
-
-    options = suite.get("options", {}) or {}
-    params = EvalParams(
-        globals=options.get("globals", {}) or {},
-        default_policy_version=options.get("defaultPolicyVersion", "default"),
-        default_scope=options.get("defaultScope", ""),
-        lenient_scope_search=bool(options.get("lenientScopeSearch", False)),
-    )
-    if options.get("now"):
-        fixed = Timestamp.parse(str(options["now"]))
-        params.now_fn = lambda: fixed
-
-    rx = re.compile(run_filter) if run_filter else None
-
-    for test in suite.get("tests", []) or []:
-        name = test.get("name", "unnamed")
-        if rx is not None and not rx.search(name):
-            continue
-        if test.get("skip"):
-            results.results.append(TestResult(suite=suite_name, name=name, principal="", resource="", passed=True, skipped=True))
-            continue
-        tin = test.get("input", {}) or {}
-        p_names = _expand_names(list(tin.get("principals", [])), p_groups)
-        r_names = _expand_names(list(tin.get("resources", [])), r_groups)
-        actions = list(tin.get("actions", []))
-        aux_name = tin.get("auxData", "")
-        aux = None
-        if aux_name:
-            aux_doc = aux_data.get(aux_name, {})
-            aux = AuxData(jwt=(aux_doc.get("jwt") or {}))
-
-        expected_index: dict[tuple[str, str], dict] = {}
-        for exp in test.get("expected", []) or []:
-            expected_index[(exp.get("principal", ""), exp.get("resource", ""))] = exp
-
-        for p_name in p_names:
-            for r_name in r_names:
-                failures: list[str] = []
-                p_doc = principals.get(p_name)
-                r_doc = resources.get(r_name)
-                if p_doc is None:
-                    failures.append(f"unknown principal fixture {p_name!r}")
-                if r_doc is None:
-                    failures.append(f"unknown resource fixture {r_name!r}")
-                if failures:
-                    results.results.append(TestResult(suite=suite_name, name=name, principal=p_name, resource=r_name, passed=False, failures=failures))
-                    continue
-                out = engine.check(
-                    [CheckInput(principal=_principal_from(p_doc), resource=_resource_from(r_doc), actions=actions, aux_data=aux)],
-                    params=params,
-                )[0]
-                exp = expected_index.get((p_name, r_name), {})
-                exp_actions = exp.get("actions", {}) or {}
-                for action in actions:
-                    want = exp_actions.get(action, "EFFECT_DENY")
-                    got = out.actions[action].effect
-                    if got != want:
-                        failures.append(f"action {action!r}: expected {want}, got {got}")
-                for oexp in exp.get("outputs", []) or []:
-                    action = oexp.get("action", "")
-                    for expected_entry in oexp.get("expected", []) or []:
-                        src = expected_entry.get("src", "")
-                        want_val = expected_entry.get("val")
-                        got_entries = [o for o in out.outputs if o.src == src and o.action == action]
-                        if not got_entries:
-                            failures.append(f"output {src!r} for action {action!r}: not produced")
-                        elif got_entries[0].val != want_val:
-                            failures.append(
-                                f"output {src!r} for action {action!r}: expected {want_val!r}, got {got_entries[0].val!r}"
-                            )
-                traces: list[dict] = []
-                if failures and verbose:
-                    from ..tracer import traced_check
-
-                    _, recorder = traced_check(
-                        engine.rule_table,
-                        CheckInput(principal=_principal_from(p_doc), resource=_resource_from(r_doc), actions=actions, aux_data=aux),
-                        params,
-                        engine.schema_mgr,
-                    )
-                    traces = recorder.to_json()
-                results.results.append(
-                    TestResult(suite=suite_name, name=name, principal=p_name, resource=r_name, passed=not failures, failures=failures, traces=traces)
-                )
-    return results
 
 
 def discover_and_run(policy_dir: str, run_filter: str = "", verbose: bool = False) -> Optional[SuiteResults]:
     """Find *_test.yaml suites under the policy dir and run them against a
     fresh engine built from the same dir (ref: cmd/cerbos/compile)."""
-    suite_paths = []
+    from ..compile import compile_policy_set
+    from ..engine.engine import Engine
+    from ..storage.disk import DiskStore
+
+    has_suites = False
     for root, dirs, files in os.walk(policy_dir):
         dirs[:] = [d for d in dirs if not d.startswith(".")]
-        for f in files:
-            if f.endswith(("_test.yaml", "_test.yml")):
-                suite_paths.append(os.path.join(root, f))
-    if not suite_paths:
+        if any(f.endswith(("_test.yaml", "_test.yml", "_test.json")) for f in files):
+            has_suites = True
+            break
+    if not has_suites:
         return None
+
     store = DiskStore(policy_dir)
     engine = Engine.from_policies(compile_policy_set(store.get_all()))
-    all_results = SuiteResults()
-    for path in sorted(suite_paths):
-        all_results.results.extend(run_suite(path, engine, run_filter, verbose=verbose).results)
-    return all_results
+    conf = Config(included_test_names_regexp=run_filter, trace=verbose)
+    return SuiteResults(verify(policy_dir, engine, conf))

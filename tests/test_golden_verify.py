@@ -1,0 +1,95 @@
+"""Reference verify corpus: policy-test framework gated on TestResults goldens.
+
+Mirrors internal/verify/verify_test.go TestVerify: each case_NNN.yaml is a
+VerifyTestCase descriptor (description, config), the .input is a txtar
+archive of a test-suite directory, and the .golden is the protojson
+TestResults produced by running the suites against the golden policy store
+engine. Comparison normalizes numbers and sorts repeated suites by file,
+exactly as the reference's protocmp options do.
+"""
+
+import json
+import os
+import re
+
+import pytest
+import yaml
+
+from cerbos_tpu import namer
+from cerbos_tpu.verify.results import Config, verify
+from golden_loader import golden_engine
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden", "verify", "cases")
+
+CASES = sorted(
+    f for f in os.listdir(CORPUS)
+    if f.endswith(".yaml") and os.path.exists(os.path.join(CORPUS, f + ".golden"))
+)
+
+
+def expand_txtar(data: str, dest: str) -> None:
+    """Minimal txtar: `-- name --` headers, body until the next header."""
+    current = None
+    lines: list[str] = []
+
+    def flush():
+        if current is None:
+            return
+        path = os.path.join(dest, current)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+
+    for line in data.splitlines():
+        m = re.match(r"^-- (.+?) --$", line)
+        if m:
+            flush()
+            current = m.group(1).strip()
+            lines = []
+        elif current is not None:
+            lines.append(line)
+    flush()
+
+
+def _norm(v):
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in sorted(v.items())}
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        return v.replace(" ", " ")  # the reference's NBSP comparer
+    return v
+
+
+def _conf_from(case: dict) -> Config:
+    cfg = case.get("config") or {}
+    return Config(
+        excluded_resource_policy_fqns=set(cfg.get("excludedResourcePolicyFqns", []) or []),
+        excluded_principal_policy_fqns=set(cfg.get("excludedPrincipalPolicyFqns", []) or []),
+        included_test_names_regexp=cfg.get("includedTestNamesRegexp", "") or "",
+    )
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return golden_engine()
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_verify_case(case, engine, tmp_path):
+    with open(os.path.join(CORPUS, case), encoding="utf-8") as f:
+        descriptor = yaml.safe_load(f) or {}
+    with open(os.path.join(CORPUS, case + ".input"), encoding="utf-8") as f:
+        expand_txtar(f.read(), str(tmp_path))
+    with open(os.path.join(CORPUS, case + ".golden"), encoding="utf-8") as f:
+        want = json.load(f)
+
+    have = verify(str(tmp_path), engine, _conf_from(descriptor))
+
+    want["suites"] = sorted(want.get("suites", []), key=lambda s: s.get("file", ""))
+    have["suites"] = sorted(have.get("suites", []), key=lambda s: s.get("file", ""))
+    assert _norm(want) == _norm(have), case

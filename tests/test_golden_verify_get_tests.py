@@ -1,0 +1,100 @@
+"""Reference test_suite_run_get_tests corpus: matrix expansion.
+
+Mirrors internal/verify/run_test_suite_test.go Test_testSuiteRun_getTests:
+a fixed fixture set, one test table per case, comparing the expanded test
+list (or the exact error string) against the corpus.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from cerbos_tpu.verify.results import TestFixture, VerifyError, _SuiteRun
+
+CORPUS = os.path.join(
+    os.path.dirname(__file__), "golden", "verify", "test_suite_run_get_tests"
+)
+
+CASES = sorted(f for f in os.listdir(CORPUS) if f.endswith(".yaml"))
+
+# run_test_suite_test.go:20-46
+FIXTURE = TestFixture(
+    principals={
+        "employee": {"id": "employee", "roles": ["user"]},
+        "manager": {"id": "manager", "roles": ["user"]},
+        "department_head": {"id": "department_head", "roles": ["user"]},
+    },
+    principal_groups={"management": ["manager", "department_head"]},
+    resources={
+        "employee_leave_request": {"kind": "leave_request", "id": "employee"},
+        "manager_leave_request": {"kind": "leave_request", "id": "manager"},
+        "department_head_leave_request": {"kind": "leave_request", "id": "department_head"},
+    },
+    resource_groups={
+        "management_leave_requests": ["manager_leave_request", "department_head_leave_request"]
+    },
+    aux_data={"test_aux_data": {"jwt": {"answer": 42}}},
+)
+
+
+def _test_to_dict(t, table: dict) -> dict:
+    out: dict = {"name": t.name}
+    if table.get("description"):
+        out["description"] = table["description"]
+    if t.skip:
+        out["skip"] = True
+    if t.skip_reason:
+        out["skipReason"] = t.skip_reason
+    inp: dict = {}
+    if t.principal:
+        inp["principal"] = t.principal
+    if t.resource:
+        inp["resource"] = t.resource
+    if t.actions:
+        inp["actions"] = t.actions
+    if t.aux_data is not None:
+        inp["auxData"] = t.aux_data
+    out["input"] = inp
+    if t.expected:
+        out["expected"] = t.expected
+    if t.expected_outputs:
+        out["expectedOutputs"] = {
+            action: {"entries": entries} for action, entries in t.expected_outputs.items()
+        }
+    if t.options:
+        out["options"] = t.options
+    return out
+
+
+def _norm(v):
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in sorted(v.items())}
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    return v
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_get_tests(case):
+    with open(os.path.join(CORPUS, case), encoding="utf-8") as f:
+        tc = yaml.safe_load(f)
+
+    table = tc["table"]
+    run = _SuiteRun({"tests": [table]}, FIXTURE)
+
+    want_err = (tc.get("wantErr") or "").strip()
+    if want_err:
+        with pytest.raises(VerifyError) as exc:
+            run.get_tests()
+        assert str(exc.value) == want_err, case
+        return
+
+    tests = run.get_tests()
+    want = tc.get("wantTests") or []
+    have = [_test_to_dict(t, table) for t in tests]
+    assert _norm(want) == _norm(have), case

@@ -240,7 +240,10 @@ class TestVerifyFramework:
         results = discover_and_run(str(tmp_path))
         assert results is not None
         assert not results.failed
-        assert len(results.results) == 2  # 2 principals x 1 resource
+        suite = results.results["suites"][0]
+        tc = suite["testCases"][0]
+        assert len(tc["principals"]) == 2  # 2 principals x 1 resource
+        assert results.results["summary"]["testsCount"] == 6  # x 3 actions
 
     def test_failing_expectation(self, tmp_path):
         write(tmp_path, "doc.yaml", POLICY_A)
@@ -256,8 +259,14 @@ class TestVerifyFramework:
         }))
         results = discover_and_run(str(tmp_path))
         assert results.failed
-        assert "expected EFFECT_DENY, got EFFECT_ALLOW" in results.results[0].failures[0]
-        assert "<testsuites>" in results.to_junit() or "testsuite" in results.to_junit()
+        details = (
+            results.results["suites"][0]["testCases"][0]["principals"][0]["resources"][0]
+            ["actions"][0]["details"]
+        )
+        assert details["result"] == "RESULT_FAILED"
+        assert details["failure"] == {"expected": "EFFECT_DENY", "actual": "EFFECT_ALLOW"}
+        assert "expected EFFECT_DENY, got EFFECT_ALLOW" in results.summary()
+        assert "testsuite" in results.to_junit()
 
 
 class TestDBDialects:
