@@ -42,12 +42,25 @@ def check(node: Node) -> None:
 def _walk(node: Node, bound: set[str]) -> None:
     if isinstance(node, Ident):
         if node.name not in ROOT_IDENTS and node.name not in bound:
-            raise CheckError(f"undeclared reference to '{node.name}'")
+            raise CheckError(f"undeclared reference to '{node.name}' (in container '')")
         return
     if isinstance(node, (Select, Present)):
         _check_select(node, bound)
         return
     if isinstance(node, Index):
+        # variables/constants/globals are typed messages in the reference,
+        # not maps: index syntax on them fails the type check
+        # (compile corpus variables_index_lookup.yaml) — unless the name is
+        # locally bound (a comprehension variable shadowing V/C/G)
+        if (
+            isinstance(node.operand, Ident)
+            and node.operand.name not in bound
+            and node.operand.name in ("V", "variables", "C", "constants", "G", "globals")
+        ):
+            raise CheckError(
+                "found no matching overload for '_[_]' applied to "
+                "'(cerbos.Variables, string)'"
+            )
         _walk(node.operand, bound)
         _walk(node.index, bound)
         return

@@ -165,7 +165,23 @@ def cmd_compile(args: argparse.Namespace) -> int:
     try:
         store = DiskStore(args.dir)
         policies = store.get_all()
-        compile_policy_set(policies)
+
+        def schema_check(ref: str):
+            # compile-time schema-ref validation over the same store
+            # (ref: cerbos compile behaviour, internal/compile schema checks)
+            schema_id = ref[len("cerbos:///"):] if ref.startswith("cerbos:///") else ref
+            raw = store.get_schema(schema_id)
+            if raw is None:
+                return ("missing", f"_schemas/{schema_id}")
+            try:
+                import jsonschema as _js
+
+                _js.Draft202012Validator.check_schema(json.loads(raw))
+            except Exception as e:  # noqa: BLE001
+                return ("invalid", f"jsonschema {ref} compilation failed: {e}")
+            return None
+
+        compile_policy_set(policies, schema_check=schema_check)
     except (BuildError, CompileError) as e:
         errors = getattr(e, "errors", [str(e)])
         if args.output == "json":
@@ -188,7 +204,7 @@ def cmd_compile(args: argparse.Namespace) -> int:
     if args.output == "json":
         print(json.dumps(results.to_json(), indent=2))
     elif args.output == "junit":
-        print(results.to_junit())
+        print(results.to_junit(verbose=getattr(args, "verbose", False)))
     else:
         print(results.summary())
     return 4 if results.failed else 0

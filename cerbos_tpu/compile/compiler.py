@@ -2,15 +2,23 @@
 
 Behavioral reference: internal/compile (derived-roles import resolution,
 exported constants/variables resolution with topological ordering of
-variable definitions, condition compilation). Conditions are parsed and
-checked here; evaluation uses the AST directly (the reference compiles CEL
-programs lazily from source, ruletable.go:506-538).
+variable definitions, condition compilation, structured source errors).
+Conditions are parsed and checked here; evaluation uses the AST directly
+(the reference compiles CEL programs lazily from source,
+ruletable.go:506-538).
+
+Errors are structured (file, short kind, description, position, path) with
+the reference's exact message text (compile corpus-gated): undefined /
+cyclical / redefined variables and constants, invalid identifiers, unknown
+or ambiguous derived roles, missing imports and scope ancestors, empty
+outputs, role-less resource rules, script conditions and schema-ref
+failures (internal/compile/errors.go).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Callable, Optional, Union
 
 from .. import namer
 from ..cel import ast as cel_ast
@@ -20,11 +28,77 @@ from ..cel.errors import CelParseError
 from ..util import normalize_attr
 from ..policy import model
 
+# segment types for source paths: field name (camelCase), list index, map key
+Seg = Union[str, int, tuple]
+
+
+def _key_seg(key: str) -> tuple:
+    return ("k", key)
+
+
+def _disp_path(segs: tuple[Seg, ...]) -> str:
+    """Render a path the way the reference's compile errors do: dots for map
+    keys (single-quoted when the key itself contains dots)."""
+    out = "$"
+    for s in segs:
+        if isinstance(s, int):
+            out += f"[{s}]"
+        elif isinstance(s, tuple):
+            k = s[1]
+            out += f".'{k}'" if "." in k else f".{k}"
+        else:
+            out += f".{s}"
+    return out
+
+
+def _camel(s: str) -> str:
+    parts = s.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _lookup_path(segs: tuple[Seg, ...]) -> str:
+    """Render a path in the strict parser's position-table key form."""
+    out = "$"
+    for s in segs:
+        if isinstance(s, int):
+            out += f"[{s}]"
+        elif isinstance(s, tuple):
+            out += f'["{_camel(s[1])}"]'
+        else:
+            out += f".{s}"
+    return out
+
+
+@dataclass
+class CompileErrorDetail:
+    file: str
+    error: str  # short kind, e.g. "unknown derived role"
+    description: str
+    line: int = 0
+    column: int = 0
+    path: str = ""
+
+    def render(self) -> str:
+        loc = f":{self.line}:{self.column}" if self.line else ""
+        return f"{self.file}{loc}: {self.description}"
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"file": self.file, "error": self.error,
+                               "description": self.description}
+        if self.line:
+            out["position"] = {"line": self.line, "column": self.column, "path": self.path}
+        return out
+
 
 class CompileError(Exception):
-    def __init__(self, errors: list[str]):
-        self.errors = errors
-        super().__init__("; ".join(errors) if errors else "compile error")
+    def __init__(self, errors: "list[str] | list[CompileErrorDetail]"):
+        if errors and isinstance(errors[0], CompileErrorDetail):
+            self.details: list[CompileErrorDetail] = list(errors)  # type: ignore[arg-type]
+            self.errors = [d.render() for d in self.details]
+        else:
+            self.details = []
+            self.errors = list(errors)  # type: ignore[arg-type]
+        super().__init__("; ".join(self.errors) if self.errors else "compile error")
 
 
 @dataclass(frozen=True)
@@ -149,153 +223,453 @@ class CompiledRolePolicy:
 
 CompiledPolicy = CompiledResourcePolicy | CompiledPrincipalPolicy | CompiledRolePolicy
 
+# CEL reserved words that cannot name a variable or constant
+_CEL_RESERVED = {
+    "true", "false", "null", "in", "as", "break", "const", "continue", "else",
+    "for", "function", "if", "import", "let", "loop", "package", "namespace",
+    "return", "var", "void", "while",
+}
+
+
+def _is_valid_ident(name: str) -> bool:
+    if not name or not (name[0].isalpha() or name[0] == "_"):
+        return False
+    return all(c.isalnum() or c == "_" for c in name[1:])
+
+
+def _file_of(pol: model.Policy) -> str:
+    return (
+        pol.source_file
+        or (pol.metadata.source_file if pol.metadata else "")
+        or pol.fqn()
+    )
+
 
 class _Ctx:
-    def __init__(self, repo: dict[str, model.Policy], source: str):
+    def __init__(self, repo: dict[str, model.Policy], pol: model.Policy, shared: Optional[dict] = None):
         self.repo = repo
-        self.source = source
-        self.errors: list[str] = []
+        self.pol = pol
+        self.source = _file_of(pol)
+        self.details: list[CompileErrorDetail] = []
+        # cross-policy caches for set compilation (validated exports, etc.)
+        self.shared = shared if shared is not None else {}
 
-    def err(self, msg: str) -> None:
-        self.errors.append(f"{self.source}: {msg}" if self.source else msg)
+    def pos_of(self, pol: model.Policy, segs: tuple[Seg, ...], anchor: str) -> tuple[int, int]:
+        table = pol.val_positions if anchor == "val" else pol.key_positions
+        return table.get(_lookup_path(segs), (0, 0))
+
+    def err(
+        self,
+        kind: str,
+        desc: str,
+        segs: Optional[tuple[Seg, ...]] = None,
+        anchor: str = "key",
+        pol: Optional[model.Policy] = None,
+    ) -> None:
+        pol = pol or self.pol
+        line = col = 0
+        path = ""
+        if segs:
+            line, col = self.pos_of(pol, segs, anchor)
+            path = _disp_path(segs)
+        self.details.append(
+            CompileErrorDetail(
+                file=_file_of(pol), error=kind, description=desc,
+                line=line, column=col, path=path,
+            )
+        )
+
+    # backwards-compatible free-form error
+    def err_text(self, msg: str) -> None:
+        self.details.append(
+            CompileErrorDetail(file=self.source, error="compile error", description=msg)
+        )
 
 
-def _compile_expr(src: str, ctx: _Ctx, where: str) -> Optional[CompiledExpr]:
+def _compile_expr(
+    src: str,
+    ctx: _Ctx,
+    segs: tuple[Seg, ...],
+    owner: Optional[model.Policy] = None,
+    anchor: str = "key",
+) -> Optional[CompiledExpr]:
+    # field-path expressions (match.expr, output.when.*) anchor at their KEY
+    # token; map-entry expressions (variables.local.X) pass anchor="val"
+    # (compile corpus bad_cel_expr 12:15 vs bad_variables 15:10)
     try:
         node = cel_parse(src)
         cel_check(node)
         return CompiledExpr(original=src, node=node)
     except CelParseError as e:
-        ctx.err(f"{where}: invalid expression {src!r}: {e}")
+        ctx.err(
+            "invalid expression",
+            f"Invalid expression `{src}`: [{e}]",
+            segs, anchor=anchor, pol=owner,
+        )
         return None
 
 
-def _compile_match(m: model.Match, ctx: _Ctx, where: str) -> Optional[CompiledCondition]:
+def _compile_match(
+    m: model.Match, ctx: _Ctx, segs: tuple[Seg, ...], owner: Optional[model.Policy] = None
+) -> Optional[CompiledCondition]:
     if m.expr is not None:
-        ce = _compile_expr(m.expr, ctx, where)
+        ce = _compile_expr(m.expr, ctx, segs + ("expr",), owner)
         return CompiledCondition(kind="expr", expr=ce) if ce else None
     for kind in ("all", "any", "none"):
         children = getattr(m, kind)
         if children is not None:
-            compiled = [_compile_match(c, ctx, where) for c in children]
+            compiled = [
+                _compile_match(c, ctx, segs + (kind, "of", j), owner)
+                for j, c in enumerate(children)
+            ]
             if any(c is None for c in compiled):
                 return None
             return CompiledCondition(kind=kind, children=tuple(compiled))  # type: ignore[arg-type]
-    ctx.err(f"{where}: empty match")
+    ctx.err("invalid condition", "empty match", segs, pol=owner)
     return None
 
 
-def _compile_condition(c: Optional[model.Condition], ctx: _Ctx, where: str) -> Optional[CompiledCondition]:
+def _compile_condition(
+    c: Optional[model.Condition],
+    ctx: _Ctx,
+    segs: tuple[Seg, ...],
+    owner: Optional[model.Policy] = None,
+) -> Optional[CompiledCondition]:
     if c is None:
         return None
     if c.script is not None:
-        ctx.err(f"{where}: script conditions are not supported")
+        ctx.err(
+            "scripts in conditions are no longer supported", "Unsupported feature",
+            segs, pol=owner,
+        )
         return None
     if c.match is None:
-        ctx.err(f"{where}: condition must define match")
+        ctx.err("invalid condition", "condition must define match", segs, pol=owner)
         return None
-    return _compile_match(c.match, ctx, where)
+    return _compile_match(c.match, ctx, segs + ("match",), owner)
 
 
-def _compile_output(o: Optional[model.Output], ctx: _Ctx, where: str) -> Optional[CompiledOutput]:
+def _compile_output(
+    o: Optional[model.Output], ctx: _Ctx, segs: tuple[Seg, ...]
+) -> Optional[CompiledOutput]:
     if o is None:
         return None
     rule_activated = None
     condition_not_met = None
     if o.when is not None:
         if o.when.rule_activated:
-            rule_activated = _compile_expr(o.when.rule_activated, ctx, f"{where}.output.when.ruleActivated")
+            rule_activated = _compile_expr(o.when.rule_activated, ctx, segs + ("when", "ruleActivated"))
         if o.when.condition_not_met:
-            condition_not_met = _compile_expr(o.when.condition_not_met, ctx, f"{where}.output.when.conditionNotMet")
+            condition_not_met = _compile_expr(o.when.condition_not_met, ctx, segs + ("when", "conditionNotMet"))
     elif o.expr:
         # deprecated output.expr is an alias for when.ruleActivated
-        rule_activated = _compile_expr(o.expr, ctx, f"{where}.output.expr")
+        rule_activated = _compile_expr(o.expr, ctx, segs + ("expr",))
+    # emptiness is STRUCTURAL (no expressions defined) — an output whose
+    # expression failed to compile already reported "invalid expression"
+    structurally_empty = not (
+        (o.when is not None and (o.when.rule_activated or o.when.condition_not_met))
+        or o.expr
+    )
+    if structurally_empty:
+        ctx.err("empty output", "output must have at least one expression", segs)
     if rule_activated is None and condition_not_met is None:
         return None
     return CompiledOutput(rule_activated=rule_activated, condition_not_met=condition_not_met)
 
 
-def _resolve_constants(c: Optional[model.Constants], ctx: _Ctx) -> dict[str, Any]:
-    out: dict[str, Any] = {}
-    if c is None:
-        return out
-    for imp in c.import_:
-        fqn = namer.export_constants_fqn(imp)
-        pol = ctx.repo.get(fqn)
-        if pol is None or pol.export_constants is None:
-            ctx.err(f"imported constants {imp!r} ({fqn}) not found")
-            continue
-        for k, v in pol.export_constants.definitions.items():
-            out[k] = normalize_attr(v)
-    for k, v in c.local.items():
-        out[k] = normalize_attr(v)
-    return out
-
-
 def _variable_refs(node: cel_ast.Node) -> set[str]:
     """Names referenced as variables.X / V.X inside an expression."""
+    return _root_refs(node, ("variables", "V"))
+
+
+def _constant_refs(node: cel_ast.Node) -> set[str]:
+    return _root_refs(node, ("constants", "C"))
+
+
+def _root_refs(node: cel_ast.Node, roots: tuple[str, ...]) -> set[str]:
     refs: set[str] = set()
     for n in cel_ast.walk(node):
         if isinstance(n, cel_ast.Select) and isinstance(n.operand, cel_ast.Ident):
-            if n.operand.name in ("variables", "V"):
+            if n.operand.name in roots:
                 refs.add(n.field)
         elif isinstance(n, cel_ast.Index) and isinstance(n.operand, cel_ast.Ident):
-            if n.operand.name in ("variables", "V") and isinstance(n.index, cel_ast.Lit) and isinstance(n.index.value, str):
+            if n.operand.name in roots and isinstance(n.index, cel_ast.Lit) and isinstance(n.index.value, str):
                 refs.add(n.index.value)
     return refs
+
+
+def _join_origins(origins: list[str]) -> str:
+    if len(origins) == 2:
+        return f"{origins[0]} and {origins[1]}"
+    return ", ".join(origins[:-1]) + f", and {origins[-1]}"
+
+
+def _validate_export_idents(ctx: _Ctx, export_pol: model.Policy, section: str, kind_word: str) -> None:
+    """Identifier validation for exportVariables/exportConstants definitions,
+    attributed to the export file; ran once per export policy per set."""
+    seen: set[int] = ctx.shared.setdefault("validated_exports", set())
+    if id(export_pol) in seen:
+        return
+    seen.add(id(export_pol))
+    defs = (
+        export_pol.export_variables.definitions
+        if section == "exportVariables"
+        else export_pol.export_constants.definitions
+    )
+    for name in defs:
+        _validate_ident(ctx, name, (section, "definitions", _key_seg(name)), kind_word, export_pol)
+
+
+def _validate_ident(
+    ctx: _Ctx, name: str, segs: tuple[Seg, ...], kind_word: str, pol: Optional[model.Policy] = None
+) -> None:
+    if name in _CEL_RESERVED:
+        ctx.err(
+            f"invalid {kind_word} name",
+            f'"{name}" is a reserved keyword and can\'t be used as an identifier',
+            segs, anchor="key", pol=pol,
+        )
+    elif not _is_valid_ident(name):
+        ctx.err(
+            f"invalid {kind_word} name",
+            f'"{name}" is not a valid identifier',
+            segs, anchor="key", pol=pol,
+        )
+
+
+@dataclass
+class _Def:
+    """One variable/constant definition with provenance."""
+
+    value: Any
+    segs: tuple[Seg, ...]
+    owner: model.Policy
+    origin: str  # rendered origin label for redefinition errors
+
+
+def _resolve_constants(
+    c: Optional[model.Constants], ctx: _Ctx, base: tuple[Seg, ...]
+) -> tuple[dict[str, Any], dict[str, _Def]]:
+    sources: dict[str, list[str]] = {}
+    defs: dict[str, _Def] = {}
+    if c is not None:
+        for i, imp in enumerate(c.import_):
+            fqn = namer.export_constants_fqn(imp)
+            pol = ctx.repo.get(fqn)
+            if pol is None or pol.export_constants is None:
+                ctx.err(
+                    "import not found", f"Constants import '{imp}' cannot be found",
+                    base + ("constants", "import", i),
+                )
+                continue
+            _validate_export_idents(ctx, pol, "exportConstants", "constant")
+            for k, v in pol.export_constants.definitions.items():
+                segs = ("exportConstants", "definitions", _key_seg(k))
+                line, col = ctx.pos_of(pol, segs, "val")
+                sources.setdefault(k, []).append(
+                    f"import '{imp}' ({_file_of(pol)}:{line}:{col})"
+                )
+                defs[k] = _Def(normalize_attr(v), segs, pol, imp)
+        for k, v in c.local.items():
+            segs = base + ("constants", "local", _key_seg(k))
+            _validate_ident(ctx, k, segs, "constant")
+            line, col = ctx.pos_of(ctx.pol, segs, "val")
+            sources.setdefault(k, []).append(
+                f"policy local constants ({ctx.source}:{line}:{col})"
+            )
+            defs[k] = _Def(normalize_attr(v), segs, ctx.pol, "")
+    for name, origins in sources.items():
+        if len(origins) > 1:
+            ctx.err(
+                "constant redefined",
+                f"Constant '{name}' has multiple definitions in {_join_origins(origins)}",
+            )
+    return {k: d.value for k, d in defs.items()}, defs
 
 
 def _resolve_variables(
     v: Optional[model.Variables],
     deprecated_top_level: dict[str, str],
     ctx: _Ctx,
+    base: tuple[Seg, ...],
+    constant_names: set[str],
 ) -> tuple[CompiledVariable, ...]:
-    defs: dict[str, str] = {}
+    sources: dict[str, list[str]] = {}
+    defs: dict[str, _Def] = {}
     if v is not None:
-        for imp in v.import_:
+        for i, imp in enumerate(v.import_):
             fqn = namer.export_variables_fqn(imp)
             pol = ctx.repo.get(fqn)
             if pol is None or pol.export_variables is None:
-                ctx.err(f"imported variables {imp!r} ({fqn}) not found")
+                ctx.err(
+                    "import not found", f"Variables import '{imp}' cannot be found",
+                    base + ("variables", "import", i),
+                )
                 continue
-            defs.update(pol.export_variables.definitions)
-    # deprecated top-level policy.variables map merges under local
-    defs.update(deprecated_top_level)
+            _validate_export_idents(ctx, pol, "exportVariables", "variable")
+            for k, src in pol.export_variables.definitions.items():
+                segs = ("exportVariables", "definitions", _key_seg(k))
+                line, col = ctx.pos_of(pol, segs, "val")
+                sources.setdefault(k, []).append(
+                    f"import '{imp}' ({_file_of(pol)}:{line}:{col})"
+                )
+                defs[k] = _Def(src, segs, pol, imp)
     if v is not None:
-        defs.update(v.local)
+        for k, src in v.local.items():
+            segs = base + ("variables", "local", _key_seg(k))
+            _validate_ident(ctx, k, segs, "variable")
+            line, col = ctx.pos_of(ctx.pol, segs, "val")
+            sources.setdefault(k, []).append(
+                f"policy local variables ({ctx.source}:{line}:{col})"
+            )
+            defs[k] = _Def(src, segs, ctx.pol, "")
+    for k, src in deprecated_top_level.items():
+        segs = ("variables", _key_seg(k))
+        line, col = ctx.pos_of(ctx.pol, segs, "val")
+        sources.setdefault(k, []).append(
+            f"deprecated top-level policy variables ({ctx.source}:{line}:{col})"
+        )
+        # deprecated map only applies when not shadowed by a local def
+        if k not in (v.local if v is not None else {}):
+            defs[k] = _Def(src, segs, ctx.pol, "")
+
+    for name, origins in sources.items():
+        if len(origins) > 1:
+            ctx.err(
+                "variable redefined",
+                f"Variable '{name}' has multiple definitions in {_join_origins(origins)}",
+            )
 
     compiled: dict[str, CompiledVariable] = {}
     deps: dict[str, set[str]] = {}
-    for name, src in defs.items():
-        ce = _compile_expr(src, ctx, f"variable {name}")
+    for name, d in defs.items():
+        ce = _compile_expr(str(d.value), ctx, d.segs, owner=d.owner, anchor="val")
         if ce is None:
             continue
         compiled[name] = CompiledVariable(name=name, expr=ce)
-        deps[name] = _variable_refs(ce.node) & set(defs.keys())
+        refs = _variable_refs(ce.node)
+        deps[name] = refs & set(defs.keys())
+        for missing in sorted(refs - set(defs.keys())):
+            ctx.err(
+                "undefined variable",
+                f"Undefined variable '{missing}' referenced in variable '{name}'",
+                d.segs, anchor="val", pol=d.owner,
+            )
+        for missing in sorted(_constant_refs(ce.node) - constant_names):
+            ctx.err(
+                "undefined constant",
+                f"Undefined constant '{missing}' referenced in variable '{name}'",
+                d.segs, anchor="val", pol=d.owner,
+            )
+
+    # cycle detection over the dependency graph: self-references and larger
+    # strongly-connected components are reported once, members excluded from
+    # the ordered output (ref: internal/compile/variables.go)
+    cyclic: set[str] = set()
+    for name in defs:
+        if name in deps.get(name, ()):
+            d = defs[name]
+            ctx.err(
+                "cyclical variable definitions",
+                f"Variable '{name}' references itself",
+                d.segs, anchor="val", pol=d.owner,
+            )
+            cyclic.add(name)
+    for scc in _sccs({n: deps.get(n, set()) - cyclic for n in compiled if n not in cyclic}):
+        if len(scc) < 2:
+            continue
+        members = [n for n in defs if n in scc]  # definition order
+        parts = []
+        for n in members:
+            d = defs[n]
+            line, col = ctx.pos_of(d.owner, d.segs, "val")
+            parts.append(f"'{n}' ({_file_of(d.owner)}:{line}:{col})")
+        first = defs[members[0]]
+        ctx.details.append(
+            CompileErrorDetail(
+                file=_file_of(first.owner),
+                error="cyclical variable definitions",
+                description=f"Variables {_join_origins(parts)} form a cycle",
+                line=ctx.pos_of(first.owner, first.segs, "val")[0],
+                column=ctx.pos_of(first.owner, first.segs, "val")[1],
+                path=_disp_path(first.segs),
+            )
+        )
+        cyclic.update(scc)
 
     # topological order (ref: internal/compile/variables.go sortVariables)
     ordered: list[CompiledVariable] = []
     state: dict[str, int] = {}  # 0=unvisited 1=visiting 2=done
 
-    def visit(name: str, chain: list[str]) -> None:
+    def visit(name: str) -> None:
         st = state.get(name, 0)
-        if st == 2:
-            return
-        if st == 1:
-            ctx.err(f"circular dependency between variables: {' -> '.join(chain + [name])}")
+        if st != 0:
             return
         state[name] = 1
         for dep in sorted(deps.get(name, ())):
-            if dep in compiled:
-                visit(dep, chain + [name])
+            if dep in compiled and dep not in cyclic:
+                visit(dep)
         state[name] = 2
         ordered.append(compiled[name])
 
     for name in defs:
-        if name in compiled:
-            visit(name, [])
+        if name in compiled and name not in cyclic:
+            visit(name)
 
     return tuple(ordered)
+
+
+def _sccs(graph: dict[str, set[str]]) -> list[set[str]]:
+    """Tarjan SCCs (iterative), deterministic over insertion order."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[set[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in graph:
+                    continue
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+
+    for n in graph:
+        if n not in index:
+            strongconnect(n)
+    return out
 
 
 def _params(
@@ -303,58 +677,214 @@ def _params(
     constants: Optional[model.Constants],
     deprecated_vars: dict[str, str],
     ctx: _Ctx,
+    base: tuple[Seg, ...],
 ) -> PolicyParams:
-    return PolicyParams(
-        constants=_resolve_constants(constants, ctx),
-        ordered_variables=_resolve_variables(variables, deprecated_vars, ctx),
-    )
+    consts, _defs = _resolve_constants(constants, ctx, base)
+    ordered = _resolve_variables(variables, deprecated_vars, ctx, base, set(consts.keys()))
+    return PolicyParams(constants=consts, ordered_variables=ordered)
+
+
+def _check_expr_refs(
+    ce: Optional[CompiledExpr],
+    ctx: _Ctx,
+    segs: tuple[Seg, ...],
+    params: PolicyParams,
+    owner: Optional[model.Policy] = None,
+) -> None:
+    """Undefined variable/constant references inside a rule expression."""
+    if ce is None:
+        return
+    var_names = {v.name for v in params.ordered_variables}
+    for missing in sorted(_variable_refs(ce.node) - var_names):
+        ctx.err(
+            "undefined variable", f"Undefined variable '{missing}'",
+            segs, anchor="key", pol=owner,
+        )
+    for missing in sorted(_constant_refs(ce.node) - set(params.constants.keys())):
+        ctx.err(
+            "undefined constant", f"Undefined constant '{missing}'",
+            segs, anchor="key", pol=owner,
+        )
+
+
+def _check_condition_refs(
+    cc: Optional[CompiledCondition],
+    ctx: _Ctx,
+    segs: tuple[Seg, ...],
+    params: PolicyParams,
+    owner: Optional[model.Policy] = None,
+) -> None:
+    if cc is None:
+        return
+    if cc.kind == "expr":
+        _check_expr_refs(cc.expr, ctx, segs + ("match", "expr"), params, owner)
+        return
+    # nested blocks: check every leaf at its own path
+    def walk(c: CompiledCondition, s: tuple[Seg, ...]) -> None:
+        if c.kind == "expr":
+            _check_expr_refs(c.expr, ctx, s + ("expr",), params, owner)
+            return
+        for j, child in enumerate(c.children):
+            walk(child, s + (c.kind, "of", j))
+
+    walk(cc, segs + ("match",))
+
+
+def _check_output_refs(
+    co: Optional[CompiledOutput], ctx: _Ctx, segs: tuple[Seg, ...], params: PolicyParams
+) -> None:
+    if co is None:
+        return
+    _check_expr_refs(co.rule_activated, ctx, segs + ("when", "ruleActivated"), params)
+    _check_expr_refs(co.condition_not_met, ctx, segs + ("when", "conditionNotMet"), params)
+
+
+def _compile_ancestors(
+    scope: str,
+    ctx: _Ctx,
+    fqn_fn: Callable[[str], str],
+    compile_fn: Callable[[model.Policy, "_Ctx"], Any],
+) -> None:
+    """Scoped policies pull their whole ancestor chain into the compilation
+    (compile.go:167-175): the first MISSING ancestor reports every missing
+    one and stops; the first FAILING ancestor's errors join this unit's and
+    stop further ancestor processing. Results are memoized across a set
+    compile so deep scope chains stay linear."""
+    scope = namer.scope_value(scope)
+    if not scope:
+        return
+    parts = scope.split(".")
+    chain = [fqn_fn(".".join(parts[:end])) for end in range(len(parts) - 1, -1, -1)]
+    memo: dict[str, list[CompileErrorDetail]] = ctx.shared.setdefault("ancestor_results", {})
+    for fqn in chain:
+        anc = ctx.repo.get(fqn)
+        if anc is None:
+            for f2 in chain:
+                if f2 not in ctx.repo:
+                    ctx.err(
+                        "missing policy definition",
+                        f'Missing ancestor policy "{namer.policy_key_from_fqn(f2)}"',
+                    )
+            return
+        cached = memo.get(fqn)
+        if cached is None:
+            anc_ctx = _Ctx(ctx.repo, anc, shared=ctx.shared)
+            compile_fn(anc, anc_ctx)
+            cached = anc_ctx.details
+            memo[fqn] = cached
+        if cached:
+            ctx.details.extend(cached)
+            return
+
+
+SchemaChecker = Callable[[str], Optional[tuple[str, str]]]
+"""ref -> None when loadable, else (kind, detail): kind 'missing' with the
+store-relative path, or 'invalid' with the compilation error text."""
+
+
+def _check_schemas(rp: model.ResourcePolicy, ctx: _Ctx, schema_check: Optional[SchemaChecker]) -> None:
+    if rp.schemas is None or schema_check is None:
+        return
+    for side, attr in (("principal", "principal_schema"), ("resource", "resource_schema")):
+        sref = getattr(rp.schemas, attr)
+        if sref is None or not sref.ref:
+            continue
+        problem = schema_check(sref.ref)
+        if problem is None:
+            continue
+        kind, detail = problem
+        if kind == "missing":
+            desc = f'Failed to load {side} schema "{sref.ref}": schema {detail} doesn\'t exist'
+        else:
+            desc = f'Failed to load {side} schema "{sref.ref}": {detail}'
+        ctx.err(
+            "invalid schema", desc,
+            ("resourcePolicy", "schemas", f"{side}Schema", "ref"),
+        )
 
 
 def _rule_name(name: str, idx: int) -> str:
     return name or f"rule-{idx:03d}"
 
 
-def _compile_resource_policy(pol: model.Policy, ctx: _Ctx) -> CompiledResourcePolicy:
+def _compile_resource_policy(
+    pol: model.Policy,
+    ctx: _Ctx,
+    schema_check: Optional[SchemaChecker] = None,
+    walk_ancestors: bool = True,
+) -> CompiledResourcePolicy:
     rp = pol.resource_policy
     assert rp is not None
     scope = namer.scope_value(rp.scope)
-    params = _params(rp.variables, rp.constants, pol.variables, ctx)
+    base: tuple[Seg, ...] = ("resourcePolicy",)
+    params = _params(rp.variables, rp.constants, pol.variables, ctx, base)
+    if walk_ancestors:
+        _compile_ancestors(
+            scope, ctx,
+            lambda s: namer.resource_policy_fqn(rp.resource, rp.version, s),
+            lambda p, c: _compile_resource_policy(p, c, schema_check, walk_ancestors=False),
+        )
+    _check_schemas(rp, ctx, schema_check)
 
     # derived roles: collect all imported definitions, then keep only the ones
     # referenced by a rule (ref: compile/compile.go:247-327
     # compileImportedDerivedRoles — unreferenced roles are pruned, a name
     # defined in more than one import is ambiguous only if referenced)
-    role_imports: dict[str, list[CompiledDerivedRole]] = {}
-    for imp in rp.import_derived_roles:
+    role_imports: dict[str, list[tuple[str, int, model.Policy, CompiledDerivedRole]]] = {}
+    for i, imp in enumerate(rp.import_derived_roles):
         fqn = namer.derived_roles_fqn(imp)
         dr_pol = ctx.repo.get(fqn)
         if dr_pol is None or dr_pol.derived_roles is None:
-            ctx.err(f"imported derived roles {imp!r} ({fqn}) not found")
+            ctx.err(
+                "import not found", f'Derived roles import "{imp}" cannot be found',
+                base + ("importDerivedRoles", i),
+            )
             continue
         dr = dr_pol.derived_roles
-        dr_params = _params(dr.variables, dr.constants, dr_pol.variables, ctx)
-        for d in dr.definitions:
+        dr_ctx = _Ctx(ctx.repo, dr_pol, shared=ctx.shared)
+        dr_params = _params(dr.variables, dr.constants, dr_pol.variables, dr_ctx, ("derivedRoles",))
+        for j, d in enumerate(dr.definitions):
+            cond_segs: tuple[Seg, ...] = ("derivedRoles", "definitions", j, "condition")
+            cond = _compile_condition(d.condition, dr_ctx, cond_segs, owner=dr_pol)
+            _check_condition_refs(cond, dr_ctx, cond_segs, dr_params, owner=dr_pol)
             role_imports.setdefault(d.name, []).append(
-                CompiledDerivedRole(
-                    name=d.name,
-                    parent_roles=frozenset(d.parent_roles),
-                    condition=_compile_condition(d.condition, ctx, f"derived role {d.name}"),
-                    params=dr_params,
-                    origin_fqn=fqn,
+                (
+                    imp, i, dr_pol,
+                    CompiledDerivedRole(
+                        name=d.name,
+                        parent_roles=frozenset(d.parent_roles),
+                        condition=cond,
+                        params=dr_params,
+                        origin_fqn=fqn,
+                    ),
                 )
             )
+        ctx.details.extend(dr_ctx.details)
 
     derived_roles: dict[str, CompiledDerivedRole] = {}
+    # referenced derived-role names, LAST reference position winning — the
+    # reference reports each unknown/ambiguous name once, at its final use
+    # (compile.go compileImportedDerivedRoles map semantics)
+    dr_refs: dict[str, tuple[Seg, ...]] = {}
     rules = []
     for i, r in enumerate(rp.rules, start=1):
-        for dr_name in r.derived_roles:
+        rule_segs: tuple[Seg, ...] = base + ("rules", i - 1)
+        if not r.roles and not r.derived_roles:
+            ctx.err(
+                "invalid resource rule",
+                f"Rule '{_rule_name(r.name, i)}' does not specify any roles or "
+                "derived roles to be matched",
+                rule_segs, anchor="val",
+            )
+        for j, dr_name in enumerate(r.derived_roles):
+            dr_refs[dr_name] = rule_segs + ("derivedRoles", j)
             imps = role_imports.get(dr_name)
-            if imps is None:
-                ctx.err(f"derived role {dr_name!r} is not defined in any imports")
-            elif len(imps) > 1:
-                ctx.err(f"derived role {dr_name!r} is defined in more than one import")
-            else:
-                derived_roles[dr_name] = imps[0]
+            if imps is not None and len(imps) == 1:
+                derived_roles[dr_name] = imps[0][3]
+        cond = _compile_condition(r.condition, ctx, rule_segs + ("condition",))
+        _check_condition_refs(cond, ctx, rule_segs + ("condition",), params)
+        out = _compile_output(r.output, ctx, rule_segs + ("output",))
+        _check_output_refs(out, ctx, rule_segs + ("output",), params)
         rules.append(
             CompiledResourceRule(
                 actions=tuple(r.actions),
@@ -362,10 +892,29 @@ def _compile_resource_policy(pol: model.Policy, ctx: _Ctx) -> CompiledResourcePo
                 derived_roles=tuple(d for d in r.derived_roles if d in role_imports),
                 effect=r.effect,
                 name=_rule_name(r.name, i),
-                condition=_compile_condition(r.condition, ctx, f"rule {_rule_name(r.name, i)}"),
-                output=_compile_output(r.output, ctx, f"rule {_rule_name(r.name, i)}"),
+                condition=cond,
+                output=out,
             )
         )
+
+    for dr_name, ref_segs in dr_refs.items():
+        imps = role_imports.get(dr_name)
+        if imps is None:
+            ctx.err(
+                "unknown derived role",
+                f'Derived role "{dr_name}" is not defined in any imports',
+                ref_segs,
+            )
+        elif len(imps) > 1:
+            origins = []
+            for imp, imp_idx, dr_pol, _cdr in imps:
+                line, col = ctx.pos_of(ctx.pol, base + ("importDerivedRoles", imp_idx), "key")
+                origins.append(f'{_file_of(dr_pol)} (imported as "{imp}" at {line}:{col})')
+            ctx.err(
+                "ambiguous derived role",
+                f'Derived role "{dr_name}" is defined in more than one import: '
+                + ", ".join(origins),
+            )
 
     meta = pol.metadata or model.Metadata()
     return CompiledResourcePolicy(
@@ -384,24 +933,38 @@ def _compile_resource_policy(pol: model.Policy, ctx: _Ctx) -> CompiledResourcePo
     )
 
 
-def _compile_principal_policy(pol: model.Policy, ctx: _Ctx) -> CompiledPrincipalPolicy:
+def _compile_principal_policy(
+    pol: model.Policy, ctx: _Ctx, walk_ancestors: bool = True
+) -> CompiledPrincipalPolicy:
     pp = pol.principal_policy
     assert pp is not None
-    params = _params(pp.variables, pp.constants, pol.variables, ctx)
+    base: tuple[Seg, ...] = ("principalPolicy",)
+    params = _params(pp.variables, pp.constants, pol.variables, ctx, base)
+    if walk_ancestors:
+        _compile_ancestors(
+            pp.scope, ctx,
+            lambda s: namer.principal_policy_fqn(pp.principal, pp.version, s),
+            lambda p, c: _compile_principal_policy(p, c, walk_ancestors=False),
+        )
     rules: list[CompiledPrincipalRule] = []
     idx = 0
-    for r in pp.rules:
-        for a in r.actions:
+    for ri, r in enumerate(pp.rules):
+        for ai, a in enumerate(r.actions):
             idx += 1
             name = _rule_name(a.name, idx)
+            act_segs: tuple[Seg, ...] = base + ("rules", ri, "actions", ai)
+            cond = _compile_condition(a.condition, ctx, act_segs + ("condition",))
+            _check_condition_refs(cond, ctx, act_segs + ("condition",), params)
+            out = _compile_output(a.output, ctx, act_segs + ("output",))
+            _check_output_refs(out, ctx, act_segs + ("output",), params)
             rules.append(
                 CompiledPrincipalRule(
                     resource=r.resource,
                     action=a.action,
                     effect=a.effect,
                     name=name,
-                    condition=_compile_condition(a.condition, ctx, f"rule {name}"),
-                    output=_compile_output(a.output, ctx, f"rule {name}"),
+                    condition=cond,
+                    output=out,
                 )
             )
     meta = pol.metadata or model.Metadata()
@@ -421,16 +984,22 @@ def _compile_principal_policy(pol: model.Policy, ctx: _Ctx) -> CompiledPrincipal
 def _compile_role_policy(pol: model.Policy, ctx: _Ctx) -> CompiledRolePolicy:
     rp = pol.role_policy
     assert rp is not None
-    params = _params(rp.variables, rp.constants, pol.variables, ctx)
+    base: tuple[Seg, ...] = ("rolePolicy",)
+    params = _params(rp.variables, rp.constants, pol.variables, ctx, base)
     rules = []
     for i, r in enumerate(rp.rules):
+        rule_segs: tuple[Seg, ...] = base + ("rules", i)
+        cond = _compile_condition(r.condition, ctx, rule_segs + ("condition",))
+        _check_condition_refs(cond, ctx, rule_segs + ("condition",), params)
+        out = _compile_output(r.output, ctx, rule_segs + ("output",))
+        _check_output_refs(out, ctx, rule_segs + ("output",), params)
         rules.append(
             CompiledRoleRule(
                 resource=r.resource,
                 allow_actions=frozenset(r.allow_actions),
                 name=r.name or f"{rp.role}_rule-{i:03d}",
-                condition=_compile_condition(r.condition, ctx, f"role rule {i}"),
-                output=_compile_output(r.output, ctx, f"role rule {i}"),
+                condition=cond,
+                output=out,
             )
         )
     meta = pol.metadata or model.Metadata()
@@ -447,39 +1016,64 @@ def _compile_role_policy(pol: model.Policy, ctx: _Ctx) -> CompiledRolePolicy:
     )
 
 
-def compile_policy(pol: model.Policy, repo: dict[str, model.Policy]) -> CompiledPolicy:
+def compile_policy(
+    pol: model.Policy,
+    repo: dict[str, model.Policy],
+    schema_check: Optional[SchemaChecker] = None,
+    _shared: Optional[dict] = None,
+) -> CompiledPolicy:
     """Compile a single policy against a repo of policies (for imports)."""
-    source = (pol.metadata.source_file if pol.metadata else "") or pol.fqn()
-    ctx = _Ctx(repo, source)
+    ctx = _Ctx(repo, pol, shared=_shared)
     kind = pol.kind
     result: Optional[CompiledPolicy] = None
     if kind == model.KIND_RESOURCE:
-        result = _compile_resource_policy(pol, ctx)
+        result = _compile_resource_policy(pol, ctx, schema_check)
     elif kind == model.KIND_PRINCIPAL:
         result = _compile_principal_policy(pol, ctx)
     elif kind == model.KIND_ROLE_POLICY:
         result = _compile_role_policy(pol, ctx)
     else:
-        raise CompileError([f"{source}: policy kind {kind} is not directly compilable"])
-    if ctx.errors:
-        raise CompileError(ctx.errors)
+        raise CompileError([
+            CompileErrorDetail(
+                file=ctx.source, error="invalid policy",
+                description=f"policy kind {kind} is not directly compilable",
+            )
+        ])
+    if ctx.details:
+        raise CompileError(ctx.details)
     return result
 
 
-def compile_policy_set(policies: list[model.Policy]) -> list[CompiledPolicy]:
+def compile_policy_set(
+    policies: list[model.Policy],
+    schema_check: Optional[SchemaChecker] = None,
+) -> list[CompiledPolicy]:
     """Compile all directly-runnable policies in the set; derived-roles and
     export policies act as imports only. Disabled policies are skipped."""
     repo = {p.fqn(): p for p in policies if not p.disabled}
     out: list[CompiledPolicy] = []
-    errors: list[str] = []
+    details: list[CompileErrorDetail] = []
+    shared: dict = {}
     for p in policies:
         if p.disabled:
             continue
         if p.kind in (model.KIND_RESOURCE, model.KIND_PRINCIPAL, model.KIND_ROLE_POLICY):
             try:
-                out.append(compile_policy(p, repo))
+                out.append(compile_policy(p, repo, schema_check, _shared=shared))
             except CompileError as e:
-                errors.extend(e.errors)
-    if errors:
-        raise CompileError(errors)
+                details.extend(
+                    e.details
+                    or [CompileErrorDetail(file="", error="compile error", description=m) for m in e.errors]
+                )
+    if details:
+        # dedupe identical errors produced once per importing policy (e.g.
+        # invalid identifiers in a shared export file)
+        seen: set[tuple] = set()
+        unique: list[CompileErrorDetail] = []
+        for d in details:
+            k = (d.file, d.error, d.description, d.line, d.column, d.path)
+            if k not in seen:
+                seen.add(k)
+                unique.append(d)
+        raise CompileError(unique)
     return out

@@ -203,6 +203,11 @@ class Policy:
     role_policy: Optional[RolePolicy] = None
     # deprecated top-level variables map (policy.proto:52)
     variables: dict[str, str] = field(default_factory=dict)
+    # provenance: set by the parser for compile-error attribution
+    source_file: str = field(default="", compare=False)
+    # path -> (line, column) anchors from the strict parser (keys vs values)
+    key_positions: dict = field(default_factory=dict, repr=False, compare=False)
+    val_positions: dict = field(default_factory=dict, repr=False, compare=False)
 
     @property
     def kind(self) -> str:

@@ -95,8 +95,8 @@ def _parse_output(v: Any, path: str) -> model.Output:
             rule_activated=w.get("ruleActivated"),
             condition_not_met=w.get("conditionNotMet"),
         )
-    if out.expr is None and out.when is None:
-        raise ParseError("output must have `expr` or `when`", path)
+    # an output with no expressions is a COMPILE error ("empty output",
+    # compile corpus invalid_output.yaml), not a parse error
     return out
 
 
@@ -169,8 +169,8 @@ def _parse_resource_rule(v: Any, path: str) -> model.ResourceRule:
         raise ParseError("rule must define at least one action", f"{path}.actions")
     roles = _expect_str_list(m.get("roles", []), f"{path}.roles")
     derived_roles = _expect_str_list(m.get("derivedRoles", []), f"{path}.derivedRoles")
-    if not roles and not derived_roles:
-        raise ParseError("rule must define roles or derivedRoles", path)
+    # a rule with neither roles nor derivedRoles is a COMPILE error
+    # ("invalid resource rule", compile corpus rule_with_no_roles.yaml)
     rule = model.ResourceRule(
         actions=actions,
         effect=_parse_effect(m.get("effect"), f"{path}.effect"),
@@ -391,15 +391,37 @@ def _load_docs(stream, source: str) -> list:
         raise ParseError(f"invalid YAML: {e}", source=source) from None
 
 
+def _strict_docs(text: str, source: str):
+    """Strict position-aware parse (protoyaml, gated on the parser corpus):
+    returns the per-document (message, key_positions, val_positions)."""
+    from . import protoschema as S
+    from .protoyaml import unmarshal
+
+    res = unmarshal(text, S.POLICY)
+    if res.errors:
+        raise ParseError(res.render_errors(), source=source)
+    return [(d.message, d.key_positions, d.val_positions) for d in res.docs]
+
+
 def parse_policies(text: str, source: str = "") -> Iterator[model.Policy]:
     """Parse one or more YAML documents into policies."""
-    for doc in _load_docs(io.StringIO(text), source):
-        yield parse_policy(doc, source=source)
+    for doc, key_pos, val_pos in _strict_docs(text, source):
+        pol = parse_policy(doc, source=source)
+        pol.source_file = source
+        pol.key_positions = key_pos
+        pol.val_positions = val_pos
+        yield pol
 
 
 def parse_policy_file(path: str) -> model.Policy:
     with open(path, encoding="utf-8") as f:
-        docs = _load_docs(f, path)
+        text = f.read()
+    docs = _strict_docs(text, path)
     if len(docs) != 1:
         raise ParseError(f"expected exactly one policy document, found {len(docs)}", source=path)
-    return parse_policy(docs[0], source=path)
+    doc, key_pos, val_pos = docs[0]
+    pol = parse_policy(doc, source=path)
+    pol.source_file = path
+    pol.key_positions = key_pos
+    pol.val_positions = val_pos
+    return pol

@@ -34,15 +34,31 @@ KIND_PARSE = "KIND_PARSE_ERROR"
 KIND_VALIDATION = "KIND_VALIDATION_ERROR"
 
 
+# libyaml's C scanner/parser is ~6x faster; PyYAML keeps the Composer,
+# Resolver and Constructor in Python either way, so the resolver tweak and
+# the compose_document override below work on both bases. YAML-level errors
+# re-parse through the pure-Python loader because the goccy-style error
+# mapping keys off the Python scanner's message strings.
+_CBase = getattr(yaml, "CSafeLoader", yaml.SafeLoader)
+
+
 class _ValueLoader(yaml.SafeLoader):
     """SafeLoader minus timestamp resolution: protojson keeps RFC3339 strings
     as strings inside google.protobuf.Value fields."""
 
 
-_ValueLoader.yaml_implicit_resolvers = {
+_NO_TS_RESOLVERS = {
     k: [(tag, rx) for tag, rx in v if tag != "tag:yaml.org,2002:timestamp"]
     for k, v in yaml.SafeLoader.yaml_implicit_resolvers.items()
 }
+_ValueLoader.yaml_implicit_resolvers = _NO_TS_RESOLVERS
+
+
+class _CValueLoader(_CBase):
+    pass
+
+
+_CValueLoader.yaml_implicit_resolvers = _NO_TS_RESOLVERS
 
 
 class _StreamLoader(_ValueLoader):
@@ -54,6 +70,14 @@ class _StreamLoader(_ValueLoader):
         node = self.compose_node(None, None)
         self.get_event()  # DocumentEndEvent
         # deliberately do NOT clear self.anchors
+        return node
+
+
+class _CStreamLoader(_CValueLoader):
+    def compose_document(self):
+        self.get_event()
+        node = self.compose_node(None, None)
+        self.get_event()
         return node
 
 
@@ -85,6 +109,13 @@ class SrcError:
 class DocResult:
     message: dict
     errors: list[SrcError] = dc_field(default_factory=list)
+    # path -> (line, column): protovalidate-style anchors (named fields at
+    # their key, map entries at their value, list items at the item)
+    positions: dict = dc_field(default_factory=dict)
+    # explicit anchors for consumers that need the other side (the compiler
+    # anchors expressions at values and identifier names at keys)
+    key_positions: dict = dc_field(default_factory=dict)
+    val_positions: dict = dc_field(default_factory=dict)
 
 
 @dataclass
@@ -138,9 +169,10 @@ def _type_error_pos(node) -> tuple[int, int]:
 
 
 def _is_null(node) -> bool:
+    # plain style is None under the Python composer, "" under the C one
     return isinstance(node, yaml.ScalarNode) and (
         node.tag == "tag:yaml.org,2002:null"
-        or (node.style is None and node.value in ("", "~", "null", "Null", "NULL"))
+        or (not node.style and node.value in ("", "~", "null", "Null", "NULL"))
     )
 
 
@@ -223,10 +255,28 @@ def _map_yaml_error(e: yaml.MarkedYAMLError, text: str) -> list[SrcError]:
     return [SrcError(KIND_PARSE, problem or "invalid YAML document", line, col)]
 
 
+_MEMBER_ONEOF_CACHE: dict[int, dict] = {}
+
+
+def _member_oneof_map(schema: S.Msg) -> dict:
+    """json-name -> oneof-name for the schema's oneof members (per-schema)."""
+    cached = _MEMBER_ONEOF_CACHE.get(id(schema))
+    if cached is None:
+        cached = {
+            schema.fields[m].json_name or S._camel(m): oname
+            for oname, members, _req in schema.oneofs
+            for m in members
+        }
+        _MEMBER_ONEOF_CACHE[id(schema)] = cached
+    return cached
+
+
 class _Walker:
     def __init__(self):
         self.loader = _ValueLoader("")
         self.pos: dict[str, tuple[int, int]] = {}
+        self.key_pos: dict[str, tuple[int, int]] = {}
+        self.val_pos: dict[str, tuple[int, int]] = {}
 
     def construct(self, node) -> Any:
         """Construct a plain-Python value (google.protobuf.Value field)."""
@@ -266,11 +316,7 @@ class _Walker:
             )
         out: dict[str, Any] = {}
         oneof_seen: dict[str, str] = {}  # oneof name -> first member set
-        member_oneof = {
-            schema.fields[m].json_name or S._camel(m): oname
-            for oname, members, _req in schema.oneofs
-            for m in members
-        }
+        member_oneof = _member_oneof_map(schema)
         for key_node, value_node in self.pairs(node):
             if not isinstance(key_node, yaml.ScalarNode):
                 line, col = _mark(key_node)
@@ -282,6 +328,9 @@ class _Walker:
                 line, col = _mark(key_node)
                 raise _DocAbort(SrcError(KIND_PARSE, f'unknown field "{key}"', line, col, kpath))
             jname, fspec = hit
+            jpath = f"{path}.{jname}" if path else f"$.{jname}"
+            self.key_pos[jpath] = _mark(key_node)
+            self.val_pos[jpath] = _type_error_pos(value_node)
             oname = member_oneof.get(jname)
             if oname is not None and not _is_null(value_node):
                 first = oneof_seen.get(oname)
@@ -295,7 +344,6 @@ class _Walker:
                         )
                     )
                 oneof_seen[oname] = jname
-            jpath = f"{path}.{jname}" if path else f"$.{jname}"
             self.pos[jpath] = _mark(key_node)
             try:
                 val = self.walk_field(value_node, fspec, jpath)
@@ -308,7 +356,7 @@ class _Walker:
         return out
 
     def walk_field(self, node, f: S.F, path: str) -> Any:
-        if _is_null(node) and not (f.kind == S.STR and node.style is not None):
+        if _is_null(node) and not (f.kind == S.STR and bool(node.style)):
             return None
         if f.map_of:
             return self.walk_map(node, f, path)
@@ -328,6 +376,8 @@ class _Walker:
             ipath = f"{path}[{i}]"
             # goccy anchors mapping items at their first key's colon
             self.pos[ipath] = _type_error_pos(item)
+            self.key_pos[ipath] = _mark(item)
+            self.val_pos[ipath] = _type_error_pos(item)
             out.append(self.walk_single(item, f, ipath))
         return out
 
@@ -344,6 +394,8 @@ class _Walker:
             # and anchors the entry at its VALUE node (verify corpus 014/026)
             kpath = f'{path}["{S._camel(key)}"]'
             self.pos[kpath] = _type_error_pos(value_node)
+            self.key_pos[kpath] = _mark(key_node)
+            self.val_pos[kpath] = _type_error_pos(value_node)
             out[key] = self.walk_single(value_node, f, kpath)
         return out
 
@@ -692,10 +744,15 @@ def unmarshal(data: Any, schema: S.Msg) -> UnmarshalResult:
     errors: list[SrcError] = []
 
     try:
-        nodes = list(yaml.compose_all(text, Loader=_StreamLoader))
-    except yaml.MarkedYAMLError as e:
-        errs = _map_yaml_error(e, text)
-        return UnmarshalResult([], errs)
+        nodes = list(yaml.compose_all(text, Loader=_CStreamLoader))
+    except yaml.MarkedYAMLError:
+        # re-scan with the pure-Python loader: the goccy-style error mapping
+        # keys off its context/problem strings
+        try:
+            nodes = list(yaml.compose_all(text, Loader=_StreamLoader))
+        except yaml.MarkedYAMLError as e:
+            errs = _map_yaml_error(e, text)
+            return UnmarshalResult([], errs)
 
     for node in nodes:
         if node is None:
@@ -717,7 +774,7 @@ def unmarshal(data: Any, schema: S.Msg) -> UnmarshalResult:
         else:
             doc_errors.extend(validate(msg, schema, w.pos))
         stripped = strip_defaults(msg, schema)
-        docs.append(DocResult(stripped, doc_errors))
+        docs.append(DocResult(stripped, doc_errors, w.pos, w.key_pos, w.val_pos))
         errors.extend(doc_errors)
 
     return UnmarshalResult(docs, errors)

@@ -131,5 +131,7 @@ class PlaygroundService:
                     {"playgroundId": pid, "failure": {"errors": [{"file": "", "error": str(x)} for x in errors]}}
                 )
         if results is None:
-            return web.json_response({"playgroundId": pid, "success": {"results": []}})
-        return web.json_response({"playgroundId": pid, "success": results.to_json()})
+            return web.json_response({"playgroundId": pid, "success": {"results": {}}})
+        # wire shape: PlaygroundTestResponse.success.results is a
+        # cerbos.policy.v1.TestResults (response.proto:306-318)
+        return web.json_response({"playgroundId": pid, "success": {"results": results.to_json()}})

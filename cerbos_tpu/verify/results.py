@@ -367,9 +367,12 @@ class _SuiteRun:
             )
 
             actions = expectation.get("actions", {})
+            extra = sorted(a for a in actions if a not in input_actions)
             for principal in principals:
                 for resource in resources:
-                    extra = sorted(a for a in actions if a not in input_actions)
+                    # checked inside the matrix loop like the reference
+                    # (test_matrix.go:105-115): no principals/resources means
+                    # no error — but the set is computed once
                     if extra:
                         raise VerifyError(
                             "found expectations for actions that do not exist in the input "
@@ -594,8 +597,20 @@ def _run_test(engine, test: _Test, actions: list[str], trace: bool) -> dict[str,
     )
     err: Optional[str] = None
     actual: list[T.CheckOutput] = []
+    traces: Optional[dict] = None
     try:
         actual = engine.check([inp], params=params)
+        if trace:
+            # engine trace batch for --verbose runs (performCheck's
+            # WithTraceSink analogue): policy→rule→condition trees
+            from ..tracer import traced_check
+
+            _, recorder = traced_check(
+                engine.rule_table, inp, params, getattr(engine, "schema_mgr", None)
+            )
+            collected = recorder.to_json()
+            if collected:
+                traces = {"traces": collected}
     except Exception as e:  # engine-level failure -> per-action error
         err = str(e)
     if err is None and used_default_now:
@@ -604,11 +619,11 @@ def _run_test(engine, test: _Test, actions: list[str], trace: bool) -> dict[str,
     if err is not None:
         for action in actions:
             results[action] = {"result": R_ERRORED, "error": err}
-        return results
+        return _attach_traces(results, traces)
     if not actual:
         for action in actions:
             results[action] = {"result": R_ERRORED, "error": "Empty response from server"}
-        return results
+        return _attach_traces(results, traces)
 
     out = actual[0]
     for action in actions:
@@ -659,6 +674,13 @@ def _run_test(engine, test: _Test, actions: list[str], trace: bool) -> dict[str,
             success["outputs"] = [_output_entry_dict(o) for o in outputs]
         details["success"] = success
         results[action] = details
+    return _attach_traces(results, traces)
+
+
+def _attach_traces(results: dict[str, dict], traces: Optional[dict]) -> dict[str, dict]:
+    if traces:
+        for details in results.values():
+            details["engineTraceBatch"] = traces
     return results
 
 
@@ -863,6 +885,8 @@ def _render_results(results: dict) -> dict:
                 out[oneof] = d[oneof]
         if "skipReason" in d:
             out["skipReason"] = d["skipReason"]
+        if "engineTraceBatch" in d:
+            out["engineTraceBatch"] = d["engineTraceBatch"]
         return out
 
     def render_suite(s: dict) -> dict:

@@ -68,6 +68,14 @@ class SuiteResults:
                                         lines.append(f"    output {o.get('src', '')!r} unsatisfied")
                                 if d.get("error"):
                                     lines.append(f"    {d['error']}")
+                                for t in d.get("engineTraceBatch", {}).get("traces", []):
+                                    comps = " > ".join(
+                                        c.get("id", "") for c in t.get("components", [])
+                                    )
+                                    ev = t.get("event", {})
+                                    detail = ev.get("effect") or ev.get("status") or ""
+                                    msg = ev.get("message", "")
+                                    lines.append(f"      trace: {comps}: {detail} {msg}".rstrip())
         status = "FAILED" if self.failed else "OK"
         lines.append(status)
         return "\n".join(lines)

@@ -339,6 +339,12 @@ apiVersion: api.cerbos.dev/v1
 resourcePolicy:
   resource: doc
   version: default
+  rules: []
+---
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: default
   scope: acme
   rules:
     - actions: ["view", "edit", "delete", "share"]
@@ -479,6 +485,12 @@ def test_delete_role_policy_removes_parent_inheritance():
 
 class TestDefaultVersionAndScopeParams:
     POLICIES = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: gadget
+  version: beta
+  rules: []
+---
 apiVersion: api.cerbos.dev/v1
 resourcePolicy:
   resource: gadget

@@ -42,9 +42,11 @@ def _id(case_tuple):
 @pytest.mark.parametrize("case_tuple", CASES, ids=_id)
 def test_cel_eval(case_tuple):
     name, case = case_tuple
-    ctx = _Ctx({}, name)
-    cond = _compile_match(parse_match(case["condition"]), ctx, "condition")
-    assert not ctx.errors, ctx.errors
+    dummy = model.Policy()
+    dummy.source_file = name
+    ctx = _Ctx({}, dummy)
+    cond = _compile_match(parse_match(case["condition"]), ctx, ("condition",))
+    assert not ctx.details, [d.render() for d in ctx.details]
 
     inp = parse_input(case["request"])
     request, principal, resource = build_request_messages(inp)

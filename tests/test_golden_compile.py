@@ -1,0 +1,196 @@
+"""Reference compile corpus: structured compile errors with positions.
+
+Mirrors internal/compile/compile_test.go TestCompile: each case_NNN.yaml is a
+CompileTestCase descriptor (mainDef, wantErrors, wantVariables), the .input
+is a txtar archive of the compilation unit. Errors compare on (file, error,
+position{line, column, path}) plus exact description text — except CEL and
+JSON-schema diagnostics, whose bracketed tool output differs from cel-go /
+santhosh-tekuri byte-wise (compared by prefix; recorded in
+tests/golden/UNSUPPORTED.md).
+
+Golden-ok cases assert clean compilation; where the descriptor carries
+wantVariables, the per-scope USED constant/variable sets (and per derived
+role) are compared against the reference's.
+"""
+
+import json
+import os
+
+import pytest
+import yaml
+
+from cerbos_tpu.compile.compiler import (
+    CompileError,
+    _constant_refs,
+    _variable_refs,
+    compile_policy,
+)
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.storage.disk import DiskStore
+from test_golden_verify import expand_txtar
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden", "compile")
+SCHEMA_FS = os.path.join(os.path.dirname(__file__), "golden", "schema_fs")
+
+CASES = sorted(
+    f for f in os.listdir(CORPUS)
+    if f.endswith(".yaml") and os.path.exists(os.path.join(CORPUS, f + ".input"))
+)
+
+# descriptions whose tails embed third-party diagnostic text: compare prefix
+_PREFIX_KINDS = {"invalid expression"}
+
+
+def _schema_check(ref: str):
+    """Compile-time schema probe over the schema_fs store (mkSchemaMgr)."""
+    store = DiskStore(SCHEMA_FS)
+    schema_id = ref[len("cerbos:///"):] if ref.startswith("cerbos:///") else ref
+    raw = store.get_schema(schema_id)
+    if raw is None:
+        return ("missing", f"_schemas/{schema_id}")
+    try:
+        import jsonschema
+
+        jsonschema.Draft202012Validator.check_schema(json.loads(raw))
+        jsonschema.Draft202012Validator(json.loads(raw))
+    except Exception as e:  # noqa: BLE001
+        return ("invalid", f"jsonschema {ref} compilation failed: {e}")
+    return None
+
+
+def _load_unit(case: str, tmp_path):
+    with open(os.path.join(CORPUS, case + ".input"), encoding="utf-8") as f:
+        expand_txtar(f.read(), str(tmp_path))
+    policies = []
+    for dirpath, _dirs, files in os.walk(tmp_path):
+        for fn in sorted(files):
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, tmp_path)
+            with open(path, encoding="utf-8") as f:
+                for pol in parse_policies(f.read(), source=rel):
+                    policies.append((rel, pol))
+    return policies
+
+
+def _norm_err(e: dict) -> dict:
+    out = {
+        "file": e.get("file", ""),
+        "error": (e.get("error") or "").strip(),
+        "description": (e.get("description") or "").strip(),
+    }
+    pos = e.get("position")
+    if pos:
+        out["position"] = {
+            "line": pos.get("line", 0),
+            "column": pos.get("column", 0),
+            "path": pos.get("path", ""),
+        }
+    return out
+
+
+def _key(e: dict):
+    pos = e.get("position", {})
+    return (e["file"], e["error"], pos.get("line", 0), pos.get("column", 0), pos.get("path", ""))
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_compile_case(case, tmp_path):
+    with open(os.path.join(CORPUS, case), encoding="utf-8") as f:
+        tc = yaml.safe_load(f) or {}
+
+    policies = _load_unit(case, tmp_path)
+    repo = {p.fqn(): p for _rel, p in policies}
+    main = next(p for rel, p in policies if rel == tc["mainDef"])
+
+    want_errors = [_norm_err(w) for w in tc.get("wantErrors") or []]
+    if want_errors:
+        with pytest.raises(CompileError) as exc:
+            compile_policy(main, repo, schema_check=_schema_check)
+        have_errors = [_norm_err(d.to_dict()) for d in exc.value.details]
+
+        def full_key(e):
+            # descriptions embedding third-party diagnostics compare by prefix
+            desc = e["description"]
+            if e["error"] in _PREFIX_KINDS:
+                desc = desc.split("`: ", 1)[0]
+            elif desc.startswith("Failed to load") and (": jsonschema" in desc):
+                desc = desc.split(": jsonschema", 1)[0]
+            return _key(e) + (desc,)
+
+        assert sorted(map(full_key, want_errors)) == sorted(map(full_key, have_errors)), (
+            f"{case}:\nwant={json.dumps(want_errors, indent=1)}\n"
+            f"have={json.dumps(have_errors, indent=1)}"
+        )
+        return
+
+    compiled = compile_policy(main, repo, schema_check=_schema_check)
+
+    want_vars = tc.get("wantVariables") or []
+    if want_vars:
+        # the reference records per-scope USED sets; compile each scope's
+        # policy from the same unit and derive its used sets
+        by_scope = {}
+        for rel, p in policies:
+            if p.kind == main.kind:
+                c = compile_policy(p, repo, schema_check=_schema_check)
+                by_scope[c.scope] = c
+        for want in want_vars:
+            c = by_scope[want.get("scope", "")]
+            used_c, used_v = _used_sets(c)
+            assert sorted(want.get("constants", [])) == sorted(used_c), (case, want.get("scope"))
+            assert sorted(want.get("variables", [])) == sorted(used_v), (case, want.get("scope"))
+            for dr_want in want.get("derivedRoles", []) or []:
+                dr = c.derived_roles[dr_want["name"]]
+                dr_c, dr_v = _used_sets_exprs([dr.condition], dr.params)
+                assert sorted(dr_want.get("constants", [])) == sorted(dr_c), (case, dr_want["name"])
+                assert sorted(dr_want.get("variables", [])) == sorted(dr_v), (case, dr_want["name"])
+
+
+def _exprs_of(cond):
+    if cond is None:
+        return
+    if cond.kind == "expr":
+        if cond.expr is not None:
+            yield cond.expr.node
+        return
+    for c in cond.children:
+        yield from _exprs_of(c)
+
+
+def _used_sets(compiled):
+    nodes = []
+    for r in compiled.rules:
+        nodes.extend(_exprs_of(getattr(r, "condition", None)))
+        out = getattr(r, "output", None)
+        if out is not None:
+            for e in (out.rule_activated, out.condition_not_met):
+                if e is not None:
+                    nodes.append(e.node)
+    return _used_from_nodes(nodes, compiled.params)
+
+
+def _used_sets_exprs(conds, params):
+    nodes = []
+    for c in conds:
+        nodes.extend(_exprs_of(c))
+    return _used_from_nodes(nodes, params)
+
+
+def _used_from_nodes(nodes, params):
+    var_defs = {v.name: v.expr.node for v in params.ordered_variables}
+    used_vars = set()
+    frontier = set()
+    for n in nodes:
+        frontier |= _variable_refs(n) & set(var_defs)
+    while frontier:
+        name = frontier.pop()
+        if name in used_vars:
+            continue
+        used_vars.add(name)
+        frontier |= _variable_refs(var_defs[name]) & set(var_defs)
+    used_consts = set()
+    for n in nodes:
+        used_consts |= _constant_refs(n) & set(params.constants)
+    for name in used_vars:
+        used_consts |= _constant_refs(var_defs[name]) & set(params.constants)
+    return sorted(used_consts), sorted(used_vars)

@@ -106,15 +106,19 @@ def test_parse_errors():
         parse_policy({"apiVersion": "bogus"})
     with pytest.raises(ParseError):
         parse_policy({"apiVersion": "api.cerbos.dev/v1"})  # no policy type
-    with pytest.raises(ParseError):
-        # rule without roles or derivedRoles
-        parse_policy({
-            "apiVersion": "api.cerbos.dev/v1",
-            "resourcePolicy": {
-                "resource": "x", "version": "default",
-                "rules": [{"actions": ["a"], "effect": "EFFECT_ALLOW"}],
-            },
-        })
+    # a rule without roles or derivedRoles PARSES; rejecting it is the
+    # compiler's job ("invalid resource rule", compile corpus)
+    from cerbos_tpu.compile.compiler import CompileError, compile_policy
+
+    pol = parse_policy({
+        "apiVersion": "api.cerbos.dev/v1",
+        "resourcePolicy": {
+            "resource": "x", "version": "default",
+            "rules": [{"actions": ["a"], "effect": "EFFECT_ALLOW"}],
+        },
+    })
+    with pytest.raises(CompileError, match="does not specify any roles"):
+        compile_policy(pol, {})
 
 
 def test_multi_doc():
