@@ -27,6 +27,89 @@ def _b64url(data: str) -> bytes:
     return base64.urlsafe_b64decode(data + "=" * (-len(data) % 4))
 
 
+# JWA signature algorithms the reference accepts on keys (jwx jwa.SignatureAlgorithm)
+_VALID_ALGS = {
+    "RS256", "RS384", "RS512", "PS256", "PS384", "PS512",
+    "ES256", "ES384", "ES512", "ES256K",
+    "HS256", "HS384", "HS512", "EdDSA", "none",
+}
+
+
+@dataclass
+class JWK:
+    """One verification key with its JWK metadata (kid/alg lookup)."""
+
+    key: Any  # cryptography public key or ("hmac", secret)
+    kid: str = ""
+    alg: str = ""
+
+
+def _jwk_from_dict(k: dict) -> Any:
+    kty = k.get("kty")
+    if kty == "RSA":
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        n = int.from_bytes(_b64url(k["n"]), "big")
+        e = int.from_bytes(_b64url(k["e"]), "big")
+        return rsa.RSAPublicNumbers(e, n).public_key()
+    if kty == "EC":
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        curve = {"P-256": ec.SECP256R1(), "P-384": ec.SECP384R1(), "P-521": ec.SECP521R1()}[k["crv"]]
+        x = int.from_bytes(_b64url(k["x"]), "big")
+        y = int.from_bytes(_b64url(k["y"]), "big")
+        return ec.EllipticCurvePublicNumbers(x, y, curve).public_key()
+    if kty == "oct":
+        return ("hmac", _b64url(k["k"]))
+    raise JWTError(f"unsupported key type {kty!r}")
+
+
+def parse_key_material(raw: bytes, pem: bool = False) -> list[JWK]:
+    """Key material → verification keys, with the reference's validation:
+    every JWK needs a non-empty kid and a known alg (jwt.go keyset loading;
+    auxdata corpus error text)."""
+    if pem:
+        from cryptography.hazmat.primitives import serialization
+
+        keys: list[JWK] = []
+        text = raw.decode("utf-8", errors="ignore")
+        blocks = ["-----BEGIN" + b for b in text.split("-----BEGIN")[1:]]
+        if not blocks:
+            raise JWTError("failed to parse PEM key material")
+        for block in blocks:
+            data = block.encode()
+            try:
+                keys.append(JWK(key=serialization.load_pem_public_key(data)))
+            except Exception:  # noqa: BLE001 — maybe a private key or cert
+                try:
+                    priv = serialization.load_pem_private_key(data, password=None)
+                    keys.append(JWK(key=priv.public_key()))
+                except Exception as e:  # noqa: BLE001
+                    raise JWTError(f"failed to parse PEM block: {e}") from None
+        return keys
+
+    try:
+        data = json.loads(raw)
+    except Exception as e:  # noqa: BLE001
+        raise JWTError(f"failed to parse key material: {e}") from None
+    entries = data.get("keys") if isinstance(data, dict) and "keys" in data else [data]
+    if not isinstance(entries, list) or not all(isinstance(k, dict) for k in entries):
+        raise JWTError("failed to parse key material: not a JWK or JWKS document")
+    keys = []
+    for i, k in enumerate(entries):
+        alg = k.get("alg")
+        if alg is not None and alg not in _VALID_ALGS:
+            raise JWTError(f"failed to parse key at idx {i}: invalid algorithm (alg) {alg!r}")
+        if "kid" not in k:
+            raise JWTError(f"failed to validate key at idx {i}: missing key ID (kid)")
+        if k.get("kid") == "":
+            raise JWTError(f"failed to validate key at idx {i}: empty key ID (kid)")
+        if alg is None:
+            raise JWTError(f"failed to validate key at idx {i}: missing algorithm (alg)")
+        keys.append(JWK(key=_jwk_from_dict(k), kid=k["kid"], alg=alg))
+    return keys
+
+
 class RemoteJWKS:
     """JWKS fetched over HTTP(S) with time-based refresh and keep-cached-on-
     failure (ref: jwt.go:40-242 — jwk.Cache with RefreshInterval; a fetch
@@ -94,9 +177,12 @@ class RemoteJWKS:
     def _fetch(self) -> list[Any]:
         import urllib.request
 
-        with urllib.request.urlopen(self.url, timeout=self.timeout) as resp:
-            data = json.loads(resp.read())
-        return _load_jwks(data)
+        try:
+            with urllib.request.urlopen(self.url, timeout=self.timeout) as resp:
+                raw = resp.read()
+            return parse_key_material(raw)
+        except Exception as e:  # noqa: BLE001
+            raise JWTError(f"failed to look up remote keyset: {e}") from None
 
 
 @dataclass
@@ -110,28 +196,6 @@ class KeySet:
         if self.remote is not None:
             return self.remote.keys(force=force_refresh)
         return self.keys
-
-
-def _load_jwks(data: dict) -> list[Any]:
-    keys = []
-    for k in data.get("keys", []):
-        kty = k.get("kty")
-        if kty == "RSA":
-            from cryptography.hazmat.primitives.asymmetric import rsa
-
-            n = int.from_bytes(_b64url(k["n"]), "big")
-            e = int.from_bytes(_b64url(k["e"]), "big")
-            keys.append(rsa.RSAPublicNumbers(e, n).public_key())
-        elif kty == "EC":
-            from cryptography.hazmat.primitives.asymmetric import ec
-
-            curve = {"P-256": ec.SECP256R1(), "P-384": ec.SECP384R1(), "P-521": ec.SECP521R1()}[k["crv"]]
-            x = int.from_bytes(_b64url(k["x"]), "big")
-            y = int.from_bytes(_b64url(k["y"]), "big")
-            keys.append(ec.EllipticCurvePublicNumbers(x, y, curve).public_key())
-        elif kty == "oct":
-            keys.append(("hmac", _b64url(k["k"])))
-    return keys
 
 
 def load_keyset(conf: dict) -> KeySet:
@@ -159,11 +223,9 @@ def load_keyset(conf: dict) -> KeySet:
         raise JWTError(f"keyset {ks.id!r} has neither local key material nor a remote JWKS url")
     text = raw.decode("utf-8", errors="ignore").strip()
     if text.startswith("{"):
-        ks.keys = _load_jwks(json.loads(text))
+        ks.keys = parse_key_material(raw)
     elif "BEGIN" in text:
-        from cryptography.hazmat.primitives import serialization
-
-        ks.keys = [serialization.load_pem_public_key(raw)]
+        ks.keys = parse_key_material(raw, pem=True)
     elif str(conf.get("algorithm", "")).startswith("HS"):
         # raw bytes are a symmetric secret only when the keyset explicitly
         # opts into an HS* algorithm; otherwise a corrupted public-key file
@@ -182,6 +244,8 @@ def _verify_signature(alg: str, key: Any, signing_input: bytes, sig: bytes) -> b
     from cryptography.hazmat.primitives import hashes, hmac as chmac
     from cryptography.hazmat.primitives.asymmetric import ec, padding, rsa, utils as asym_utils
 
+    if isinstance(key, JWK):
+        key = key.key
     hash_alg = {"256": hashes.SHA256(), "384": hashes.SHA384(), "512": hashes.SHA512()}[alg[2:]]
     try:
         if alg.startswith("HS"):
@@ -246,15 +310,32 @@ class AuxDataManager:
             if alg not in ("RS256", "RS384", "RS512", "ES256", "ES384", "HS256", "HS384", "HS512"):
                 raise JWTError(f"unsupported JWT algorithm {alg!r}")
             signing_input = f"{parts[0]}.{parts[1]}".encode("ascii")
+            kid = header.get("kid", "")
+
+            def candidates(keys):
+                # jwx WithKeySet parity: a key with a kid only matches the
+                # token's kid (when the token carries one), and a key with a
+                # declared alg only verifies tokens of that alg
+                out = []
+                for key in keys:
+                    if isinstance(key, JWK):
+                        if kid and key.kid and key.kid != kid:
+                            continue
+                        if key.alg and key.alg != alg:
+                            continue
+                    out.append(key)
+                return out
+
             verified = any(
-                _verify_signature(alg, key, signing_input, sig) for key in ks.current_keys()
+                _verify_signature(alg, key, signing_input, sig)
+                for key in candidates(ks.current_keys())
             )
             if not verified and ks.remote is not None:
                 # the signer may have rotated since the last fetch: refresh
                 # once and retry (jwk.Cache's refresh-on-miss behavior)
                 verified = any(
                     _verify_signature(alg, key, signing_input, sig)
-                    for key in ks.current_keys(force_refresh=True)
+                    for key in candidates(ks.current_keys(force_refresh=True))
                 )
             if not verified:
                 raise JWTError("JWT signature verification failed")

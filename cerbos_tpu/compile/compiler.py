@@ -716,10 +716,7 @@ def _check_condition_refs(
 ) -> None:
     if cc is None:
         return
-    if cc.kind == "expr":
-        _check_expr_refs(cc.expr, ctx, segs + ("match", "expr"), params, owner)
-        return
-    # nested blocks: check every leaf at its own path
+
     def walk(c: CompiledCondition, s: tuple[Seg, ...]) -> None:
         if c.kind == "expr":
             _check_expr_refs(c.expr, ctx, s + ("expr",), params, owner)

@@ -1,147 +1,438 @@
-"""Policy inspection: extract actions, attributes, variables, derived roles.
+"""Policy inspection: actions, attributes, constants, variables, derived roles.
 
-Behavioral reference: internal/inspect — used by the Admin API
+Behavioral reference: internal/inspect/{policy,visit,visitors,attributes}.go
+and internal/policy/policy.go List* helpers — used by the Admin API
 (InspectPolicies) and cerbosctl to answer "what does this policy reference".
+Local definitions carry their own policy as source; referenced-but-undefined
+names resolve through imports (marked KIND_IMPORTED with the exporting
+policy), then an optional policy loader, and finally fall out as
+KIND_UNDEFINED. Gated on the reference's inspect corpus
+(tests/test_golden_inspect.py).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
+from . import namer
 from .cel import ast as A
 from .cel import parse as cel_parse
 from .cel.errors import CelParseError
 from .policy import model
 
-
-@dataclass
-class PolicyInspection:
-    policy_id: str
-    actions: list[str] = field(default_factory=list)
-    roles: list[str] = field(default_factory=list)
-    derived_roles: list[str] = field(default_factory=list)
-    imported_derived_roles: list[str] = field(default_factory=list)
-    principal_attributes: list[str] = field(default_factory=list)
-    resource_attributes: list[str] = field(default_factory=list)
-    variables: list[str] = field(default_factory=list)
-    constants: list[str] = field(default_factory=list)
-
-    def to_json(self) -> dict:
-        return {
-            "policyId": self.policy_id,
-            "actions": self.actions,
-            "roles": self.roles,
-            "derivedRoles": self.derived_roles,
-            "importedDerivedRoles": self.imported_derived_roles,
-            "attributes": (
-                [{"kind": "KIND_PRINCIPAL_ATTRIBUTE", "name": n} for n in self.principal_attributes]
-                + [{"kind": "KIND_RESOURCE_ATTRIBUTE", "name": n} for n in self.resource_attributes]
-            ),
-            "variables": [{"name": n, "kind": "KIND_LOCAL"} for n in self.variables],
-            "constants": [{"name": n, "kind": "KIND_LOCAL"} for n in self.constants],
-        }
+KIND_PRINCIPAL_ATTRIBUTE = "KIND_PRINCIPAL_ATTRIBUTE"
+KIND_RESOURCE_ATTRIBUTE = "KIND_RESOURCE_ATTRIBUTE"
 
 
-def _attrs_from_expr(src: str, principal: set[str], resource: set[str], variables: set[str]) -> None:
+def _visit_expr_strings(pol: model.Policy):
+    """Every condition/output/variable-definition expression in the policy,
+    in the reference's visit order (inspect/visit.go visitPolicy)."""
+    for expr in pol.variables.values():  # deprecated top-level variables
+        yield expr
+
+    def conditions_of(cond: Optional[model.Condition]):
+        if cond is None or cond.match is None:
+            return
+        stack = [cond.match]
+        while stack:
+            m = stack.pop()
+            if m.expr is not None:
+                yield m.expr
+            for group in (m.all, m.any, m.none):
+                if group:
+                    stack.extend(group)
+
+    def outputs_of(out: Optional[model.Output]):
+        if out is None:
+            return
+        if out.expr:
+            yield out.expr
+        if out.when is not None:
+            if out.when.rule_activated:
+                yield out.when.rule_activated
+            if out.when.condition_not_met:
+                yield out.when.condition_not_met
+
+    if pol.derived_roles is not None:
+        dr = pol.derived_roles
+        if dr.variables is not None:
+            yield from dr.variables.local.values()
+        for d in dr.definitions:
+            yield from conditions_of(d.condition)
+    elif pol.export_variables is not None:
+        yield from pol.export_variables.definitions.values()
+    elif pol.principal_policy is not None:
+        pp = pol.principal_policy
+        if pp.variables is not None:
+            yield from pp.variables.local.values()
+        for rule in pp.rules:
+            for action in rule.actions:
+                yield from conditions_of(action.condition)
+                yield from outputs_of(action.output)
+    elif pol.resource_policy is not None:
+        rp = pol.resource_policy
+        if rp.variables is not None:
+            yield from rp.variables.local.values()
+        for rule in rp.rules:
+            yield from conditions_of(rule.condition)
+            yield from outputs_of(rule.output)
+    elif pol.role_policy is not None:
+        rp = pol.role_policy
+        if rp.variables is not None:
+            yield from rp.variables.local.values()
+        for rule in rp.rules:
+            yield from conditions_of(rule.condition)
+            yield from outputs_of(rule.output)
+
+
+def _collect_from_expr(src, attrs: dict, consts: dict, variables: dict) -> None:
+    """AST sweep for P/R attribute selects and C/V references
+    (inspect/visitors.go attribute/constant/variableVisitor)."""
     try:
-        node = cel_parse(src)
+        node = cel_parse(str(src))
     except CelParseError:
         return
     for n in A.walk(node):
-        if isinstance(n, A.Select):
-            op = n.operand
-            if isinstance(op, A.Select) and op.field == "attr":
-                root = op.operand
-                name = None
-                if isinstance(root, A.Ident):
-                    name = root.name
-                elif isinstance(root, A.Select) and isinstance(root.operand, A.Ident) and root.operand.name == "request":
-                    name = {"principal": "P", "resource": "R"}.get(root.field)
-                if name == "P":
-                    principal.add(n.field)
-                elif name == "R":
-                    resource.add(n.field)
-            elif isinstance(op, A.Ident) and op.name in ("V", "variables"):
-                variables.add(n.field)
+        if not isinstance(n, (A.Select, A.Present)):
+            continue
+        op = n.operand
+        field = n.field
+        if isinstance(op, A.Ident):
+            if op.name in ("constants", "C"):
+                consts[field] = True
+            elif op.name in ("variables", "V"):
+                variables[field] = True
+            continue
+        if isinstance(op, (A.Select, A.Present)) and op.field == "attr":
+            root = op.operand
+            root_name = None
+            if isinstance(root, A.Ident):
+                root_name = root.name
+            elif isinstance(root, (A.Select, A.Present)):
+                root_name = root.field
+            if root_name in ("principal", "P"):
+                attrs[("P", field)] = {"name": field, "kind": KIND_PRINCIPAL_ATTRIBUTE}
+            elif root_name in ("resource", "R"):
+                attrs[("R", field)] = {"name": field, "kind": KIND_RESOURCE_ATTRIBUTE}
 
 
-def _walk_condition(cond: Optional[model.Condition], principal: set, resource: set, variables: set) -> None:
-    if cond is None or cond.match is None:
-        return
-
-    def walk_match(m: model.Match) -> None:
-        if m.expr is not None:
-            _attrs_from_expr(m.expr, principal, resource, variables)
-        for children in (m.all, m.any, m.none):
-            if children:
-                for c in children:
-                    walk_match(c)
-
-    walk_match(cond.match)
+def _policy_key(pol: model.Policy) -> str:
+    return namer.policy_key_from_fqn(pol.fqn())
 
 
-def inspect_policy(pol: model.Policy) -> PolicyInspection:
-    from . import namer
-
-    out = PolicyInspection(policy_id=namer.policy_key_from_fqn(pol.fqn()))
-    p_attrs: set[str] = set()
-    r_attrs: set[str] = set()
-    variables: set[str] = set()
-    actions: set[str] = set()
-    roles: set[str] = set()
-    drs: set[str] = set()
-    constants: set[str] = set()
-
-    def handle_variables(v: Optional[model.Variables], c: Optional[model.Constants]) -> None:
-        if v is not None:
-            for name, expr in v.local.items():
-                variables.add(name)
-                _attrs_from_expr(expr, p_attrs, r_attrs, variables)
-        if c is not None:
-            constants.update(c.local.keys())
-
+def _list_actions(pol: model.Policy) -> list[str]:
+    actions: list[str] = []
+    seen: set[str] = set()
     if pol.resource_policy is not None:
-        rp = pol.resource_policy
-        handle_variables(rp.variables, rp.constants)
-        out.imported_derived_roles = sorted(rp.import_derived_roles)
-        for rule in rp.rules:
-            actions.update(rule.actions)
-            roles.update(rule.roles)
-            drs.update(rule.derived_roles)
-            _walk_condition(rule.condition, p_attrs, r_attrs, variables)
+        for r in pol.resource_policy.rules:
+            for a in r.actions:
+                if a not in seen:
+                    seen.add(a)
+                    actions.append(a)
     elif pol.principal_policy is not None:
-        pp = pol.principal_policy
-        handle_variables(pp.variables, pp.constants)
-        for rule in pp.rules:
-            for a in rule.actions:
-                actions.add(a.action)
-                _walk_condition(a.condition, p_attrs, r_attrs, variables)
+        for r in pol.principal_policy.rules:
+            for a in r.actions:
+                if a.action not in seen:
+                    seen.add(a.action)
+                    actions.append(a.action)
     elif pol.role_policy is not None:
-        rp2 = pol.role_policy
-        roles.add(rp2.role)
-        for rule in rp2.rules:
-            actions.update(rule.allow_actions)
-            _walk_condition(rule.condition, p_attrs, r_attrs, variables)
-    elif pol.derived_roles is not None:
-        dr = pol.derived_roles
-        handle_variables(dr.variables, dr.constants)
-        for d in dr.definitions:
-            drs.add(d.name)
-            roles.update(d.parent_roles)
-            _walk_condition(d.condition, p_attrs, r_attrs, variables)
-    elif pol.export_variables is not None:
-        for name, expr in pol.export_variables.definitions.items():
-            variables.add(name)
-            _attrs_from_expr(expr, p_attrs, r_attrs, variables)
-    elif pol.export_constants is not None:
-        constants.update(pol.export_constants.definitions.keys())
+        for r in pol.role_policy.rules:
+            actions.extend(r.allow_actions)
+    return actions
 
-    out.actions = sorted(actions)
-    out.roles = sorted(roles)
-    out.derived_roles = sorted(drs)
-    out.principal_attributes = sorted(p_attrs)
-    out.resource_attributes = sorted(r_attrs)
-    out.variables = sorted(variables)
-    out.constants = sorted(constants)
+
+def _section_of(pol: model.Policy):
+    return (
+        pol.derived_roles
+        or pol.principal_policy
+        or pol.resource_policy
+        or pol.role_policy
+    )
+
+
+def _list_constants(pol: model.Policy) -> dict[str, dict]:
+    key = _policy_key(pol)
+    out: dict[str, dict] = {}
+    if pol.export_constants is not None:
+        for name, value in pol.export_constants.definitions.items():
+            out[name] = {"name": name, "value": value, "kind": "KIND_EXPORTED", "source": key}
+        return out
+    section = _section_of(pol)
+    if section is not None and getattr(section, "constants", None) is not None:
+        for name, value in section.constants.local.items():
+            out[name] = {"name": name, "value": value, "kind": "KIND_LOCAL", "source": key}
     return out
+
+
+def _list_variables(pol: model.Policy) -> dict[str, dict]:
+    key = _policy_key(pol)
+    out: dict[str, dict] = {}
+    if pol.export_variables is not None:
+        for name, value in pol.export_variables.definitions.items():
+            out[name] = {"name": name, "value": value, "kind": "KIND_EXPORTED", "source": key}
+        return out
+    for name, value in pol.variables.items():  # deprecated top-level
+        out[name] = {"name": name, "value": value, "kind": "KIND_LOCAL", "source": key}
+    section = _section_of(pol)
+    if section is not None and getattr(section, "variables", None) is not None:
+        for name, value in section.variables.local.items():
+            out[name] = {"name": name, "value": value, "kind": "KIND_LOCAL", "source": key}
+    return out
+
+
+def _list_exported_derived_roles(pol: model.Policy) -> list[dict]:
+    drp = pol.derived_roles
+    if drp is None:
+        return []
+    key = namer.policy_key_from_fqn(namer.derived_roles_fqn(drp.name))
+    out = []
+    seen: set[str] = set()
+    for d in drp.definitions:
+        if d.name not in seen:
+            seen.add(d.name)
+            out.append({"name": d.name, "kind": "KIND_EXPORTED", "source": key})
+    return out
+
+
+class PolicyInspector:
+    """inspect.Policies(): per-policy inventories with cross-policy import
+    resolution at results() time (inspect/policy.go)."""
+
+    def __init__(self):
+        self._dr_imports: dict[str, list[str]] = {}
+        self._dr_to_resolve: dict[str, dict[str, bool]] = {}
+        self._const_imports: dict[str, list[str]] = {}
+        self._consts_to_resolve: dict[str, dict[str, bool]] = {}
+        self._var_imports: dict[str, list[str]] = {}
+        self._vars_to_resolve: dict[str, dict[str, bool]] = {}
+        self.results_map: dict[str, dict] = {}
+
+    def inspect(self, pol: model.Policy) -> None:
+        policy_id = _policy_key(pol)
+        store_identifier = pol.metadata.store_identifier if pol.metadata else ""
+
+        section = _section_of(pol)
+        dr_imp: list[str] = []
+        const_imp: list[str] = []
+        var_imp: list[str] = []
+        if section is not None:
+            consts = getattr(section, "constants", None)
+            if consts is not None:
+                const_imp = [
+                    namer.policy_key_from_fqn(namer.export_constants_fqn(n))
+                    for n in consts.import_
+                ]
+            variables = getattr(section, "variables", None)
+            if variables is not None:
+                var_imp = [
+                    namer.policy_key_from_fqn(namer.export_variables_fqn(n))
+                    for n in variables.import_
+                ]
+        if pol.resource_policy is not None:
+            dr_imp = [
+                namer.policy_key_from_fqn(namer.derived_roles_fqn(n))
+                for n in pol.resource_policy.import_derived_roles
+            ]
+        self._dr_imports[policy_id] = dr_imp
+        self._const_imports[policy_id] = const_imp
+        self._var_imports[policy_id] = var_imp
+
+        attrs: dict = {}
+        ref_consts: dict[str, bool] = {}
+        ref_vars: dict[str, bool] = {}
+        for expr in _visit_expr_strings(pol):
+            _collect_from_expr(expr, attrs, ref_consts, ref_vars)
+
+        derived_roles = sorted(_list_exported_derived_roles(pol), key=lambda d: d["name"])
+        if pol.resource_policy is not None:
+            referenced = {
+                dr for rule in pol.resource_policy.rules for dr in rule.derived_roles
+            }
+            if referenced:
+                self._dr_to_resolve[policy_id] = {name: False for name in referenced}
+
+        local_consts = _list_constants(pol)
+        for name in ref_consts:
+            if name in local_consts:
+                local_consts[name]["used"] = True
+            else:
+                self._consts_to_resolve.setdefault(policy_id, {})[name] = False
+        constants = sorted(local_consts.values(), key=lambda c: c["name"])
+
+        local_vars = _list_variables(pol)
+        for name in ref_vars:
+            if name in local_vars:
+                local_vars[name]["used"] = True
+            else:
+                self._vars_to_resolve.setdefault(policy_id, {})[name] = False
+        variables = sorted(local_vars.values(), key=lambda v: v["name"])
+
+        attributes = sorted(
+            ({"name": a["name"], "kind": a["kind"]} for a in attrs.values()),
+            key=lambda a: (a["kind"], a["name"]),
+        )
+        self.results_map[policy_id] = {
+            "policyId": store_identifier,
+            "actions": sorted(_list_actions(pol)),
+            "attributes": attributes,
+            "constants": constants,
+            "derivedRoles": derived_roles,
+            "variables": variables,
+        }
+
+    def results(self, load_policy: Optional[Callable[[str], Optional[model.Policy]]] = None) -> dict[str, dict]:
+        self._resolve_derived_roles(load_policy)
+        self._resolve_constants(load_policy)
+        self._resolve_variables(load_policy)
+        return self.results_map
+
+    # -- import resolution -------------------------------------------------
+
+    def _load(self, load_policy, key: str) -> Optional[model.Policy]:
+        if load_policy is None:
+            return None
+        try:
+            return load_policy(key)
+        except Exception:  # noqa: BLE001 — a missing policy is "unresolved"
+            return None
+
+    def _resolve_derived_roles(self, load_policy) -> None:
+        for policy_id, wanted in self._dr_to_resolve.items():
+            result = self.results_map[policy_id]
+            missing: list[str] = []
+            for imported_id in self._dr_imports.get(policy_id, []):
+                imported = self.results_map.get(imported_id)
+                if imported is None:
+                    missing.append(imported_id)
+                    continue
+                for dr in imported["derivedRoles"]:
+                    if dr["name"] in wanted:
+                        result["derivedRoles"].append(
+                            {"name": dr["name"], "kind": "KIND_IMPORTED", "source": imported_id}
+                        )
+                        wanted[dr["name"]] = True
+            for imported_id in missing:
+                pol = self._load(load_policy, imported_id)
+                if pol is None:
+                    continue
+                for dr in _list_exported_derived_roles(pol):
+                    if dr["name"] in wanted:
+                        result["derivedRoles"].append(
+                            {"name": dr["name"], "kind": "KIND_IMPORTED", "source": _policy_key(pol)}
+                        )
+                        wanted[dr["name"]] = True
+            for name, found in wanted.items():
+                if not found:
+                    result["derivedRoles"].append(
+                        {"name": name, "kind": "KIND_UNDEFINED", "source": ""}
+                    )
+            result["derivedRoles"].sort(key=lambda d: d["name"])
+
+    def _resolve_constants(self, load_policy) -> None:
+        for policy_id, wanted in self._consts_to_resolve.items():
+            result = self.results_map[policy_id]
+            missing: list[str] = []
+            for imported_id in self._const_imports.get(policy_id, []):
+                imported = self.results_map.get(imported_id)
+                if imported is None:
+                    missing.append(imported_id)
+                    continue
+                for c in imported["constants"]:
+                    if c["name"] in wanted:
+                        result["constants"].append(
+                            {"name": c["name"], "value": c.get("value"),
+                             "kind": "KIND_IMPORTED", "source": imported_id, "used": True}
+                        )
+                        wanted[c["name"]] = True
+            for imported_id in missing:
+                pol = self._load(load_policy, imported_id)
+                if pol is None:
+                    continue
+                for name, c in _list_constants(pol).items():
+                    if name in wanted:
+                        result["constants"].append(
+                            {"name": name, "value": c.get("value"),
+                             "kind": "KIND_IMPORTED", "source": _policy_key(pol), "used": True}
+                        )
+                        wanted[name] = True
+            for name, found in wanted.items():
+                if not found:
+                    result["constants"].append(
+                        {"name": name, "kind": "KIND_UNDEFINED", "used": True}
+                    )
+            result["constants"].sort(key=lambda c: c["name"])
+
+    def _resolve_variables(self, load_policy) -> None:
+        for policy_id, wanted in self._vars_to_resolve.items():
+            result = self.results_map[policy_id]
+            attr_names = {a["name"] for a in result["attributes"]}
+
+            def merge_attrs_from(value) -> None:
+                extra: dict = {}
+                if isinstance(value, str):
+                    _collect_from_expr(value, extra, {}, {})
+                for a in extra.values():
+                    if a["name"] not in attr_names:
+                        result["attributes"].append(a)
+                        attr_names.add(a["name"])
+
+            missing: list[str] = []
+            for imported_id in self._var_imports.get(policy_id, []):
+                imported = self.results_map.get(imported_id)
+                if imported is None:
+                    missing.append(imported_id)
+                    continue
+                for v in imported["variables"]:
+                    if v["name"] in wanted:
+                        result["variables"].append(
+                            {"name": v["name"], "value": v.get("value"),
+                             "kind": "KIND_IMPORTED", "source": imported_id, "used": True}
+                        )
+                        wanted[v["name"]] = True
+                        merge_attrs_from(v.get("value", ""))
+            for imported_id in missing:
+                pol = self._load(load_policy, imported_id)
+                if pol is None:
+                    continue
+                for name, v in _list_variables(pol).items():
+                    if name in wanted:
+                        result["variables"].append(
+                            {"name": name, "value": v.get("value"),
+                             "kind": "KIND_IMPORTED", "source": _policy_key(pol), "used": True}
+                        )
+                        wanted[name] = True
+                        merge_attrs_from(v.get("value", ""))
+            for name, found in wanted.items():
+                if not found:
+                    result["variables"].append(
+                        {"name": name, "value": "null", "kind": "KIND_UNDEFINED",
+                         "source": "", "used": True}
+                    )
+            # the post-resolution re-sort is by NAME only (policy.go
+            # resolveVariables), unlike the initial (kind, name) ordering
+            result["attributes"].sort(key=lambda a: a["name"])
+            result["variables"].sort(key=lambda v: v["name"])
+
+
+def inspect_policies(policies: list[model.Policy], load_policy=None) -> dict[str, dict]:
+    ins = PolicyInspector()
+    for p in policies:
+        ins.inspect(p)
+    return ins.results(load_policy)
+
+
+class _SingleResult:
+    """Adapter for the Admin API: one policy's result dict."""
+
+    def __init__(self, policy_id: str, data: dict):
+        self.policy_id = policy_id
+        self._data = data
+
+    def to_json(self) -> dict:
+        return self._data
+
+
+def inspect_policy(pol: model.Policy) -> _SingleResult:
+    results = inspect_policies([pol])
+    policy_id = next(iter(results))
+    return _SingleResult(policy_id, results[policy_id])

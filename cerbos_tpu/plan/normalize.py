@@ -43,8 +43,28 @@ def _canon(op: Operand) -> str:
     return json.dumps(op.to_json(), sort_keys=True)
 
 
+_ABBREVS = {
+    "P": "request.principal",
+    "R": "request.resource",
+    "C": "constants",
+    "V": "variables",
+    "G": "globals",
+}
+
+
+def expand_abbrev(s: str) -> str:
+    """conditions/cel.go ExpandAbbrev: P/R/C/V/G prefixes → full idents."""
+    prefix, dot, rest = s.partition(".")
+    expanded = _ABBREVS.get(prefix, prefix)
+    return f"{expanded}.{rest}" if dot else expanded
+
+
 def normalise_operand(op: Optional[Operand]) -> Optional[Operand]:
-    if op is None or op.expression is None:
+    if op is None:
+        return op
+    if op.expression is None:
+        if op.variable is not None:
+            return Operand(variable=expand_abbrev(op.variable))
         return op
     expr = op.expression
 
@@ -153,3 +173,43 @@ def merge_with_and(filters: list[tuple[str, Optional[Operand]]]) -> tuple[str, O
         return KIND_CONDITIONAL, next(iter(conds.values()))
     operands = [conds[k] for k in sorted(conds)]
     return KIND_CONDITIONAL, Operand(expression=Expr(op="and", operands=operands))
+
+
+def filter_to_string(kind: str, condition: Optional[Operand]) -> str:
+    """planner/ast.go FilterToString: canonical debug rendering of a filter."""
+    if kind == KIND_ALWAYS_ALLOWED:
+        return "(true)"
+    if kind == KIND_ALWAYS_DENIED:
+        return "(false)"
+    if kind == KIND_CONDITIONAL:
+        return _op_to_string(condition)
+    return ""
+
+
+def _op_to_string(op: Optional[Operand]) -> str:
+    if op is None:
+        return ""
+    if op.expression is not None:
+        inner = " ".join(_op_to_string(o) for o in op.expression.operands)
+        return f"({op.expression.op} {inner})"
+    if op.variable is not None:
+        return op.variable
+    return _compact_value(op.value)
+
+
+def _compact_value(v) -> str:
+    """protojson-compact Value rendering (whole floats print as ints)."""
+    import json as _json
+
+    def compact(x):
+        if isinstance(x, bool) or x is None or isinstance(x, str):
+            return x
+        if isinstance(x, float) and x.is_integer():
+            return int(x)
+        if isinstance(x, list):
+            return [compact(i) for i in x]
+        if isinstance(x, dict):
+            return {k: compact(i) for k, i in x.items()}
+        return x
+
+    return _json.dumps(compact(v), separators=(",", ":"), ensure_ascii=False)

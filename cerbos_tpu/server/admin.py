@@ -201,26 +201,11 @@ class AdminService:
 
         def inspect_policies(req: request_pb2.InspectPoliciesRequest, ctx):
             guard(ctx)
-            from ..inspect import inspect_policy
+            from ..inspect import inspect_policies as run_inspection
 
             resp = response_pb2.InspectPoliciesResponse()
-            for pol in self.core.store.get_all():
-                insp = inspect_policy(pol)
-                result = {
-                    "actions": insp.actions,
-                    "policyId": insp.policy_id,
-                    "attributes": (
-                        [{"kind": "KIND_PRINCIPAL_ATTRIBUTE", "name": n} for n in insp.principal_attributes]
-                        + [{"kind": "KIND_RESOURCE_ATTRIBUTE", "name": n} for n in insp.resource_attributes]
-                    ),
-                    "variables": [{"name": n, "kind": "KIND_LOCAL"} for n in insp.variables],
-                    "constants": [{"name": n, "kind": "KIND_LOCAL"} for n in insp.constants],
-                    "derivedRoles": (
-                        [{"name": n, "kind": "KIND_EXPORTED"} for n in insp.derived_roles]
-                        + [{"name": n, "kind": "KIND_IMPORTED"} for n in insp.imported_derived_roles]
-                    ),
-                }
-                json_format.ParseDict(result, resp.results[insp.policy_id], ignore_unknown_fields=True)
+            for policy_id, result in run_inspection(self.core.store.get_all()).items():
+                json_format.ParseDict(result, resp.results[policy_id], ignore_unknown_fields=True)
             return resp
 
         def add_or_update_schema(req: request_pb2.AddOrUpdateSchemaRequest, ctx):
@@ -430,13 +415,9 @@ class AdminService:
     async def _h_inspect(self, request: web.Request) -> web.Response:
         if (resp := self._guard(request)) is not None:
             return resp
-        from ..inspect import inspect_policy
+        from ..inspect import inspect_policies as run_inspection
 
-        results = {}
-        for pol in self.core.store.get_all():
-            insp = inspect_policy(pol)
-            results[insp.policy_id] = insp.to_json()
-        return web.json_response({"results": results})
+        return web.json_response({"results": run_inspection(self.core.store.get_all())})
 
     async def _h_reload_store(self, request: web.Request) -> web.Response:
         if (resp := self._guard(request)) is not None:

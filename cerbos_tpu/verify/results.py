@@ -600,9 +600,12 @@ def _run_test(engine, test: _Test, actions: list[str], trace: bool) -> dict[str,
     traces: Optional[dict] = None
     try:
         actual = engine.check([inp], params=params)
-        if trace:
-            # engine trace batch for --verbose runs (performCheck's
-            # WithTraceSink analogue): policy→rule→condition trees
+    except Exception as e:  # engine-level failure -> per-action error
+        err = str(e)
+    if err is None and trace:
+        # engine trace batch for --verbose runs (performCheck's WithTraceSink
+        # analogue); diagnostic-only, so its own failures are swallowed
+        try:
             from ..tracer import traced_check
 
             _, recorder = traced_check(
@@ -611,8 +614,8 @@ def _run_test(engine, test: _Test, actions: list[str], trace: bool) -> dict[str,
             collected = recorder.to_json()
             if collected:
                 traces = {"traces": collected}
-    except Exception as e:  # engine-level failure -> per-action error
-        err = str(e)
+        except Exception:  # noqa: BLE001
+            pass
     if err is None and used_default_now:
         err = ERR_USED_DEFAULT_NOW
 

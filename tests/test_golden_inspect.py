@@ -1,0 +1,64 @@
+"""Reference inspect corpus: policy inspection results.
+
+Mirrors internal/inspect/inspect_test.go (Policies mode): each case's input
+policies are inspected together, with import resolution falling back to a
+policy loader over the same inputs, and the per-policy results compare
+against policiesExpectation (attributes, constants, variables with
+local/imported/exported/undefined kinds and used flags, derived roles,
+actions).
+"""
+
+import os
+
+import pytest
+import yaml
+
+from cerbos_tpu.inspect import PolicyInspector, _policy_key
+from cerbos_tpu.policy.parser import parse_policy
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden", "inspect")
+
+CASES = sorted(f for f in os.listdir(CORPUS) if f.endswith(".yaml"))
+
+
+def _norm(v):
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in sorted(v.items()) if not _is_default(x)}
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return v
+
+
+def _is_default(x):
+    return x in ("", None, [], {}, False)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_inspect_policies(case):
+    with open(os.path.join(CORPUS, case), encoding="utf-8") as f:
+        tc = yaml.safe_load(f)
+
+    policies = [parse_policy(doc) for doc in tc.get("inputs", [])]
+    by_key = {_policy_key(p): p for p in policies}
+
+    requested_missing: list[str] = []
+
+    def load_policy(key):
+        pol = by_key.get(key)
+        if pol is None:
+            requested_missing.append(key)
+        return pol
+
+    ins = PolicyInspector()
+    for p in policies:
+        ins.inspect(p)
+    have = ins.results(load_policy=load_policy)
+
+    want = (tc.get("policiesExpectation") or {}).get("policies") or {}
+    missing = (tc.get("policiesExpectation") or {}).get("missingPolicies") or []
+    assert sorted(want.keys()) == sorted(have.keys()), case
+    for key in want:
+        assert _norm(want[key]) == _norm(have[key]), f"{case}: {key}"
+    assert sorted(missing) == sorted(set(requested_missing)), case

@@ -1,0 +1,61 @@
+"""Reference query_planner_filter corpus: filter normalisation + rendering.
+
+Mirrors internal/ruletable/planner/planner_test.go TestNormaliseFilter: each
+case feeds a PlanResources filter through normalisation and compares the
+resulting (kind, condition) protojson shape and the FilterToString debug
+rendering byte-for-byte.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from cerbos_tpu.plan.normalize import filter_to_string, normalise_filter
+from cerbos_tpu.plan.types import Expr, Operand
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden", "query_planner_filter")
+
+CASES = sorted(f for f in os.listdir(CORPUS) if f.endswith(".yaml"))
+
+
+def operand_from(d: dict) -> Operand:
+    if "expression" in d:
+        e = d["expression"]
+        return Operand(
+            expression=Expr(
+                op=e.get("operator", ""),
+                operands=[operand_from(o) for o in e.get("operands", [])],
+            )
+        )
+    if "variable" in d:
+        return Operand(variable=d["variable"])
+    return Operand(value=d.get("value"))
+
+
+def _norm(v):
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in sorted(v.items())}
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    return v
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_normalise_filter(case):
+    with open(os.path.join(CORPUS, case), encoding="utf-8") as f:
+        tc = yaml.safe_load(f)
+    inp = tc["input"]
+    cond = operand_from(inp["condition"]) if inp.get("condition") else None
+    kind, norm_cond = normalise_filter(inp.get("kind", "KIND_UNSPECIFIED"), cond)
+
+    want = tc["wantFilter"]
+    have = {"kind": kind}
+    if norm_cond is not None:
+        have["condition"] = norm_cond.to_json()
+    assert _norm(want) == _norm(have), case
+    assert tc["wantString"] == filter_to_string(kind, norm_cond), case

@@ -1,0 +1,50 @@
+"""Reference policy_formats corpus: YAML/JSON parse equivalence.
+
+Mirrors internal/policy/io_test.go: every policy parses identically from its
+.yaml and .json renderings (TestReadPolicy/TestHash), and single-policy
+reads reject multi-document files while tolerating trailing whitespace and
+comment-only documents (TestReadFileWithMultiplePolicies).
+"""
+
+import os
+
+import pytest
+
+from cerbos_tpu.policy.parser import ParseError, parse_policies, parse_policy_file
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden", "policy_formats")
+
+PAIRS = sorted(
+    f[:-5] for f in os.listdir(CORPUS)
+    if f.endswith(".yaml") and os.path.exists(os.path.join(CORPUS, f[:-5] + ".json"))
+)
+
+
+@pytest.mark.parametrize("name", PAIRS)
+def test_yaml_json_equivalence(name):
+    with open(os.path.join(CORPUS, name + ".yaml"), encoding="utf-8") as f:
+        yaml_pols = list(parse_policies(f.read(), source="x"))
+    with open(os.path.join(CORPUS, name + ".json"), encoding="utf-8") as f:
+        json_pols = list(parse_policies(f.read(), source="x"))
+    assert len(yaml_pols) == len(json_pols) == 1
+    # model dataclass equality (source_file/positions excluded via compare=False;
+    # equal models imply equal deterministic hashes — the TestHash analogue)
+    assert yaml_pols[0] == json_pols[0], name
+
+
+@pytest.mark.parametrize(
+    "name,want_err",
+    [
+        ("multiple_policies.yaml", True),
+        ("single_policy_trailing_spaces.yaml", False),
+        ("single_policy_others_commented.yaml", False),
+    ],
+)
+def test_single_policy_reads(name, want_err):
+    path = os.path.join(CORPUS, name)
+    if want_err:
+        with pytest.raises(ParseError, match="expected exactly one policy"):
+            parse_policy_file(path)
+    else:
+        pol = parse_policy_file(path)
+        assert pol.kind

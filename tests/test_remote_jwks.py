@@ -19,10 +19,13 @@ def _b64(b: bytes) -> str:
     return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
 
 
-def _jwk(key):
+def _jwk(key, kid="test-key"):
     pub = key.public_key().public_numbers()
+    # kid and alg are REQUIRED by key-set validation (jwt.go; auxdata corpus)
     return {
         "kty": "RSA",
+        "kid": kid,
+        "alg": "RS256",
         "n": _b64(pub.n.to_bytes((pub.n.bit_length() + 7) // 8, "big")),
         "e": _b64(pub.e.to_bytes((pub.e.bit_length() + 7) // 8, "big")),
     }
