@@ -24,7 +24,18 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
-def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+def make_mesh_2d(rows: int, cols: int, axes: tuple[str, str] = ("replica", "data")) -> Mesh:
+    """Multi-axis mesh: the batch axis shards over BOTH axes (the flattened
+    device grid), exercising 2-D device layouts the way a tp×dp topology
+    would place them on real hardware."""
+    devices = np.array(jax.devices()[: rows * cols]).reshape(rows, cols)
+    return Mesh(devices, axes)
+
+
+def batch_sharding(mesh: Mesh, axis="data") -> NamedSharding:
+    if len(mesh.axis_names) > 1:
+        # shard the batch over every mesh axis (flattened grid)
+        return NamedSharding(mesh, P(tuple(mesh.axis_names)))
     return NamedSharding(mesh, P(axis))
 
 
