@@ -1,0 +1,138 @@
+"""Minimal Azure Blob Storage client for the blob store.
+
+Behavioral reference: internal/storage/blob (gocloud's azblob:// transport).
+List (paginated XML) + download, authenticated with the Shared Key scheme
+(HMAC-SHA256 over the canonicalized request — the same construction the
+Azure SDK performs) or a SAS token appended to the query string; anonymous
+works for public containers. ``endpoint_url`` points tests (or Azurite) at
+a local server.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Optional
+
+_API_VERSION = "2021-08-06"
+
+
+@dataclass
+class AzureObject:
+    key: str
+    etag: str
+    size: int
+
+
+class AzureError(RuntimeError):
+    pass
+
+
+def shared_key_signature(
+    account: str,
+    key_b64: str,
+    verb: str,
+    path: str,
+    query: dict[str, str],
+    headers: dict[str, str],
+) -> str:
+    """The Blob service Shared Key string-to-sign (docs: 'Authorize with
+    Shared Key'): VERB + canonical standard headers + x-ms-* headers +
+    canonicalized resource (/account/path plus sorted query params)."""
+    ms_headers = "\n".join(
+        f"{k.lower()}:{v}" for k, v in sorted(headers.items()) if k.lower().startswith("x-ms-")
+    )
+    canonical_resource = f"/{account}{path}"
+    for name in sorted(query):
+        canonical_resource += f"\n{name.lower()}:{query[name]}"
+    string_to_sign = "\n".join(
+        [
+            verb,
+            "",  # Content-Encoding
+            "",  # Content-Language
+            "",  # Content-Length (empty when 0)
+            "",  # Content-MD5
+            "",  # Content-Type
+            "",  # Date (empty: x-ms-date is set)
+            "",  # If-Modified-Since
+            "",  # If-Match
+            "",  # If-None-Match
+            "",  # If-Unmodified-Since
+            "",  # Range
+            ms_headers,
+            canonical_resource,
+        ]
+    )
+    digest = hmac.new(base64.b64decode(key_b64), string_to_sign.encode(), hashlib.sha256).digest()
+    return base64.b64encode(digest).decode()
+
+
+class AzureBlobClient:
+    def __init__(
+        self,
+        account: str,
+        container: str,
+        account_key: Optional[str] = None,
+        sas_token: str = "",
+        endpoint_url: str = "",
+        timeout_s: float = 30.0,
+    ):
+        self.account = account
+        self.container = container
+        self.account_key = account_key or ""
+        self.sas_token = sas_token.lstrip("?")
+        self.endpoint = (endpoint_url or f"https://{account}.blob.core.windows.net").rstrip("/")
+        self.timeout = timeout_s
+
+    def _request(self, path: str, query: dict[str, str]) -> bytes:
+        query = dict(query)
+        qs = urllib.parse.urlencode(query)
+        if self.sas_token:
+            qs = f"{qs}&{self.sas_token}" if qs else self.sas_token
+        url = f"{self.endpoint}{urllib.parse.quote(path)}" + (f"?{qs}" if qs else "")
+        headers = {
+            "x-ms-date": datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%a, %d %b %Y %H:%M:%S GMT"
+            ),
+            "x-ms-version": _API_VERSION,
+        }
+        if self.account_key and not self.sas_token:
+            sig = shared_key_signature(
+                self.account, self.account_key, "GET", path, query, headers
+            )
+            headers["Authorization"] = f"SharedKey {self.account}:{sig}"
+        req = urllib.request.Request(url, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise AzureError(f"Azure request failed: {e.code} {e.reason} for {url}") from None
+        except OSError as e:
+            raise AzureError(f"Azure request failed: {e} for {url}") from None
+
+    def list_objects(self, prefix: str = "") -> list[AzureObject]:
+        out: list[AzureObject] = []
+        marker = ""
+        while True:
+            query = {"restype": "container", "comp": "list", "prefix": prefix}
+            if marker:
+                query["marker"] = marker
+            data = self._request(f"/{self.container}", query)
+            root = ET.fromstring(data)
+            for blob in root.iter("Blob"):
+                name = blob.findtext("Name", "")
+                etag = blob.findtext("Properties/Etag", "")
+                size = int(blob.findtext("Properties/Content-Length", "0") or 0)
+                out.append(AzureObject(key=name, etag=etag, size=size))
+            marker = root.findtext("NextMarker", "") or ""
+            if not marker:
+                return out
+
+    def get_object(self, key: str) -> bytes:
+        return self._request(f"/{self.container}/{key}", {})

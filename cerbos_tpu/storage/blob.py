@@ -11,8 +11,12 @@ a local clone + poll — blob/cloner.go). Transports:
   AWS's regional endpoint), credentials from config or the standard AWS
   env vars. Sync = list the prefix, download new/changed keys (ETag diff),
   delete local files whose keys vanished — cloner.go's clone loop.
-- ``gs://`` / ``azblob://`` — would need their (different) auth protocols;
-  raise a clear error.
+- ``gs://bucket`` — GCS via the in-tree JSON-API client
+  (`storage/gcs.py`: bearer-token auth, paginated list, alt=media
+  download); endpoint override points at fake-gcs-server for tests.
+- ``azblob://account/container`` — Azure Blob via the in-tree client
+  (`storage/azure_blob.py`: Shared Key request signing or SAS token,
+  paginated XML list); endpoint override points at Azurite for tests.
 """
 
 from __future__ import annotations
@@ -40,12 +44,14 @@ class BlobStore(Store):
         prefix: str = "",
         access_key: Optional[str] = None,
         secret_key: Optional[str] = None,
+        access_token: Optional[str] = None,
+        sas_token: str = "",
     ):
         super().__init__()
         self.bucket_url = bucket_url
         self.work_dir = os.path.abspath(work_dir)
         self.prefix = prefix
-        self._s3 = None
+        self._remote = None  # any client with list_objects/get_object + etags
         self._etags: dict[str, str] = {}  # key -> last-synced ETag
         if bucket_url.startswith("s3://"):
             from .s3 import S3Client
@@ -53,12 +59,36 @@ class BlobStore(Store):
             bucket = bucket_url[len("s3://"):].strip("/")
             if not endpoint_url:
                 endpoint_url = f"https://s3.{region}.amazonaws.com"
-            self._s3 = S3Client(
+            self._remote = S3Client(
                 bucket=bucket,
                 endpoint_url=endpoint_url,
                 region=region,
                 access_key=access_key,
                 secret_key=secret_key,
+            )
+        elif bucket_url.startswith("gs://"):
+            from .gcs import GCSClient
+
+            bucket = bucket_url[len("gs://"):].strip("/")
+            kwargs = {"bucket": bucket, "access_token": access_token}
+            if endpoint_url:
+                kwargs["endpoint_url"] = endpoint_url
+            self._remote = GCSClient(**kwargs)
+        elif bucket_url.startswith("azblob://"):
+            from .azure_blob import AzureBlobClient
+
+            rest = bucket_url[len("azblob://"):].strip("/")
+            account, _, container = rest.partition("/")
+            if not account or not container:
+                raise ValueError(
+                    f"azblob URL must be azblob://account/container, got {bucket_url!r}"
+                )
+            self._remote = AzureBlobClient(
+                account=account,
+                container=container,
+                account_key=access_key,
+                sas_token=sas_token,
+                endpoint_url=endpoint_url,
             )
         self._stop = threading.Event()
         self._sync()
@@ -95,20 +125,14 @@ class BlobStore(Store):
                     rel_path = os.path.normpath(os.path.join(rel, f))
                     if rel_path not in seen:
                         os.unlink(os.path.join(root, f))
-        elif self._s3 is not None:
-            self._sync_s3()
-        elif self.bucket_url.startswith(("gs://", "azblob://")):
-            raise RuntimeError(
-                f"blob transport for {self.bucket_url!r} is not supported "
-                "(gs/azblob auth protocols need their SDKs); use s3://, file://, "
-                "or the git/disk drivers"
-            )
+        elif self._remote is not None:
+            self._sync_remote()
         else:
             raise ValueError(f"unsupported bucket URL {self.bucket_url!r}")
 
-    def _sync_s3(self) -> None:
+    def _sync_remote(self) -> None:
         os.makedirs(self.work_dir, exist_ok=True)
-        objects = self._s3.list_objects(self.prefix)
+        objects = self._remote.list_objects(self.prefix)
         seen: set[str] = set()
         for obj in objects:
             rel = obj.key[len(self.prefix):].lstrip("/") if self.prefix else obj.key
@@ -121,7 +145,7 @@ class BlobStore(Store):
             dst = os.path.join(self.work_dir, rel)
             if self._etags.get(rel) == obj.etag and os.path.exists(dst):
                 continue
-            data = self._s3.get_object(obj.key)
+            data = self._remote.get_object(obj.key)
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             with open(dst, "wb") as f:
                 f.write(data)
@@ -173,6 +197,8 @@ register_driver("blob", lambda conf: BlobStore(
     endpoint_url=conf.get("endpointUrl", ""),
     region=conf.get("region", "us-east-1"),
     prefix=conf.get("prefix", ""),
-    access_key=conf.get("accessKeyId") or None,
+    access_key=conf.get("accessKeyId") or conf.get("accountKey") or None,
     secret_key=conf.get("secretAccessKey") or None,
+    access_token=conf.get("accessToken") or None,
+    sas_token=conf.get("sasToken", ""),
 ))

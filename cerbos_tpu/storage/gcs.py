@@ -1,0 +1,88 @@
+"""Minimal GCS JSON-API client for the blob store.
+
+Behavioral reference: internal/storage/blob (gocloud's gs:// transport).
+Only what the cloner needs: list a prefix (paginated) and fetch objects.
+Auth is a bearer token (``GOOGLE_OAUTH_ACCESS_TOKEN`` / config) — the
+standard header the JSON API takes from any credential source; anonymous
+works for public buckets. ``endpoint_url`` override points tests (or
+fake-gcs-server deployments) at a local server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class GCSObject:
+    key: str
+    etag: str
+    size: int
+
+
+class GCSError(RuntimeError):
+    pass
+
+
+class GCSClient:
+    def __init__(
+        self,
+        bucket: str,
+        endpoint_url: str = "https://storage.googleapis.com",
+        access_token: Optional[str] = None,
+        timeout_s: float = 30.0,
+    ):
+        self.bucket = bucket
+        self.endpoint = endpoint_url.rstrip("/")
+        self.access_token = access_token or os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN", "")
+        self.timeout = timeout_s
+
+    def _request(self, url: str) -> bytes:
+        req = urllib.request.Request(url)
+        if self.access_token:
+            req.add_header("Authorization", f"Bearer {self.access_token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise GCSError(f"GCS request failed: {e.code} {e.reason} for {url}") from None
+        except OSError as e:
+            raise GCSError(f"GCS request failed: {e} for {url}") from None
+
+    def list_objects(self, prefix: str = "") -> list[GCSObject]:
+        out: list[GCSObject] = []
+        page_token = ""
+        while True:
+            params = {"prefix": prefix}
+            if page_token:
+                params["pageToken"] = page_token
+            url = (
+                f"{self.endpoint}/storage/v1/b/{urllib.parse.quote(self.bucket, safe='')}/o"
+                f"?{urllib.parse.urlencode(params)}"
+            )
+            doc = json.loads(self._request(url))
+            for item in doc.get("items", []):
+                out.append(
+                    GCSObject(
+                        key=item.get("name", ""),
+                        # md5Hash is content-addressed like S3's ETag; fall
+                        # back to etag (metageneration-sensitive) when absent
+                        etag=item.get("md5Hash") or item.get("etag", ""),
+                        size=int(item.get("size", 0)),
+                    )
+                )
+            page_token = doc.get("nextPageToken", "")
+            if not page_token:
+                return out
+
+    def get_object(self, key: str) -> bytes:
+        url = (
+            f"{self.endpoint}/storage/v1/b/{urllib.parse.quote(self.bucket, safe='')}"
+            f"/o/{urllib.parse.quote(key, safe='')}?alt=media"
+        )
+        return self._request(url)
