@@ -40,6 +40,7 @@ def sanitize(v: str) -> str:
     return v
 
 
+@functools.lru_cache(maxsize=65536)
 def module_id(fqn: str) -> int:
     """Stable 64-bit module ID for an FQN."""
     return int.from_bytes(hashlib.blake2b(fqn.encode(), digest_size=8).digest(), "big")

@@ -44,10 +44,14 @@ class RuleTable:
         self.schemas: dict[int, model.Schemas] = {}
         self.meta: dict[int, PolicyMeta] = {}
         self.scope_parent_roles: dict[str, dict[str, list[str]]] = {}
+        # fqn -> chain source attributes (static per table build; hot on the
+        # evaluator's cold-assembly path)
+        self._chain_attr_memo: dict[str, dict[str, dict]] = {}
 
     # -- build ------------------------------------------------------------
 
     def ingest_policy(self, p: CompiledPolicy) -> None:
+        self._chain_attr_memo.clear()
         mod_id = namer.module_id(p.fqn)
         if isinstance(p, CompiledResourcePolicy):
             self.meta[mod_id] = PolicyMeta(
@@ -85,6 +89,7 @@ class RuleTable:
         self.idx.index_rules(rows)
 
     def delete_policy(self, fqn: str) -> None:
+        self._chain_attr_memo.clear()
         self.idx.delete_policy(fqn)
         mod_id = namer.module_id(fqn)
         meta = self.meta.pop(mod_id, None)
@@ -130,6 +135,9 @@ class RuleTable:
         policy sets carry the whole ancestor chain's SourceAttributes
         (compile.go:153-165), so one binding attributes every policy in its
         chain."""
+        hit = self._chain_attr_memo.get(fqn)
+        if hit is not None:
+            return hit
         out: dict[str, dict] = {}
         root, sep, scope = fqn.partition("/")
         chain = [fqn]
@@ -142,6 +150,7 @@ class RuleTable:
             meta = self.meta.get(namer.module_id(f))
             if meta is not None and meta.source_attributes:
                 out[f] = meta.source_attributes
+        self._chain_attr_memo[fqn] = out
         return out
 
     def get_meta(self, fqn: str) -> Optional[PolicyMeta]:

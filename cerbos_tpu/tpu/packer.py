@@ -141,6 +141,8 @@ class Packer:
         # scratch interner for predicate group keys (kept separate from the
         # device interner so grouping never grows the device string space)
         self._pred_scratch: dict[str, int] = {}
+        # pred_id -> fastpred program (None = outside the fast grammar)
+        self._fast_preds: dict[int, Any] = {}
 
     def invalidate(self) -> None:
         self._cand_cache.clear()
@@ -161,6 +163,7 @@ class Packer:
         self._sp_uid.clear()
         self._sp_store.clear()
         self._sp_stacked = None
+        self._fast_preds.clear()
 
     def _get_all_scopes(self, kind: str, scope: str, name: str, version: str, lenient: bool):
         key = (kind, scope, name, version, lenient)
@@ -950,6 +953,39 @@ class Packer:
             for spec in preds
         }
 
+        # Closed-form vectorized predicates first (fastpred): no activation
+        # objects, no interpreter, no value-combination grouping — a
+        # memo-cold batch with globally unique attributes costs one Python
+        # loop per AST op instead of a full CEL evaluation per input.
+        fast_specs: list[tuple[Any, Any]] = []
+        gen_specs: list = []
+        for spec in preds:
+            prog = self._fast_pred_prog(spec)
+            if prog is not None:
+                fast_specs.append((spec, prog))
+            else:
+                gen_specs.append(spec)
+        if fast_specs and live:
+            n = len(live)
+            gathered: dict[tuple[str, ...], list] = {}
+            for _, prog in fast_specs:
+                for p in prog.paths:
+                    if p not in gathered:
+                        acc = self._path_accessor(p)
+                        gathered[p] = [acc(plan.input) for _, plan in live]
+            bis = np.fromiter((bi for bi, _ in live), dtype=np.int64, count=n)
+            for spec, prog in fast_specs:
+                v_list, e_list = prog.eval(gathered, n)
+                vals, errs = out[spec.pred_id]
+                vals[bis] = v_list
+                errs[bis] = e_list
+        preds = gen_specs
+        if not preds:
+            for spec_id, (vals, errs) in out.items():
+                cb.pred_vals[spec_id] = vals
+                cb.pred_errs[spec_id] = errs
+            return
+
         # Vectorized grouping: encode every referenced path's value to its
         # canonical (tag, hi, lo, sid) key columns, group the batch with one
         # np.unique over the key matrix, and evaluate each predicate ONCE per
@@ -1031,10 +1067,21 @@ class Packer:
                     continue
                 vals, errs = out[spec.pred_id]
                 vals[bi], errs[bi] = self._eval_pred(spec, plan, params)
-        for spec in preds:
-            vals, errs = out[spec.pred_id]
-            cb.pred_vals[spec.pred_id] = vals
-            cb.pred_errs[spec.pred_id] = errs
+        for spec_id, (vals, errs) in out.items():
+            cb.pred_vals[spec_id] = vals
+            cb.pred_errs[spec_id] = errs
+
+    def _fast_pred_prog(self, spec):
+        """Compile-once cache of fastpred programs (None = generic path)."""
+        hit = self._fast_preds.get(spec.pred_id, _MISSING_SENTINEL)
+        if hit is not _MISSING_SENTINEL:
+            return hit
+        from . import fastpred
+
+        fastpred.configure(_MISSING_SENTINEL, _ERR_SENTINEL)
+        prog = fastpred.compile_fast_pred(spec)
+        self._fast_preds[spec.pred_id] = prog
+        return prog
 
     def _fused_mode(self, path: tuple[str, ...]) -> Optional[tuple[int, str, str]]:
         """(mode, root, leaf) for paths the C fused gather+encode handles;

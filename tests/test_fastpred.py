@@ -1,0 +1,184 @@
+"""fastpred (vectorized host predicates) must be bit-exact vs the CEL
+interpreter path for every value shape: matching strings, wrong types,
+missing attributes, malformed IPs, IPv6, leading-zero octets.
+
+Two layers:
+  1. direct program-vs-interpreter equivalence on the compiled PredSpecs;
+  2. end-to-end evaluator-vs-oracle parity through TpuEvaluator.
+"""
+
+import pytest
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table
+from cerbos_tpu.ruletable.check import EvalContext, build_request_messages, check_input
+from cerbos_tpu.tpu import TpuEvaluator
+from cerbos_tpu.tpu.condcompile import evaluate_pred_host
+from cerbos_tpu.tpu import fastpred
+from cerbos_tpu.tpu.packer import _ERR_SENTINEL, _MISSING_SENTINEL
+
+POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: doc
+  version: "default"
+  rules:
+    - actions: ["read"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.name.startsWith("n1")
+    - actions: ["write"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: >-
+            R.attr.geography ==
+            (P.attr.ip_address.inIPAddrRange("10.20.0.0/16") ? "GB" : "")
+    - actions: ["tail"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.name.endsWith("z")
+    - actions: ["find"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: R.attr.name.contains("mid")
+    - actions: ["vsix"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: P.attr.ip_address.inIPAddrRange("2001:db8::/32")
+"""
+
+IPS = [
+    "10.20.1.2",        # in 10.20.0.0/16
+    "10.21.1.2",        # out
+    "10.020.1.2",       # leading zero -> parse error
+    "10.20.1",          # short -> error
+    "10.20.1.256",      # octet range -> error
+    "300.1.1.1",        # octet range -> error
+    " 10.20.1.2",       # whitespace -> error
+    "10.20.1.2.3",      # long -> error
+    "2001:db8::1",      # v6: version mismatch for v4 net; inside v6 net
+    "2001:db9::1",      # v6 outside v6 net
+    "::ffff:10.20.1.2", # v4-mapped v6 literal
+    "not-an-ip",
+    "",
+]
+
+NAMES = ["n1-doc", "n2-doc", "xmidz", "n1", "", "midz", "zzz"]
+
+WEIRD = [1, 1.5, True, None, ["n1"], {"a": 1}, b"n1"]
+
+
+def _battery():
+    """CheckInputs covering every adversarial combination."""
+    inputs = []
+    i = 0
+    for ip in IPS:
+        for name in NAMES[:3]:
+            inputs.append(
+                CheckInput(
+                    request_id=f"r{i}",
+                    principal=Principal(id=f"u{i}", roles=["user"], attr={"ip_address": ip}),
+                    resource=Resource(kind="doc", id=f"d{i}", attr={"name": name, "geography": "GB"}),
+                    actions=["read", "write", "tail", "find", "vsix"],
+                )
+            )
+            i += 1
+    for name in NAMES:
+        for geo in ("GB", "", "FR", 7):
+            inputs.append(
+                CheckInput(
+                    request_id=f"r{i}",
+                    principal=Principal(id=f"u{i}", roles=["user"], attr={"ip_address": "10.20.3.4"}),
+                    resource=Resource(kind="doc", id=f"d{i}", attr={"name": name, "geography": geo}),
+                    actions=["read", "write", "tail", "find", "vsix"],
+                )
+            )
+            i += 1
+    for w in WEIRD:
+        inputs.append(
+            CheckInput(
+                request_id=f"r{i}",
+                principal=Principal(id=f"u{i}", roles=["user"], attr={"ip_address": w}),
+                resource=Resource(kind="doc", id=f"d{i}", attr={"name": w} if not isinstance(w, dict) else {"name": "x"}),
+                actions=["read", "write", "tail", "find", "vsix"],
+            )
+        )
+        i += 1
+    # missing attributes entirely
+    inputs.append(
+        CheckInput(
+            request_id=f"r{i}",
+            principal=Principal(id="u-miss", roles=["user"], attr={}),
+            resource=Resource(kind="doc", id="d-miss", attr={}),
+            actions=["read", "write", "tail", "find", "vsix"],
+        )
+    )
+    return inputs
+
+
+@pytest.fixture(scope="module")
+def rt():
+    return build_rule_table(compile_policy_set(list(parse_policies(POLICY))))
+
+
+def test_fast_programs_compile(rt):
+    ev = TpuEvaluator(rt, use_jax=False, min_device_batch=1)
+    specs = ev.lowered.compiler.preds
+    assert specs, "policy should produce host predicate columns"
+    fastpred.configure(_MISSING_SENTINEL, _ERR_SENTINEL)
+    compiled = [fastpred.compile_fast_pred(s) for s in specs]
+    assert all(p is not None for p in compiled), [
+        getattr(s.node, "fn", s.node) for s, p in zip(specs, compiled) if p is None
+    ]
+
+
+def test_program_matches_interpreter(rt):
+    ev = TpuEvaluator(rt, use_jax=False, min_device_batch=1)
+    pk = ev.packer
+    params = EvalParams()
+    inputs = _battery()
+    for spec in ev.lowered.compiler.preds:
+        prog = pk._fast_pred_prog(spec)
+        assert prog is not None
+        gathered = {
+            p: [pk._path_accessor(p)(inp) for inp in inputs] for p in prog.paths
+        }
+        v_list, e_list = prog.eval(gathered, len(inputs))
+        for i, inp in enumerate(inputs):
+            request, principal, resource = build_request_messages(inp)
+            ec = EvalContext(params, request, principal, resource)
+
+            def act_factory(pparams):
+                variables = ec.evaluate_variables(pparams.constants, pparams.ordered_variables)
+                return ec.activation(pparams.constants, variables)
+
+            want = evaluate_pred_host(spec, inp, act_factory)
+            got = (bool(v_list[i]) and not e_list[i], bool(e_list[i]))
+            assert got == want, (
+                f"pred {spec.pred_id} input {i} attrs "
+                f"p={inp.principal.attr} r={inp.resource.attr}: got {got} want {want}"
+            )
+
+
+def test_end_to_end_oracle_parity(rt):
+    ev = TpuEvaluator(rt, use_jax=False, min_device_batch=1)
+    params = EvalParams()
+    inputs = _battery()
+    outs = ev.check(inputs, params)
+    for inp, out in zip(inputs, outs):
+        oracle = check_input(rt, inp, params, None)
+        assert {a: e.effect for a, e in out.actions.items()} == {
+            a: e.effect for a, e in oracle.actions.items()
+        }, (inp.principal.attr, inp.resource.attr)
