@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import struct
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 
@@ -57,6 +57,52 @@ def split_key(key: int) -> tuple[int, int]:
 _TS_EPOCH = None
 
 
+def _days_from_civil(y: int, m: int, d: int) -> int:
+    """Days since 1970-01-01 for a proleptic-Gregorian civil date
+    (Howard Hinnant's civil_from_days inverse — pure int arithmetic)."""
+    y -= m <= 2
+    era = y // 400
+    yoe = y - era * 400
+    doy = (153 * (m - 3 if m > 2 else m + 9) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+_DAYS_IN_MONTH = (31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31)
+
+
+def _fast_iso_key(s: str) -> "Optional[tuple[int, int]]":
+    """Direct key for the exact 'YYYY-MM-DDTHH:MM:SSZ' form — the dominant
+    shape in request corpora. None (caller falls back to the CEL
+    conversion) for anything else, INCLUDING values the CEL function would
+    reject, so error behavior is identical. Equivalence with the generic
+    path is pinned by tests/test_fastpred.py::test_fast_iso_key."""
+    if (
+        len(s) != 20
+        or not s.isascii()
+        or s[4] != "-" or s[7] != "-" or s[10] != "T"
+        or s[13] != ":" or s[16] != ":" or s[19] != "Z"
+    ):
+        return None
+    ys, mos, ds, hs, mis, ss = s[0:4], s[5:7], s[8:10], s[11:13], s[14:16], s[17:19]
+    if not (
+        ys.isdigit() and mos.isdigit() and ds.isdigit()
+        and hs.isdigit() and mis.isdigit() and ss.isdigit()
+    ):
+        return None
+    y, mo, d = int(ys), int(mos), int(ds)
+    h, mi, sec = int(hs), int(mis), int(ss)
+    if not (1 <= y <= 9999 and 1 <= mo <= 12 and h < 24 and mi < 60 and sec < 60):
+        return None
+    dim = _DAYS_IN_MONTH[mo - 1]
+    if mo == 2 and (y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)):
+        dim = 29
+    if not (1 <= d <= dim):
+        return None
+    micros = (_days_from_civil(y, mo, d) * 86400 + h * 3600 + mi * 60 + sec) * 1_000_000
+    return split_key((micros + (1 << 63)) & ((1 << 64) - 1))
+
+
 def timestamp_key(v: Any) -> tuple[int, int]:
     """CEL-convertible timestamp value → order-preserving (hi, lo) i32 pair.
 
@@ -67,6 +113,11 @@ def timestamp_key(v: Any) -> tuple[int, int]:
     CEL function would reject."""
     global _TS_EPOCH
     import datetime as _dt
+
+    if type(v) is str:
+        k = _fast_iso_key(v)
+        if k is not None:
+            return k
 
     from ..cel.stdlib import _to_timestamp
 

@@ -1121,7 +1121,48 @@ class Packer:
                 act_inputs = [plan.input for _, plan in active]
                 act_ix = np.fromiter((bi for bi, _ in active), dtype=np.int64, count=len(active))
         na = len(active)
+
+        # one C pass over the batch for every fused path at once: the
+        # per-input attribute resolution (principal/resource objects, attr
+        # and jwt dicts) is shared by all P columns instead of repeated P
+        # times (encode_attr_columns_multi)
+        done: set = set()
+        if fused_ok and hasattr(native, "encode_attr_columns_multi") and act_inputs:
+            fused_paths = [p for p in paths if self._fused_mode(p) is not None]
+            if fused_paths:
+                P = len(fused_paths)
+                MT = np.zeros((P, na), dtype=np.uint8)
+                MH = np.zeros((P, na), dtype=np.int32)
+                ML = np.zeros((P, na), dtype=np.int32)
+                MS = np.zeros((P, na), dtype=np.int32)
+                MN = np.zeros((P, na), dtype=np.uint8)
+                native.encode_attr_columns_multi(
+                    act_inputs,
+                    [self._fused_mode(p) for p in fused_paths],
+                    interner.ids, _MISSING_SENTINEL, _ERR_SENTINEL,
+                    memoryview(MT), memoryview(MH), memoryview(ML),
+                    memoryview(MS), memoryview(MN),
+                )
+                for pi, p in enumerate(fused_paths):
+                    if all_active:
+                        t, h, l, s, nn = MT[pi], MH[pi], ML[pi], MS[pi], MN[pi]
+                    else:
+                        t = np.zeros(B, dtype=np.uint8)
+                        h = np.zeros(B, dtype=np.int32)
+                        l = np.zeros(B, dtype=np.int32)
+                        s = np.zeros(B, dtype=np.int32)
+                        nn = np.zeros(B, dtype=np.uint8)
+                        t[act_ix] = MT[pi]
+                        h[act_ix] = MH[pi]
+                        l[act_ix] = ML[pi]
+                        s[act_ix] = MS[pi]
+                        nn[act_ix] = MN[pi]
+                    self._store_scalar_column(cb, plans, p, t, h, l, s, nn)
+                    done.add(p)
+
         for p in paths:
+            if p in done:
+                continue
             t = np.zeros(B, dtype=np.uint8)
             h = np.zeros(B, dtype=np.int32)
             l = np.zeros(B, dtype=np.int32)
@@ -1164,18 +1205,22 @@ class Packer:
                     values, interner.ids, _MISSING_SENTINEL, _ERR_SENTINEL,
                     memoryview(t), memoryview(h), memoryview(l), memoryview(s), memoryview(nn),
                 )
-            trig = self.lt.fallback_tags.get(p)
-            if trig:
-                bad = np.isin(t, np.fromiter(trig, dtype=np.uint8))
-                if bad.any():
-                    for bi in np.nonzero(bad)[0]:
-                        plan = plans[int(bi)]
-                        if not (plan.trivial or plan.oracle):
-                            plan.oracle = True
-            cb.tags[p] = t.astype(np.int8)
-            cb.his[p], cb.los[p], cb.sids[p] = h, l, s
-            cb.nans[p] = nn.astype(bool)
+            self._store_scalar_column(cb, plans, p, t, h, l, s, nn)
 
+    def _store_scalar_column(self, cb: ColumnBatch, plans, p, t, h, l, s, nn) -> None:
+        """Fallback-tag oracle routing + dtype-normalized store of one
+        encoded scalar column."""
+        trig = self.lt.fallback_tags.get(p)
+        if trig:
+            bad = np.isin(t, np.fromiter(trig, dtype=np.uint8))
+            if bad.any():
+                for bi in np.nonzero(bad)[0]:
+                    plan = plans[int(bi)]
+                    if not (plan.trivial or plan.oracle):
+                        plan.oracle = True
+        cb.tags[p] = t.astype(np.int8)
+        cb.his[p], cb.los[p], cb.sids[p] = h, l, s
+        cb.nans[p] = nn.astype(bool)
 
     def _pred_key_accessors(self, spec):
         accs = self._pred_accessors.get(spec.pred_id)

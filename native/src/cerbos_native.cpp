@@ -1145,6 +1145,213 @@ PyObject* PyDecodeNodePool(PyObject*, PyObject* args) {
   return cache;
 }
 
+// encode_attr_columns_multi(inputs, specs, interner, missing, err,
+//                           tags_u8, hi_i32, lo_i32, sid_i32, nan_u8) -> None
+//
+// One pass over the batch for EVERY fused column path at once. specs is a
+// sequence of (mode, root, leaf) as in encode_attr_column; the output
+// buffers are row-major [P, n] matrices (row p = spec p). Each input's
+// principal / resource objects and their attr / jwt dicts are resolved
+// ONCE and shared by all specs, so the per-input Python attribute-access
+// overhead is paid once instead of P times (the packer's dominant
+// memo-cold cost; VERDICT r4 item 3).
+PyObject* PyEncodeAttrColumnsMulti(PyObject*, PyObject* args) {
+  PyObject* inputs;
+  PyObject* specs;
+  PyObject* interner;
+  PyObject* missing;
+  PyObject* err;
+  Py_buffer tags_b, hi_b, lo_b, sid_b, nan_b;
+  if (!PyArg_ParseTuple(args, "OOO!OOw*w*w*w*w*", &inputs, &specs,
+                        &PyDict_Type, &interner, &missing, &err, &tags_b,
+                        &hi_b, &lo_b, &sid_b, &nan_b)) {
+    return nullptr;
+  }
+  struct Bufs {
+    Py_buffer *a, *b, *c, *d, *e;
+    ~Bufs() {
+      PyBuffer_Release(a);
+      PyBuffer_Release(b);
+      PyBuffer_Release(c);
+      PyBuffer_Release(d);
+      PyBuffer_Release(e);
+    }
+  } release{&tags_b, &hi_b, &lo_b, &sid_b, &nan_b};
+
+  PyObject* seq = PySequence_Fast(inputs, "inputs must be a sequence");
+  if (!seq) return nullptr;
+  PyObject* spec_seq = PySequence_Fast(specs, "specs must be a sequence");
+  if (!spec_seq) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  Py_ssize_t P = PySequence_Fast_GET_SIZE(spec_seq);
+  if (tags_b.len < P * n || nan_b.len < P * n ||
+      hi_b.len < static_cast<Py_ssize_t>(P * n * 4) ||
+      lo_b.len < static_cast<Py_ssize_t>(P * n * 4) ||
+      sid_b.len < static_cast<Py_ssize_t>(P * n * 4)) {
+    Py_DECREF(spec_seq);
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "output buffers too small");
+    return nullptr;
+  }
+  uint8_t* tags = static_cast<uint8_t*>(tags_b.buf);
+  int32_t* hi = static_cast<int32_t*>(hi_b.buf);
+  int32_t* lo = static_cast<int32_t*>(lo_b.buf);
+  int32_t* sid = static_cast<int32_t*>(sid_b.buf);
+  uint8_t* nan = static_cast<uint8_t*>(nan_b.buf);
+
+  // spec table: mode, principal-or-resource flag, leaf object
+  struct Spec {
+    int mode;
+    bool principal;
+    PyObject* leaf;  // borrowed from spec tuple (spec_seq held)
+  };
+  std::vector<Spec> sp(static_cast<size_t>(P));
+  bool need_p = false, need_r = false, need_jwt = false;
+  bool need_p_attr = false, need_r_attr = false;
+  for (Py_ssize_t p = 0; p < P; p++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(spec_seq, p);
+    PyObject* mode_o;
+    PyObject* root_o;
+    PyObject* leaf_o;
+    if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3) {
+      Py_DECREF(spec_seq);
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_TypeError, "spec must be (mode, root, leaf)");
+      return nullptr;
+    }
+    mode_o = PyTuple_GET_ITEM(item, 0);
+    root_o = PyTuple_GET_ITEM(item, 1);
+    leaf_o = PyTuple_GET_ITEM(item, 2);
+    long mode = PyLong_AsLong(mode_o);
+    if (mode < 0 || mode > 2 || !PyUnicode_Check(root_o) ||
+        !PyUnicode_Check(leaf_o)) {
+      Py_DECREF(spec_seq);
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_ValueError, "bad spec entry");
+      return nullptr;
+    }
+    bool is_principal =
+        PyUnicode_CompareWithASCIIString(root_o, "principal") == 0;
+    sp[static_cast<size_t>(p)] = {static_cast<int>(mode), is_principal, leaf_o};
+    if (mode == 1) {
+      need_jwt = true;
+    } else if (is_principal) {
+      need_p = true;
+      if (mode == 0) need_p_attr = true;
+    } else {
+      need_r = true;
+      if (mode == 0) need_r_attr = true;
+    }
+  }
+
+  static PyObject* attr_name = nullptr;
+  static PyObject* aux_name = nullptr;
+  static PyObject* jwt_name = nullptr;
+  static PyObject* principal_name = nullptr;
+  static PyObject* resource_name = nullptr;
+  if (!attr_name) attr_name = PyUnicode_InternFromString("attr");
+  if (!aux_name) aux_name = PyUnicode_InternFromString("aux_data");
+  if (!jwt_name) jwt_name = PyUnicode_InternFromString("jwt");
+  if (!principal_name) principal_name = PyUnicode_InternFromString("principal");
+  if (!resource_name) resource_name = PyUnicode_InternFromString("resource");
+
+  bool fail = false;
+  for (Py_ssize_t i = 0; i < n && !fail; i++) {
+    PyObject* inp = PySequence_Fast_GET_ITEM(seq, i);
+    // resolve shared roots once per input (owned refs, may stay null)
+    PyObject* p_obj = nullptr;
+    PyObject* r_obj = nullptr;
+    PyObject* p_attr = nullptr;
+    PyObject* r_attr = nullptr;
+    PyObject* jwt = nullptr;
+    if (need_p) {
+      p_obj = PyObject_GetAttr(inp, principal_name);
+      if (!p_obj) PyErr_Clear();
+      if (need_p_attr && p_obj) {
+        p_attr = PyObject_GetAttr(p_obj, attr_name);
+        if (!p_attr) PyErr_Clear();
+        if (p_attr && !PyDict_Check(p_attr)) Py_CLEAR(p_attr);
+      }
+    }
+    if (need_r) {
+      r_obj = PyObject_GetAttr(inp, resource_name);
+      if (!r_obj) PyErr_Clear();
+      if (need_r_attr && r_obj) {
+        r_attr = PyObject_GetAttr(r_obj, attr_name);
+        if (!r_attr) PyErr_Clear();
+        if (r_attr && !PyDict_Check(r_attr)) Py_CLEAR(r_attr);
+      }
+    }
+    if (need_jwt) {
+      PyObject* aux = PyObject_GetAttr(inp, aux_name);
+      if (!aux) {
+        PyErr_Clear();
+      } else {
+        if (aux != Py_None) {
+          jwt = PyObject_GetAttr(aux, jwt_name);
+          if (!jwt) PyErr_Clear();
+          if (jwt && !PyDict_Check(jwt)) Py_CLEAR(jwt);
+        }
+        Py_DECREF(aux);
+      }
+    }
+
+    for (Py_ssize_t p = 0; p < P && !fail; p++) {
+      const Spec& s = sp[static_cast<size_t>(p)];
+      PyObject* v = nullptr;  // owned
+      if (s.mode == 0) {
+        PyObject* d = s.principal ? p_attr : r_attr;
+        if (d) {
+          PyObject* got = PyDict_GetItemWithError(d, s.leaf);  // borrowed
+          if (got) {
+            Py_INCREF(got);
+            v = got;
+          } else if (PyErr_Occurred()) {
+            PyErr_Clear();
+          }
+        }
+      } else if (s.mode == 1) {
+        if (jwt) {
+          PyObject* got = PyDict_GetItemWithError(jwt, s.leaf);
+          if (got) {
+            Py_INCREF(got);
+            v = got;
+          } else if (PyErr_Occurred()) {
+            PyErr_Clear();
+          }
+        }
+      } else {
+        PyObject* obj = s.principal ? p_obj : r_obj;
+        if (obj) {
+          v = PyObject_GetAttr(obj, s.leaf);
+          if (!v) PyErr_Clear();
+        }
+      }
+      if (!v) {
+        Py_INCREF(missing);
+        v = missing;
+      }
+      Py_ssize_t at = p * n + i;
+      int rc = EncodeOne(v, interner, missing, err, at, tags, hi, lo, sid, nan);
+      Py_DECREF(v);
+      if (rc < 0) fail = true;
+    }
+
+    Py_XDECREF(p_obj);
+    Py_XDECREF(r_obj);
+    Py_XDECREF(p_attr);
+    Py_XDECREF(r_attr);
+    Py_XDECREF(jwt);
+  }
+  Py_DECREF(spec_seq);
+  Py_DECREF(seq);
+  if (fail) return nullptr;
+  Py_RETURN_NONE;
+}
+
 PyMethodDef kMethods[] = {
     {"glob_match", PyGlobMatch, METH_VARARGS,
      "glob_match(pattern, value) -> bool — gobwas-style glob with ':' separator"},
@@ -1157,6 +1364,9 @@ PyMethodDef kMethods[] = {
     {"encode_attr_column", PyEncodeAttrColumn, METH_VARARGS,
      "encode_attr_column(inputs, mode, root, leaf, interner, missing, err, "
      "tags, hi, lo, sid, nan) — fused gather + encode"},
+    {"encode_attr_columns_multi", PyEncodeAttrColumnsMulti, METH_VARARGS,
+     "encode_attr_columns_multi(inputs, specs, interner, missing, err, "
+     "tags[P,n], hi, lo, sid, nan) — all fused columns in one batch pass"},
     {"encode_list_column", PyEncodeListColumn, METH_VARARGS,
      "encode_list_column(inputs, mode, root, leaf, interner, missing, state) "
      "-> (width, sids_bytes) — fused gather + intern for string lists"},

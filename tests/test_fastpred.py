@@ -172,6 +172,54 @@ def test_program_matches_interpreter(rt):
             )
 
 
+def test_fast_iso_key():
+    """_fast_iso_key must agree with the generic CEL conversion wherever it
+    claims a result, and decline (None) anything the generic path rejects."""
+    import random
+
+    from cerbos_tpu.cel.errors import CelError
+    from cerbos_tpu.tpu.columns import _fast_iso_key, timestamp_key
+    from cerbos_tpu.cel.stdlib import _to_timestamp
+
+    def generic_key(s):
+        import datetime as dt
+
+        ts = _to_timestamp(s)
+        epoch = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+        micros = (ts - epoch) // dt.timedelta(microseconds=1)
+        from cerbos_tpu.tpu.columns import split_key
+
+        return split_key((micros + (1 << 63)) & ((1 << 64) - 1))
+
+    rng = random.Random(42)
+    cases = [
+        "1970-01-01T00:00:00Z", "2000-02-29T23:59:59Z", "1900-02-28T12:00:00Z",
+        "9999-12-31T23:59:59Z", "0001-01-01T00:00:00Z", "2026-07-29T10:11:12Z",
+    ]
+    for _ in range(300):
+        y, mo, d = rng.randint(1, 9999), rng.randint(1, 12), rng.randint(1, 28)
+        h, mi, s = rng.randint(0, 23), rng.randint(0, 59), rng.randint(0, 59)
+        cases.append(f"{y:04d}-{mo:02d}-{d:02d}T{h:02d}:{mi:02d}:{s:02d}Z")
+    for s in cases:
+        assert _fast_iso_key(s) == generic_key(s), s
+
+    # invalid or out-of-shape values must decline so the generic error path runs
+    bad = [
+        "2026-13-01T00:00:00Z", "2026-02-30T00:00:00Z", "2026-01-01T24:00:00Z",
+        "2026-01-01T00:60:00Z", "2026-01-01T00:00:60Z", "0000-01-01T00:00:00Z",
+        "2026-1-01T00:00:00Z", "2026-01-01 00:00:00Z", "2026-01-01T00:00:00",
+        "2026-01-01T00:00:00+00:00", "٢٠٢٦-01-01T00:00:00Z", "2026-01-01T00:00:00.5Z",
+    ]
+    for s in bad:
+        assert _fast_iso_key(s) is None, s
+    # and the full timestamp_key must keep raising on genuinely bad values
+    import pytest as _pytest
+
+    for s in ("2026-13-01T00:00:00Z", "garbage"):
+        with _pytest.raises((CelError, ValueError)):
+            timestamp_key(s)
+
+
 def test_end_to_end_oracle_parity(rt):
     ev = TpuEvaluator(rt, use_jax=False, min_device_batch=1)
     params = EvalParams()

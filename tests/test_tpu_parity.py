@@ -623,3 +623,30 @@ def test_fuzz_timestamp_string_list_parity(mode):
         ))
     ev = assert_parity(rt, inputs, params=params, mode=mode)
     assert ev.stats["device_inputs"] >= 150, ev.stats
+
+
+def test_submit_collect_matches_check():
+    """Streaming submit/collect must return exactly what check() returns,
+    in order, with overlapping in-flight batches."""
+    from cerbos_tpu.compile import compile_policy_set
+    from cerbos_tpu.engine import EvalParams
+    from cerbos_tpu.policy.parser import parse_policies
+    from cerbos_tpu.ruletable import build_rule_table
+    from cerbos_tpu.tpu import TpuEvaluator
+    from cerbos_tpu.util import bench_corpus
+
+    rt = build_rule_table(compile_policy_set(list(parse_policies(bench_corpus.corpus_yaml(2)))))
+    params = EvalParams()
+    ev = TpuEvaluator(rt, use_jax=True, min_device_batch=4)
+    batches = [bench_corpus.requests_unique(32, 2, seed=s) for s in (1, 2, 3, 4)]
+    want = [ev.check(b, params) for b in batches]
+    tickets = [ev.submit(b, params) for b in batches]  # all in flight at once
+    got = [ev.collect(t) for t in tickets]
+    for wb, gb in zip(want, got):
+        for w, g in zip(wb, gb):
+            assert w.resource_id == g.resource_id
+            assert {a: e.effect for a, e in w.actions.items()} == {
+                a: e.effect for a, e in g.actions.items()
+            }
+    # collect is idempotent
+    assert ev.collect(tickets[0]) is got[0]
