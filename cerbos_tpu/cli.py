@@ -185,7 +185,13 @@ def cmd_compile(args: argparse.Namespace) -> int:
     except (BuildError, CompileError) as e:
         errors = getattr(e, "errors", [str(e)])
         if args.output == "json":
-            print(json.dumps({"errors": errors}, indent=2))
+            details = getattr(e, "details", None)
+            if details:
+                # structured position/path details (the reference's
+                # CompileErrors proto shape), not just rendered strings
+                print(json.dumps({"errors": [d.to_dict() for d in details]}, indent=2))
+            else:
+                print(json.dumps({"errors": errors}, indent=2))
         else:
             for err in errors:
                 print(f"ERROR: {err}", file=sys.stderr)

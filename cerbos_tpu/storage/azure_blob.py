@@ -14,6 +14,7 @@ import base64
 import datetime
 import hashlib
 import hmac
+import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
@@ -50,7 +51,11 @@ def shared_key_signature(
     )
     canonical_resource = f"/{account}{path}"
     for name in sorted(query):
-        canonical_resource += f"\n{name.lower()}:{query[name]}"
+        v = query[name]
+        # spec: multi-valued params join their sorted values with commas
+        if isinstance(v, (list, tuple)):
+            v = ",".join(sorted(v))
+        canonical_resource += f"\n{name.lower()}:{v}"
     string_to_sign = "\n".join(
         [
             verb,

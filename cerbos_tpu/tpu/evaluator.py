@@ -867,11 +867,7 @@ class TpuEvaluator:
         # split oversized batches along the same chunk boundaries as
         # check(), so streaming reuses the already-traced shape buckets
         # instead of compiling a monolithic one
-        chunk = self.pipeline_chunk if self.pipeline_chunk > 0 else len(inputs)
-        chunks = [inputs[b : b + chunk] for b in range(0, len(inputs), chunk)]
-        if len(chunks) > 1 and len(chunks[-1]) < self.min_device_batch:
-            chunks[-2] = chunks[-2] + chunks[-1]
-            chunks.pop()
+        chunks = self._chunk_inputs(inputs)
         t.parts = []
         for ch in chunks:
             batch = self.packer.pack(ch, params)
@@ -889,6 +885,18 @@ class TpuEvaluator:
         ticket.parts = None
         return out
 
+    def _chunk_inputs(self, inputs: list[T.CheckInput]) -> list[list[T.CheckInput]]:
+        """Pipeline-chunk boundaries shared by check() and submit(): fixed
+        pipeline_chunk-sized slices, with a tail smaller than the device
+        threshold riding with its neighbor rather than paying a dispatch
+        (or an oracle walk) of its own."""
+        chunk = self.pipeline_chunk if self.pipeline_chunk > 0 else len(inputs)
+        chunks = [inputs[b : b + chunk] for b in range(0, len(inputs), chunk)]
+        if len(chunks) > 1 and len(chunks[-1]) < self.min_device_batch:
+            chunks[-2] = chunks[-2] + chunks[-1]
+            chunks.pop()
+        return chunks
+
     def _check_pipelined(self, inputs: list[T.CheckInput], params: T.EvalParams) -> list[T.CheckOutput]:
         """Chunked double-buffered device pipeline (VERDICT r4 item 1).
 
@@ -901,14 +909,7 @@ class TpuEvaluator:
         packing. Wall-clock approaches max(host work, device work) instead
         of their sum."""
         outputs: list[T.CheckOutput] = []
-        chunk = self.pipeline_chunk
-        bounds = list(range(0, len(inputs), chunk))
-        chunks = [inputs[b : b + chunk] for b in bounds]
-        # a tail smaller than the device threshold rides with its neighbor
-        # rather than paying a dispatch (or an oracle walk) of its own
-        if len(chunks) > 1 and len(chunks[-1]) < self.min_device_batch:
-            chunks[-2] = chunks[-2] + chunks[-1]
-            chunks.pop()
+        chunks = self._chunk_inputs(inputs)
         inflight: list[tuple[PackedBatch, _DeviceHandle]] = []
         for ci, ch in enumerate(chunks):
             batch = self.packer.pack(ch, params)

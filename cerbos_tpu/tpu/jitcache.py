@@ -48,6 +48,14 @@ def enable() -> str | None:
         import jax
     except Exception:  # pragma: no cover - jax is a hard dep in practice
         return None
+    # respect an embedding application's own cache configuration: only
+    # install ours when nothing is configured yet
+    try:
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            _enabled = True
+            return None
+    except Exception:
+        pass
     for cand in _candidate_dirs():
         try:
             cand.mkdir(parents=True, exist_ok=True)

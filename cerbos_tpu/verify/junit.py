@@ -139,6 +139,12 @@ def _outputs_el(parent: _XML, outputs: list[dict], success: bool) -> None:
                 # Go's output struct marshals <actual> unconditionally
                 act_el = el.child(_XML("actual"))
                 act_el.cdata = ""
+            else:
+                # outcome oneof unset: junit.go's output struct has
+                # non-pointer fields, so empty <expected/> and <actual/>
+                # are still marshalled
+                el.child(_XML("expected")).cdata = ""
+                el.child(_XML("actual")).cdata = ""
 
 
 def build(results: dict, verbose: bool) -> str:
