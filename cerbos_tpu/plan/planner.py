@@ -117,6 +117,7 @@ class Planner:
             include_meta=input.include_meta,
         )
         if not p_scopes and not r_scopes:
+            output.policy_match = False
             return output
 
         # schema validation of the principal (resource attrs are partial)
@@ -142,8 +143,9 @@ class Planner:
         action_filters: list[tuple[str, Optional[Any]]] = []
         dr_lists: dict[str, Any] = {}  # scope → derived-roles list, shared across actions
         effective_policies: dict[str, dict] = {}
+        any_match = False
         for action in dict.fromkeys(input.actions):
-            node, matched_scope = self._plan_action(
+            node, matched_scope, matched = self._plan_action(
                 pe, input, params, action, sanitized, resource_version, resource_scope, p_scopes, r_scopes, dr_lists,
                 effective_policies,
             )
@@ -154,8 +156,10 @@ class Planner:
             else:
                 action_filters.append(normalise_filter(KIND_CONDITIONAL, ast_to_operand(node)))
             output.matched_scopes[action] = matched_scope
+            any_match = any_match or matched
 
         output.kind, output.condition = merge_with_and(action_filters)
+        output.policy_match = any_match
         output.effective_policies = {
             namer.policy_key_from_fqn(f): attrs for f, attrs in effective_policies.items()
         }
@@ -382,13 +386,14 @@ class Planner:
                 inv = invert(pt_deny)
                 root = inv if root is None else and2(inv, root)
 
+        matched = root is not None
         if root is None or not has_pt_allow:
-            return FALSE, matched_scope
+            return FALSE, matched_scope, matched
         if is_true(root):
-            return TRUE, matched_scope
+            return TRUE, matched_scope, matched
         if is_false(root):
-            return FALSE, matched_scope
-        return to_node(root), matched_scope
+            return FALSE, matched_scope, matched
+        return to_node(root), matched_scope, matched
 
     def _pe_for(self, pe_factory, known, params_obj, drl) -> PartialEvaluator:
         var_defs = {}

@@ -90,6 +90,9 @@ class PlanOutput:
     matched_scopes: dict[str, str] = field(default_factory=dict)
     validation_errors: list[T.ValidationError] = field(default_factory=list)
     include_meta: bool = False
+    # False when NO policy produced a node for any action (plan.go:380-390:
+    # FilterDebug reads NO_MATCH instead of the filter string)
+    policy_match: bool = True
     # policy key -> source attributes for every queried binding's chain
     # (plan.go: effectivePolicies in the audit trail)
     effective_policies: dict[str, dict] = field(default_factory=dict)
@@ -106,8 +109,16 @@ class PlanOutput:
             "filter": filter_j,
         }
         if self.include_meta:
+            if not self.policy_match:
+                debug = "NO_MATCH"  # plan.go noPolicyMatch
+            elif self.kind == KIND_ALWAYS_ALLOWED:
+                debug = "(true)"  # planner/ast.go FilterToString
+            elif self.kind == KIND_ALWAYS_DENIED:
+                debug = "(false)"
+            else:
+                debug = self.condition.debug_str() if self.condition is not None else self.kind
             out["meta"] = {
-                "filterDebug": self.condition.debug_str() if self.condition is not None else self.kind,
+                "filterDebug": debug,
                 "matchedScopes": self.matched_scopes,
             }
         if self.validation_errors:

@@ -18,6 +18,21 @@ from .engine import types as T
 from .policy import model
 from .storage.store import Event, Store
 
+
+def _error_message(err: "jsonschema.ValidationError") -> str:
+    """Validation message in the reference's wording where it differs.
+
+    The reference validates with santhosh-tekuri/jsonschema; its messages
+    are part of the wire response (server corpus pins them). Translate the
+    shapes that appear in practice; anything else keeps python-jsonschema's
+    phrasing."""
+    if err.validator == "enum":
+        import json as _json
+
+        allowed = ", ".join(_json.dumps(v) for v in err.validator_value)
+        return f"value must be one of {allowed}"
+    return err.message
+
 ENFORCEMENT_NONE = "none"
 ENFORCEMENT_WARN = "warn"
 ENFORCEMENT_REJECT = "reject"
@@ -51,19 +66,35 @@ class SchemaManager:
         self._cache[ref] = validator
         return validator
 
-    def _validate(self, ref: str, attrs: dict[str, Any], source: str, errors: list[T.ValidationError]) -> None:
+    def _validate(
+        self,
+        ref: str,
+        attrs: dict[str, Any],
+        source: str,
+        errors: list[T.ValidationError],
+        ignore_required: bool = False,
+    ) -> None:
         validator = self._validator(ref)
         if validator is None:
             errors.append(T.ValidationError(path="", message=f"failed to load schema {ref}", source=source))
             return
         for err in validator.iter_errors(attrs):
+            if ignore_required and err.validator == "required":
+                continue
             path = "/" + "/".join(str(p) for p in err.absolute_path)
-            errors.append(T.ValidationError(path=path, message=err.message, source=source))
+            errors.append(T.ValidationError(path=path, message=_error_message(err), source=source))
 
     def validate_check_input(
-        self, schemas: Optional[model.Schemas], input: T.CheckInput, principal_only: bool = False
+        self,
+        schemas: Optional[model.Schemas],
+        input: T.CheckInput,
+        principal_only: bool = False,
+        resource_ignore_required: bool = False,
     ) -> tuple[list[T.ValidationError], bool]:
-        """→ (errors, reject). Ref: schema.go ValidateCheckInput."""
+        """→ (errors, reject). Ref: schema.go ValidateCheckInput;
+        ``resource_ignore_required`` mirrors ValidatePlanResourcesInput
+        (schema_common.go:157-162): resource attributes are optional when
+        planning, so required-property errors are filtered."""
         if self.enforcement == ENFORCEMENT_NONE or schemas is None:
             return [], False
         errors: list[T.ValidationError] = []
@@ -72,7 +103,10 @@ class SchemaManager:
                 self._validate(schemas.principal_schema.ref, input.principal.attr, "SOURCE_PRINCIPAL", errors)
         if not principal_only and schemas.resource_schema is not None and schemas.resource_schema.ref:
             if not self._ignored(schemas.resource_schema, input.actions):
-                self._validate(schemas.resource_schema.ref, input.resource.attr, "SOURCE_RESOURCE", errors)
+                self._validate(
+                    schemas.resource_schema.ref, input.resource.attr, "SOURCE_RESOURCE", errors,
+                    ignore_required=resource_ignore_required,
+                )
         reject = bool(errors) and self.enforcement == ENFORCEMENT_REJECT
         return errors, reject
 

@@ -21,7 +21,7 @@ import grpc
 from aiohttp import web
 
 from ..engine import types as T
-from . import convert
+from . import convert, wire_validate
 from .service import CerbosService, RequestLimitExceeded
 
 
@@ -217,6 +217,9 @@ def _grpc_rpcs(svc: CerbosService):
     from ..api.cerbos.response.v1 import response_pb2
 
     def check_resources(req: request_pb2.CheckResourcesRequest, ctx: grpc.ServicerContext):
+        verr = wire_validate.check_resources_proto(req)
+        if verr:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, verr)
         try:
             aux = None
             if req.HasField("aux_data") and req.aux_data.jwt.token:
@@ -230,6 +233,9 @@ def _grpc_rpcs(svc: CerbosService):
             ctx.abort(grpc.StatusCode.INTERNAL, f"check failed: {e}")
 
     def plan_resources(req: request_pb2.PlanResourcesRequest, ctx: grpc.ServicerContext):
+        verr = wire_validate.plan_resources_proto(req)
+        if verr:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, verr)
         try:
             aux = None
             if req.HasField("aux_data") and req.aux_data.jwt.token:
@@ -269,6 +275,9 @@ def _grpc_rpcs(svc: CerbosService):
     def check_resource_set(req: request_pb2.CheckResourceSetRequest, ctx: grpc.ServicerContext):
         if not req.resource.instances:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "at least one resource instance must be specified")
+        verr = wire_validate.check_resource_set_proto(req)
+        if verr:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, verr)
         try:
             aux = None
             if req.HasField("aux_data") and req.aux_data.jwt.token:
@@ -318,6 +327,9 @@ def _grpc_rpcs(svc: CerbosService):
     def check_resource_batch(req: request_pb2.CheckResourceBatchRequest, ctx: grpc.ServicerContext):
         if not req.resources:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "at least one resource must be specified")
+        verr = wire_validate.check_resource_batch_proto(req)
+        if verr:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, verr)
         try:
             aux = None
             if req.HasField("aux_data") and req.aux_data.jwt.token:
@@ -389,7 +401,10 @@ def _plan_from_json(svc: CerbosService, body: dict, aux: Optional[T.AuxData]) ->
 
     pj = body.get("principal") or {}
     rj = body.get("resource") or {}
-    actions = list(body.get("actions") or ([] if not body.get("action") else [body["action"]]))
+    # the deprecated singular `action` wins over `actions` and flips the
+    # response to the singular field shape (cerbos_svc.go PlanResources)
+    one_action = body.get("action") or ""
+    actions = [one_action] if one_action else list(body.get("actions") or [])
     plan_input = PlanInput(
         request_id=body.get("requestId", ""),
         actions=actions,
@@ -408,7 +423,16 @@ def _plan_from_json(svc: CerbosService, body: dict, aux: Optional[T.AuxData]) ->
         include_meta=bool(body.get("includeMeta", False)),
     )
     output, call_id = svc.plan_resources(plan_input)
-    return output.to_json(call_id), call_id
+    j = output.to_json(call_id)
+    if one_action:
+        j.pop("actions", None)
+        j["action"] = one_action
+        meta = j.get("meta")
+        if meta is not None:
+            scopes = meta.pop("matchedScopes", {}) or {}
+            if scopes.get(one_action):
+                meta["matchedScope"] = scopes[one_action]
+    return j, call_id
 
 
 def _plan_json_to_proto(j: dict, response_pb2):
@@ -522,6 +546,8 @@ class Server:
         app.router.add_post("/api/plan/resources", self._h_plan_resources)
         # deprecated APIs kept for older SDKs (ref: cerbos_svc.go:123-252)
         app.router.add_post("/api/check", self._h_check_resource_set)
+        app.router.add_post("/api/check_resource_batch", self._h_check_resource_batch)
+        # legacy alias kept for clients that used the pre-parity route
         app.router.add_post("/api/x/check_resource_batch", self._h_check_resource_batch)
         app.router.add_get("/_cerbos/health", self._h_health)
         app.router.add_get("/_cerbos/metrics", self._h_metrics)
@@ -579,6 +605,9 @@ class Server:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        verr = wire_validate.check_resources_body(body)
+        if verr:
+            return web.json_response({"code": 3, "message": verr}, status=400)
         try:
             aux = None
             aux_j = (body.get("auxData") or {}).get("jwt") or {}
@@ -603,6 +632,9 @@ class Server:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        verr = wire_validate.check_resource_set_body(body)
+        if verr:
+            return web.json_response({"code": 3, "message": verr}, status=400)
         try:
             rs = body.get("resource") or {}
             instances = rs.get("instances") or {}
@@ -635,9 +667,13 @@ class Server:
             )
             resource_instances = {}
             for entry, out in zip(inner["resources"], outputs):
-                resource_instances[entry["resource"]["id"]] = {
-                    "actions": {a: ae.effect for a, ae in out.actions.items()}
-                }
+                inst: dict = {"actions": {a: ae.effect for a, ae in out.actions.items()}}
+                if out.validation_errors:
+                    inst["validationErrors"] = [
+                        {"path": v.path, "message": v.message, "source": v.source}
+                        for v in out.validation_errors
+                    ]
+                resource_instances[entry["resource"]["id"]] = inst
             resp: dict = {"requestId": request_id, "resourceInstances": resource_instances, "cerbosCallId": call_id}
             if include_meta:
                 resp["meta"] = {
@@ -664,6 +700,9 @@ class Server:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        verr = wire_validate.check_resource_batch_body(body)
+        if verr:
+            return web.json_response({"code": 3, "message": verr}, status=400)
         try:
             aux = None
             aux_j = (body.get("auxData") or {}).get("jwt") or {}
@@ -700,6 +739,9 @@ class Server:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        verr = wire_validate.plan_resources_body(body)
+        if verr:
+            return web.json_response({"code": 3, "message": verr}, status=400)
         try:
             aux = None
             aux_j = (body.get("auxData") or {}).get("jwt") or {}

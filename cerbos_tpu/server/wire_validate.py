@@ -1,0 +1,259 @@
+"""Request validation mirroring the reference's protovalidate annotations.
+
+The reference validates every request against buf.validate constraints in
+`api/public/cerbos/request/v1/request.proto` and `engine/v1/engine.proto`
+via a protovalidate interceptor (server.go:358-393); violations surface as
+HTTP 400 / gRPC INVALID_ARGUMENT before the service layer runs. This module
+implements the same constraints over the protojson dict bodies (HTTP
+surface) and the request protos (gRPC surface).
+
+Returns an error message (str) or None.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+_VERSION_RE = re.compile(r"^[\w]*$")
+_SCOPE_RE = re.compile(r"^(^$|\.|[0-9a-zA-Z][\w\-]*(\.\w[\w\-]*)*)$")
+
+
+def _check_actions(actions, field: str, required: bool = True, max_items: int = 0) -> Optional[str]:
+    if not actions:
+        if required:
+            return f"{field}: value is required and must contain at least one item"
+        return None
+    if not isinstance(actions, (list, tuple)):
+        return f"{field}: must be a list"
+    if max_items and len(actions) > max_items:
+        return f"{field}: must contain at most {max_items} items"
+    seen = set()
+    for a in actions:
+        if not isinstance(a, str) or len(a) < 1:
+            return f"{field}: items must be non-empty strings"
+        if a in seen:
+            return f"{field}: items must be unique"
+        seen.add(a)
+    return None
+
+
+def _check_principal(p) -> Optional[str]:
+    if not p:
+        return "principal: value is required"
+    get = p.get if isinstance(p, dict) else lambda k, d="": getattr(p, _SNAKE.get(k, k), d)
+    if not get("id"):
+        return "principal.id: value length must be at least 1"
+    err = _check_actions(list(get("roles", []) or []), "principal.roles")
+    if err:
+        return err
+    if not _VERSION_RE.match(get("policyVersion", "") or ""):
+        return "principal.policyVersion: must match ^[\\w]*$"
+    if not _SCOPE_RE.match(get("scope", "") or ""):
+        return "principal.scope: invalid scope"
+    return None
+
+
+_SNAKE = {"policyVersion": "policy_version"}
+
+
+def _check_resource(r, *, need_id: bool = True) -> Optional[str]:
+    if not r:
+        return "resource: value is required"
+    get = r.get if isinstance(r, dict) else lambda k, d="": getattr(r, _SNAKE.get(k, k), d)
+    if not get("kind"):
+        return "resource.kind: value length must be at least 1"
+    if need_id and not get("id"):
+        return "resource.id: value length must be at least 1"
+    if not _VERSION_RE.match(get("policyVersion", "") or ""):
+        return "resource.policyVersion: must match ^[\\w]*$"
+    if not _SCOPE_RE.match(get("scope", "") or ""):
+        return "resource.scope: invalid scope"
+    return None
+
+
+def check_resources_body(body: dict) -> Optional[str]:
+    err = _check_principal(body.get("principal"))
+    if err:
+        return err
+    resources = body.get("resources")
+    if not resources:
+        return "resources: value is required and must contain at least one item"
+    for i, entry in enumerate(resources):
+        entry = entry or {}
+        err = _check_actions(entry.get("actions"), f"resources[{i}].actions")
+        if err:
+            return err
+        err = _check_resource(entry.get("resource"))
+        if err:
+            return f"resources[{i}].{err}"
+    return None
+
+
+def check_resource_set_body(body: dict) -> Optional[str]:
+    err = _check_actions(body.get("actions"), "actions")
+    if err:
+        return err
+    err = _check_principal(body.get("principal"))
+    if err:
+        return err
+    rs = body.get("resource")
+    if not rs:
+        return "resource: value is required"
+    if not rs.get("kind"):
+        return "resource.kind: value length must be at least 1"
+    if not _VERSION_RE.match(rs.get("policyVersion", "") or ""):
+        return "resource.policyVersion: must match ^[\\w]*$"
+    if not _SCOPE_RE.match(rs.get("scope", "") or ""):
+        return "resource.scope: invalid scope"
+    if not rs.get("instances"):
+        return "resource.instances: must contain at least one entry"
+    return None
+
+
+def check_resource_batch_body(body: dict) -> Optional[str]:
+    err = _check_principal(body.get("principal"))
+    if err:
+        return err
+    resources = body.get("resources")
+    if not resources:
+        return "resources: value is required and must contain at least one item"
+    for i, entry in enumerate(resources):
+        entry = entry or {}
+        err = _check_actions(entry.get("actions"), f"resources[{i}].actions")
+        if err:
+            return err
+        err = _check_resource(entry.get("resource"))
+        if err:
+            return f"resources[{i}].{err}"
+    return None
+
+
+def plan_resources_body(body: dict) -> Optional[str]:
+    one = body.get("action") or ""
+    many = body.get("actions") or []
+    # exactly one of action / actions (request.proto exclusiveFieldsActionOrActions)
+    if bool(one) == bool(many):
+        return "exactly one of 'action' or 'actions' field must be set"
+    if many:
+        err = _check_actions(many, "actions", max_items=20)
+        if err:
+            return err
+    err = _check_principal(body.get("principal"))
+    if err:
+        return err
+    err = _check_resource(body.get("resource"), need_id=False)
+    if err:
+        return err
+    return None
+
+
+# -- proto variants (gRPC surface) ------------------------------------------
+
+
+def _proto_principal(p) -> Optional[str]:
+    if p is None or not p.id:
+        # an unset proto message has empty id; both violate `required`+min_len
+        return "principal.id: value length must be at least 1"
+    err = _check_actions(list(p.roles), "principal.roles")
+    if err:
+        return err
+    if not _VERSION_RE.match(p.policy_version):
+        return "principal.policyVersion: must match ^[\\w]*$"
+    if not _SCOPE_RE.match(p.scope):
+        return "principal.scope: invalid scope"
+    return None
+
+
+def _proto_resource(r, *, need_id: bool = True) -> Optional[str]:
+    if r is None or not r.kind:
+        return "resource.kind: value length must be at least 1"
+    if need_id and not r.id:
+        return "resource.id: value length must be at least 1"
+    if not _VERSION_RE.match(r.policy_version):
+        return "resource.policyVersion: must match ^[\\w]*$"
+    if not _SCOPE_RE.match(r.scope):
+        return "resource.scope: invalid scope"
+    return None
+
+
+def check_resources_proto(req) -> Optional[str]:
+    if not req.HasField("principal"):
+        return "principal: value is required"
+    err = _proto_principal(req.principal)
+    if err:
+        return err
+    if not req.resources:
+        return "resources: value is required and must contain at least one item"
+    for i, entry in enumerate(req.resources):
+        err = _check_actions(list(entry.actions), f"resources[{i}].actions")
+        if err:
+            return err
+        if not entry.HasField("resource"):
+            return f"resources[{i}].resource: value is required"
+        err = _proto_resource(entry.resource)
+        if err:
+            return f"resources[{i}].{err}"
+    return None
+
+
+def check_resource_set_proto(req) -> Optional[str]:
+    err = _check_actions(list(req.actions), "actions")
+    if err:
+        return err
+    if not req.HasField("principal"):
+        return "principal: value is required"
+    err = _proto_principal(req.principal)
+    if err:
+        return err
+    if not req.HasField("resource") or not req.resource.kind:
+        return "resource.kind: value length must be at least 1"
+    if not _VERSION_RE.match(req.resource.policy_version):
+        return "resource.policyVersion: must match ^[\\w]*$"
+    if not _SCOPE_RE.match(req.resource.scope):
+        return "resource.scope: invalid scope"
+    if not req.resource.instances:
+        return "resource.instances: must contain at least one entry"
+    return None
+
+
+def check_resource_batch_proto(req) -> Optional[str]:
+    if not req.HasField("principal"):
+        return "principal: value is required"
+    err = _proto_principal(req.principal)
+    if err:
+        return err
+    if not req.resources:
+        return "resources: value is required and must contain at least one item"
+    for i, entry in enumerate(req.resources):
+        err = _check_actions(list(entry.actions), f"resources[{i}].actions")
+        if err:
+            return err
+        if not entry.HasField("resource"):
+            return f"resources[{i}].resource: value is required"
+        err = _proto_resource(entry.resource)
+        if err:
+            return f"resources[{i}].{err}"
+    return None
+
+
+def plan_resources_proto(req) -> Optional[str]:
+    one = req.action
+    many = list(req.actions)
+    if bool(one) == bool(many):
+        return "exactly one of 'action' or 'actions' field must be set"
+    if many:
+        err = _check_actions(many, "actions", max_items=20)
+        if err:
+            return err
+    if not req.HasField("principal"):
+        return "principal: value is required"
+    err = _proto_principal(req.principal)
+    if err:
+        return err
+    if not req.HasField("resource"):
+        return "resource: value is required"
+    err = _proto_resource(req.resource, need_id=False)
+    if err:
+        return err
+    return None
