@@ -24,8 +24,10 @@ final JSON line, so the artifact always shows whether a chip was reachable
 and, if not, exactly how each spaced attempt failed.
 """
 
+import argparse
 import json
 import statistics
+import sys
 import time
 
 from cerbos_tpu.compile import compile_policy_set
@@ -126,7 +128,98 @@ def _merge_probe(evidence, fresh, label):
     return fresh["available"]
 
 
+def index_query_tuples(requests):
+    """Expand CheckResources requests into the raw index query tuples the
+    engine issues per (action, policy-kind) pair — the memo-cold unit of work."""
+    from cerbos_tpu import namer
+    from cerbos_tpu.ruletable.rows import KIND_PRINCIPAL, KIND_RESOURCE
+
+    qs = []
+    for r in requests:
+        sanitized = namer.sanitize(r.resource.kind)
+        version = r.resource.policy_version or "default"
+        scope = r.resource.scope or ""
+        roles = list(r.principal.roles)
+        for action in r.actions:
+            for pt in (KIND_PRINCIPAL, KIND_RESOURCE):
+                pid = r.principal.id if pt == KIND_PRINCIPAL else ""
+                qs.append((version, sanitized, scope, action, roles, pt, pid))
+    return qs
+
+
+def index_only_main(smoke: bool) -> int:
+    """--index-only: memo-cold rule-index micro-bench + bitmap/legacy parity.
+
+    Builds the bench corpus once into both index backends with the
+    request-shape memos disabled, replays every cold query through each, and
+    fails (exit 1) on any result divergence. Prints one JSON line.
+    """
+    n_requests = 256 if smoke else 1024
+    policies = list(parse_policies(bench_corpus.corpus_yaml(N_MODS)))
+    compiled = compile_policy_set(policies)
+    rt_bitmap = build_rule_table(compiled, index_backend="bitmap")
+    rt_legacy = build_rule_table(compiled, index_backend="legacy")
+    rt_bitmap.idx.set_memo_enabled(False)
+    rt_legacy.idx.set_memo_enabled(False)
+
+    qs = index_query_tuples(bench_corpus.requests(n_requests, N_MODS))
+
+    mismatches = 0
+    for q in qs:
+        got = [
+            (r.id, r.origin_fqn, r.action, r.effect)
+            for r in rt_bitmap.idx.query(*q)
+        ]
+        want = [
+            (r.id, r.origin_fqn, r.action, r.effect)
+            for r in rt_legacy.idx.query(*q)
+        ]
+        if got != want:
+            mismatches += 1
+    parity_ok = mismatches == 0
+
+    rates = {}
+    reps = 2 if smoke else 5
+    for name, rt in (("legacy", rt_legacy), ("bitmap", rt_bitmap)):
+        query = rt.idx.query
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for q in qs:
+                query(*q)
+            best = min(best, time.perf_counter() - t0)
+        rates[name] = len(qs) / best
+        print(f"index cold {name}: {rates[name]:.0f} queries/s", flush=True)
+
+    from cerbos_tpu.ruletable import index as index_mod
+
+    record = {
+        "metric": "index_cold_queries_per_sec",
+        "value": round(rates["bitmap"], 1),
+        "legacy": round(rates["legacy"], 1),
+        "speedup": round(rates["bitmap"] / rates["legacy"], 2),
+        "queries": len(qs),
+        "parity": "ok" if parity_ok else f"{mismatches} mismatches",
+        "kernel": "native" if index_mod._native_bitmap_sweep is not None else "numpy",
+    }
+    print(json.dumps(record))
+    return 0 if parity_ok else 1
+
+
 def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="reduced iteration counts for CI",
+    )
+    parser.add_argument(
+        "--index-only", action="store_true",
+        help="memo-cold rule-index micro-bench + bitmap/legacy parity check only",
+    )
+    args = parser.parse_args()
+    if args.index_only:
+        sys.exit(index_only_main(smoke=args.smoke))
+
     evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
     probe = tpu_probe.probe_ladder(attempts=1)
     jax_ok = _merge_probe(evidence, probe, "initial")
@@ -204,13 +297,16 @@ def main() -> None:
         # is fully hidden.
         depth = 3
         tickets = []
+        stream_outs = None
         t0 = time.perf_counter()
         for _ in range(ITERS):
             tickets.append(ev_jx.submit(inputs, params))
             if len(tickets) >= depth:
-                ev_jx.collect(tickets.pop(0))  # assembly timed, results not hoarded
+                # assembly timed; keep the latest batch so output verification
+                # exercises what the streaming path actually produced
+                stream_outs = ev_jx.collect(tickets.pop(0))
         while tickets:
-            ev_jx.collect(tickets.pop(0))
+            stream_outs = ev_jx.collect(tickets.pop(0))
         stream_wall = time.perf_counter() - t0
         stream_rate = decisions_per_batch * ITERS / stream_wall
         print(
@@ -218,7 +314,7 @@ def main() -> None:
             f"over {ITERS} in-flight batches",
             flush=True,
         )
-        results["jax_stream"] = (stream_rate, [stream_wall / ITERS] * ITERS, 0.0, outs)
+        results["jax_stream"] = (stream_rate, [stream_wall / ITERS] * ITERS, 0.0, stream_outs)
         ev_by_backend["jax_stream"] = ev_jx
 
         # characterize the host<->device link so the artifact records WHY

@@ -18,6 +18,16 @@ from typing import Any, Optional
 
 from .engine.types import AuxData
 
+try:  # pragma: no cover - exercised implicitly by every test environment
+    import cryptography  # noqa: F401
+
+    _HAVE_CRYPTOGRAPHY = True
+except Exception:  # noqa: BLE001
+    # verification still works without the optional cryptography package:
+    # cerbos_tpu.util.softcrypto provides pure-Python RSA/ECDSA/HMAC verify
+    # and the PEM/JWK parsing the corpus needs (verify-only, no signing)
+    _HAVE_CRYPTOGRAPHY = False
+
 
 class JWTError(ValueError):
     pass
@@ -46,6 +56,13 @@ class JWK:
 
 def _jwk_from_dict(k: dict) -> Any:
     kty = k.get("kty")
+    if not _HAVE_CRYPTOGRAPHY:
+        from .util import softcrypto
+
+        try:
+            return softcrypto.jwk_public_key(k, _b64url)
+        except ValueError as e:
+            raise JWTError(str(e)) from None
     if kty == "RSA":
         from cryptography.hazmat.primitives.asymmetric import rsa
 
@@ -69,13 +86,22 @@ def parse_key_material(raw: bytes, pem: bool = False) -> list[JWK]:
     every JWK needs a non-empty kid and a known alg (jwt.go keyset loading;
     auxdata corpus error text)."""
     if pem:
-        from cryptography.hazmat.primitives import serialization
-
         keys: list[JWK] = []
         text = raw.decode("utf-8", errors="ignore")
         blocks = ["-----BEGIN" + b for b in text.split("-----BEGIN")[1:]]
         if not blocks:
             raise JWTError("failed to parse PEM key material")
+        if not _HAVE_CRYPTOGRAPHY:
+            from .util import softcrypto
+
+            for block in blocks:
+                try:
+                    keys.append(JWK(key=softcrypto.parse_pem_block(block)))
+                except ValueError as e:
+                    raise JWTError(f"failed to parse PEM block: {e}") from None
+            return keys
+        from cryptography.hazmat.primitives import serialization
+
         for block in blocks:
             data = block.encode()
             try:
@@ -240,12 +266,16 @@ def load_keyset(conf: dict) -> KeySet:
 
 
 def _verify_signature(alg: str, key: Any, signing_input: bytes, sig: bytes) -> bool:
+    if isinstance(key, JWK):
+        key = key.key
+    if not _HAVE_CRYPTOGRAPHY:
+        from .util import softcrypto
+
+        return softcrypto.verify(alg, key, signing_input, sig)
     from cryptography.exceptions import InvalidSignature
     from cryptography.hazmat.primitives import hashes, hmac as chmac
     from cryptography.hazmat.primitives.asymmetric import ec, padding, rsa, utils as asym_utils
 
-    if isinstance(key, JWK):
-        key = key.key
     hash_alg = {"256": hashes.SHA256(), "384": hashes.SHA384(), "512": hashes.SHA512()}[alg[2:]]
     try:
         if alg.startswith("HS"):

@@ -112,7 +112,10 @@ class Planner:
             actions=list(input.actions),
             kind=KIND_ALWAYS_DENIED,
             resource_kind=input.resource_kind,
-            policy_version=resource_version,
+            # echo the request's version verbatim (engine PlanResourcesOutput
+            # does NOT substitute the "default" fallback; an omitted request
+            # version stays omitted in the response)
+            policy_version=input.resource_policy_version,
             scope=resource_scope,
             include_meta=input.include_meta,
         )
@@ -129,7 +132,7 @@ class Planner:
                 aux_data=input.aux_data,
             )
             errors, reject = self.schema_mgr.validate_check_input(
-                rt.get_schema(r_fqn), check_in, principal_only=True
+                rt.get_schema(r_fqn), check_in, resource_ignore_required=True
             )
             output.validation_errors = errors
             if reject:

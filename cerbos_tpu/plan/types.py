@@ -105,9 +105,10 @@ class PlanOutput:
             "requestId": self.request_id,
             "actions": self.actions,
             "resourceKind": self.resource_kind,
-            "policyVersion": self.policy_version,
             "filter": filter_j,
         }
+        if self.policy_version:  # proto3 JSON omits empty strings
+            out["policyVersion"] = self.policy_version
         if self.include_meta:
             if not self.policy_match:
                 debug = "NO_MATCH"  # plan.go noPolicyMatch

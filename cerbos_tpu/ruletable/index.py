@@ -3,15 +3,38 @@
 Behavioral reference: internal/ruletable/index (bitmap index with exact dims
 for scope/version/policyKind/principal and glob dims for role/action/resource;
 query = AND of dimension sets; synthetic role-policy DENY bindings generated
-at query time, index.go:305-515). Sets of integer row IDs stand in for the
-reference's hierarchical bitmaps; the TPU lowering packs these into dense
-mask tensors instead.
+at query time, index.go:305-515).
+
+Two backends answer dimension intersections behind the same ``query()``
+surface:
+
+``bitmap`` (default)
+    The reference's hierarchical bitmap index
+    (internal/ruletable/index/bitmap.go) ported as a two-level packed
+    bitmap: every posting list is a fixed-width ``uint64`` bitmap over row
+    ids plus a coarse summary level (one summary word per 64-word block,
+    one bit per word), so a memo-cold query is a handful of vectorized
+    AND sweeps that skip empty blocks.  The sweep kernel exists twice —
+    a numpy fallback in this module and a fused C sweep
+    (``cerbos_native.bitmap_sweep``) chosen the same way the other fused
+    matchers are (``native.get()`` + hasattr).
+
+``legacy``
+    The original Python ``set`` algebra, kept for one release as a
+    differential oracle (``CERBOS_TPU_RULE_INDEX=legacy``); the
+    differential tests assert byte-identical row lists between the two.
+
+Request-shape memos still exist (``memo_enabled``) but are no longer load
+bearing: the bitmap path is fast without a warm cache.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Iterable, Optional
+import os
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
 
 from .. import globs, namer
 from ..compile import CompiledCondition
@@ -25,6 +48,10 @@ from .rows import (
 from ..compile.compiler import CompiledOutput
 from ..policy.model import SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT
 
+_WORD_BITS = 64
+_ENV_BACKEND = "CERBOS_TPU_RULE_INDEX"
+_VALID_BACKENDS = ("bitmap", "legacy")
+
 
 # pattern -> is-glob memo (role/action vocabularies repeat heavily at build)
 @functools.lru_cache(maxsize=65536)
@@ -32,34 +59,282 @@ def _is_glob_value(value: str) -> bool:
     return globs.is_glob(value) or value == "*"
 
 
-class _GlobDim:
-    """Literal + glob pattern buckets (ref: index/glob_dimension.go)."""
+# -- packed two-level bitmaps ------------------------------------------------
 
-    __slots__ = ("literals", "globs", "_cache", "_multi_cache")
+
+class PackedBitmap:
+    """A posting list as a packed ``uint64`` bitmap over row ids.
+
+    ``words[w]`` holds rows ``64*w .. 64*w+63``.  The coarse level,
+    ``summary``, keeps one bit per word (bit ``w & 63`` of
+    ``summary[w >> 6]`` is set iff ``words[w] != 0``), so each summary
+    word covers a 64-word / 4096-row block and an AND sweep can skip
+    empty blocks without touching them.  Arrays grow lazily to the
+    highest set bit; queries treat the missing tail as zeros.
+    """
+
+    __slots__ = ("words", "summary", "n")
+
+    def __init__(self) -> None:
+        self.words = np.zeros(0, dtype=np.uint64)
+        self.summary = np.zeros(0, dtype=np.uint64)
+        self.n = 0  # popcount, maintained incrementally
+
+    def __len__(self) -> int:
+        return self.n
+
+    def _grow(self, nwords: int) -> None:
+        target = max(nwords, 2 * len(self.words), 4)
+        w = np.zeros(target, dtype=np.uint64)
+        w[: len(self.words)] = self.words
+        self.words = w
+        nsum = (target + _WORD_BITS - 1) >> 6
+        if nsum > len(self.summary):
+            s = np.zeros(nsum, dtype=np.uint64)
+            s[: len(self.summary)] = self.summary
+            self.summary = s
+
+    def add(self, rid: int) -> None:
+        w, b = rid >> 6, rid & 63
+        if w >= len(self.words):
+            self._grow(w + 1)
+        cur = int(self.words[w])
+        bit = 1 << b
+        if cur & bit:
+            return
+        self.words[w] = np.uint64(cur | bit)
+        self.summary[w >> 6] = np.uint64(int(self.summary[w >> 6]) | (1 << (w & 63)))
+        self.n += 1
+
+    def discard(self, rid: int) -> None:
+        """Clear a row bit, keeping BOTH levels consistent (free-id reuse
+        after ``delete_policy`` depends on stale summary bits not lingering)."""
+        w, b = rid >> 6, rid & 63
+        if w >= len(self.words):
+            return
+        cur = int(self.words[w])
+        bit = 1 << b
+        if not (cur & bit):
+            return
+        cur &= ~bit
+        self.words[w] = np.uint64(cur)
+        if cur == 0:
+            self.summary[w >> 6] = np.uint64(
+                int(self.summary[w >> 6]) & ~(1 << (w & 63))
+            )
+        self.n -= 1
+
+    @staticmethod
+    def union(parts: Sequence["PackedBitmap"]) -> "PackedBitmap":
+        out = PackedBitmap()
+        live = [p for p in parts if p.n]
+        if not live:
+            return out
+        if len(live) == 1:
+            # shared read-only view: callers never mutate query results and
+            # dim caches are invalidated on every index mutation
+            return live[0]
+        nwords = max(len(p.words) for p in live)
+        words = np.zeros(nwords, dtype=np.uint64)
+        summary = np.zeros((nwords + _WORD_BITS - 1) >> 6, dtype=np.uint64)
+        for p in live:
+            words[: len(p.words)] |= p.words
+            summary[: len(p.summary)] |= p.summary
+        out.words = words
+        out.summary = summary
+        out.n = int(np.bitwise_count(words).sum())
+        return out
+
+
+_EMPTY_BITMAP = PackedBitmap()
+
+
+# -- sweep kernels -----------------------------------------------------------
+
+# Above this row count the sweep passes the summary arrays so the kernel can
+# skip empty 64-word blocks; below it, a linear word AND is cheaper than the
+# extra per-dimension buffer acquisitions.
+_SUMMARY_THRESHOLD_ROWS = 32768
+
+# Resolved once on first Index construction (same selection as the existing
+# fused matchers: ``native.get()`` + hasattr); None = numpy fallback.
+_native_bitmap_sweep = None
+_native_bitmap_any = None
+_native_resolved = False
+
+
+def _resolve_native() -> None:
+    global _native_bitmap_sweep, _native_bitmap_any, _native_resolved
+    from .. import native as native_mod
+
+    nat = native_mod.get()
+    if nat is not None and hasattr(nat, "bitmap_sweep"):
+        _native_bitmap_sweep = nat.bitmap_sweep
+        _native_bitmap_any = nat.bitmap_any
+    _native_resolved = True
+
+
+def _sweep_numpy(
+    ws: Sequence[np.ndarray],
+    ss: Sequence[np.ndarray],
+    extra: Optional[np.ndarray],
+    rows: Optional[list],
+) -> tuple[bool, list]:
+    """Vectorized two-level AND sweep (numpy twin of the C kernel).
+
+    ANDs the summary level first to find candidate 64-bit words, gathers
+    and ANDs only those words, then (optionally) applies ``extra`` — the
+    action dim, which legacy semantics exclude from the base-emptiness
+    check — and decodes set bits into ascending row ids.  Returns
+    ``(base_nonempty, rows-or-ids)``.
+    """
+    L = min(len(w) for w in ws)
+    S = min(len(s) for s in ss)
+    if L == 0 or S == 0:
+        return False, []
+    ssum = ss[0][:S]
+    for s in ss[1:]:
+        ssum = ssum & s[:S]
+    if not ssum.any():
+        return False, []
+    live = np.flatnonzero(np.unpackbits(ssum.view(np.uint8), bitorder="little"))
+    live = live[live < L]
+    if live.size == 0:
+        return False, []
+    acc = ws[0][live]
+    for w in ws[1:]:
+        acc = acc & w[live]
+    nz = acc != 0
+    if not nz.any():
+        return False, []
+    if extra is not None:
+        if len(extra) == 0:
+            return True, []
+        pad = np.minimum(live, len(extra) - 1)
+        ev = extra[pad]
+        ev[live >= len(extra)] = 0
+        acc = acc & ev
+        nz = acc != 0
+        if not nz.any():
+            return True, []
+    live = live[nz]
+    acc = acc[nz]
+    bits = np.unpackbits(acc.view(np.uint8), bitorder="little").reshape(live.size, 64)
+    wi, bi = np.nonzero(bits)
+    ids = (live[wi] << 6) + bi
+    if rows is None:
+        return True, ids.tolist()
+    out = []
+    for rid in ids.tolist():
+        row = rows[rid]
+        if row is not None:
+            out.append(row)
+    return True, out
+
+
+# -- dimensions --------------------------------------------------------------
+
+
+class _ExactDim:
+    """Exact-match dimension: per-key legacy id set + packed bitmap."""
+
+    __slots__ = ("ids", "bm")
+
+    def __init__(self) -> None:
+        self.ids: dict[str, set[int]] = {}
+        self.bm: dict[str, PackedBitmap] = {}
+
+    def add(self, key: str, rid: int) -> None:
+        self.ids.setdefault(key, set()).add(rid)
+        bm = self.bm.get(key)
+        if bm is None:
+            bm = self.bm[key] = PackedBitmap()
+        bm.add(rid)
+
+    def remove(self, key: str, rid: int) -> None:
+        s = self.ids.get(key)
+        if s is None:
+            return
+        s.discard(rid)
+        bm = self.bm.get(key)
+        if bm is not None:
+            bm.discard(rid)
+        if not s:
+            del self.ids[key]
+            self.bm.pop(key, None)
+
+    def get(self, key: str) -> Optional[set[int]]:
+        return self.ids.get(key)
+
+    def get_bm(self, key: str) -> Optional[PackedBitmap]:
+        return self.bm.get(key)
+
+
+class _GlobDim:
+    """Literal + glob pattern buckets (ref: index/glob_dimension.go), with a
+    packed bitmap per bucket alongside the legacy id sets."""
+
+    __slots__ = (
+        "literals",
+        "globs",
+        "lit_bm",
+        "glob_bm",
+        "_cache",
+        "_multi_cache",
+        "_bm_cache",
+        "_bm_multi_cache",
+    )
 
     def __init__(self) -> None:
         self.literals: dict[str, set[int]] = {}
         self.globs: dict[str, set[int]] = {}
+        self.lit_bm: dict[str, PackedBitmap] = {}
+        self.glob_bm: dict[str, PackedBitmap] = {}
         self._cache: dict[str, frozenset[int]] = {}
         self._multi_cache: dict[tuple[str, ...], frozenset[int]] = {}
+        self._bm_cache: dict[str, PackedBitmap] = {}
+        self._bm_multi_cache: dict[tuple[str, ...], PackedBitmap] = {}
 
-    def add(self, value: str, rid: int) -> None:
-        bucket = self.globs if _is_glob_value(value) else self.literals
-        bucket.setdefault(value, set()).add(rid)
+    def _clear_caches(self) -> None:
         if self._cache:
             self._cache.clear()
         if self._multi_cache:
             self._multi_cache.clear()
+        if self._bm_cache:
+            self._bm_cache.clear()
+        if self._bm_multi_cache:
+            self._bm_multi_cache.clear()
+
+    def add(self, value: str, rid: int) -> None:
+        if _is_glob_value(value):
+            bucket, bm_bucket = self.globs, self.glob_bm
+        else:
+            bucket, bm_bucket = self.literals, self.lit_bm
+        bucket.setdefault(value, set()).add(rid)
+        bm = bm_bucket.get(value)
+        if bm is None:
+            bm = bm_bucket[value] = PackedBitmap()
+        bm.add(rid)
+        self._clear_caches()
 
     def remove(self, value: str, rid: int) -> None:
-        bucket = self.globs if _is_glob_value(value) else self.literals
+        if _is_glob_value(value):
+            bucket, bm_bucket = self.globs, self.glob_bm
+        else:
+            bucket, bm_bucket = self.literals, self.lit_bm
         ids = bucket.get(value)
         if ids is not None:
             ids.discard(rid)
             if not ids:
                 del bucket[value]
-        self._cache.clear()
-        self._multi_cache.clear()
+        bm = bm_bucket.get(value)
+        if bm is not None:
+            bm.discard(rid)
+            if bm.n == 0:
+                del bm_bucket[value]
+        self._clear_caches()
+
+    # -- legacy (set) queries ---------------------------------------------
 
     def query(self, value: str) -> frozenset[int]:
         hit = self._cache.get(value)
@@ -95,19 +370,99 @@ class _GlobDim:
         self._multi_cache[key] = res
         return res
 
+    # -- bitmap queries ---------------------------------------------------
+
+    def query_bm(self, value: str) -> PackedBitmap:
+        hit = self._bm_cache.get(value)
+        if hit is not None:
+            return hit
+        parts: list[PackedBitmap] = []
+        lit = self.lit_bm.get(value)
+        if lit is not None:
+            parts.append(lit)
+        for pat, bm in self.glob_bm.items():
+            if globs.matches_glob(pat, value):
+                parts.append(bm)
+        res = PackedBitmap.union(parts)
+        if len(self._bm_cache) > 65536:
+            self._bm_cache.clear()
+        self._bm_cache[value] = res
+        return res
+
+    def query_multiple_bm(self, values: Iterable[str]) -> PackedBitmap:
+        key = tuple(values)
+        hit = self._bm_multi_cache.get(key)
+        if hit is not None:
+            return hit
+        res = PackedBitmap.union([self.query_bm(v) for v in key])
+        if len(self._bm_multi_cache) > 65536:
+            self._bm_multi_cache.clear()
+        self._bm_multi_cache[key] = res
+        return res
+
+
+class _DimView:
+    """dict-like read view over an _ExactDim's legacy sets, so existing
+    callers (and the packer's ``idx.principal``) keep their contract."""
+
+    __slots__ = ("_dim",)
+
+    def __init__(self, dim: _ExactDim) -> None:
+        self._dim = dim
+
+    def get(self, key, default=None):
+        s = self._dim.ids.get(key)
+        return s if s is not None else default
+
+    def __getitem__(self, key):
+        return self._dim.ids[key]
+
+    def __contains__(self, key) -> bool:
+        return key in self._dim.ids
+
+    def __iter__(self):
+        return iter(self._dim.ids)
+
+    def __len__(self) -> int:
+        return len(self._dim.ids)
+
+    def items(self):
+        return self._dim.ids.items()
+
+    def keys(self):
+        return self._dim.ids.keys()
+
+    def values(self):
+        return self._dim.ids.values()
+
+
+def default_backend() -> str:
+    env = os.environ.get(_ENV_BACKEND, "").strip().lower()
+    return env if env in _VALID_BACKENDS else "bitmap"
+
 
 class Index:
-    def __init__(self) -> None:
+    def __init__(self, backend: Optional[str] = None, memo_enabled: bool = True) -> None:
+        if backend is None:
+            backend = default_backend()
+        if backend not in _VALID_BACKENDS:
+            raise ValueError(f"unknown rule-index backend {backend!r}")
+        if not _native_resolved:
+            _resolve_native()
+        self.backend = backend
+        self.memo_enabled = memo_enabled
+        self._use_summary = False  # flips once the table outgrows one block run
         self.rows: list[Optional[RuleRow]] = []
         self._free_ids: list[int] = []
-        self.scope: dict[str, set[int]] = {}
-        self.version: dict[str, set[int]] = {}
-        self.policy_kind: dict[str, set[int]] = {}
-        self.principal: dict[str, set[int]] = {}
+        self._scope = _ExactDim()
+        self._version = _ExactDim()
+        self._policy_kind = _ExactDim()
+        self._principal = _ExactDim()
         self.resource = _GlobDim()
         self.role = _GlobDim()
         self.action = _GlobDim()
         self.allow_actions_ids: set[int] = set()
+        self.allow_actions_bm = PackedBitmap()
         self.fqn_ids: dict[str, set[int]] = {}
         # scope -> role -> transitive parent roles (ref: index.go:729-773)
         self.parent_roles: dict[str, dict[str, list[str]]] = {}
@@ -116,10 +471,38 @@ class Index:
         # request-shape memos: the serving path repeats a small set of
         # (version, resource, scope, action, roles, ...) tuples; the index is
         # immutable between mutations, so results cache until the next
-        # index_rules/delete_policy (the reference gets the same effect from
-        # bitmap ANDs being cheap; Python set ops are not, so memoize)
+        # index_rules/delete_policy.  With the bitmap backend these are an
+        # optimization, not a requirement — cold queries are packed AND
+        # sweeps, not set algebra.
         self._query_cache: dict[tuple, list] = {}
         self._exists_cache: dict[tuple, bool] = {}
+        self._query_impl = (
+            self._query_bitmap if backend == "bitmap" else self._query_legacy
+        )
+
+    # legacy-shaped views over the exact dims (read-only dict contract)
+    @property
+    def scope(self) -> _DimView:
+        return _DimView(self._scope)
+
+    @property
+    def version(self) -> _DimView:
+        return _DimView(self._version)
+
+    @property
+    def policy_kind(self) -> _DimView:
+        return _DimView(self._policy_kind)
+
+    @property
+    def principal(self) -> _DimView:
+        return _DimView(self._principal)
+
+    def set_memo_enabled(self, enabled: bool) -> None:
+        """Toggle the request-shape memos (the memo-cold bench/tests disable
+        them to measure the uncached path)."""
+        self.memo_enabled = enabled
+        self._query_cache.clear()
+        self._exists_cache.clear()
 
     def _invalidate_memos(self) -> None:
         # bulk build ingests thousands of policies before the first query:
@@ -140,11 +523,11 @@ class Index:
                 self.rows.append(row)
             else:
                 self.rows[rid] = row
-            self.scope.setdefault(row.scope, set()).add(rid)
-            self.version.setdefault(row.version, set()).add(rid)
-            self.policy_kind.setdefault(row.policy_kind, set()).add(rid)
+            self._scope.add(row.scope, rid)
+            self._version.add(row.version, rid)
+            self._policy_kind.add(row.policy_kind, rid)
             if row.principal:
-                self.principal.setdefault(row.principal, set()).add(rid)
+                self._principal.add(row.principal, rid)
             if row.resource:
                 self.resource.add(row.resource, rid)
             if row.role:
@@ -153,7 +536,9 @@ class Index:
                 self.action.add(row.action, rid)
             if row.allow_actions is not None:
                 self.allow_actions_ids.add(rid)
+                self.allow_actions_bm.add(rid)
             self.fqn_ids.setdefault(row.origin_fqn, set()).add(rid)
+        self._use_summary = len(self.rows) > _SUMMARY_THRESHOLD_ROWS
 
     def delete_policy(self, fqn: str) -> None:
         ids = self.fqn_ids.pop(fqn, None)
@@ -166,18 +551,11 @@ class Index:
                 continue
             self.rows[rid] = None
             self._free_ids.append(rid)
-            for dim, key in ((self.scope, row.scope), (self.version, row.version), (self.policy_kind, row.policy_kind)):
-                s = dim.get(key)
-                if s is not None:
-                    s.discard(rid)
-                    if not s:
-                        del dim[key]
+            self._scope.remove(row.scope, rid)
+            self._version.remove(row.version, rid)
+            self._policy_kind.remove(row.policy_kind, rid)
             if row.principal:
-                s = self.principal.get(row.principal)
-                if s is not None:
-                    s.discard(rid)
-                    if not s:
-                        del self.principal[row.principal]
+                self._principal.remove(row.principal, rid)
             if row.resource:
                 self.resource.remove(row.resource, rid)
             if row.role:
@@ -185,6 +563,7 @@ class Index:
             if row.action is not None:
                 self.action.remove(row.action, rid)
             self.allow_actions_ids.discard(rid)
+            self.allow_actions_bm.discard(rid)
 
     def index_parent_roles(self, scope_parent_roles: dict[str, dict[str, list[str]]]) -> None:
         """Record parent-role definitions; the transitive closure is computed
@@ -241,39 +620,67 @@ class Index:
         if not scopes:
             return False
         key = (KIND_PRINCIPAL, version, tuple(scopes))
-        hit = self._exists_cache.get(key)
-        if hit is not None:
-            return hit
-        v = self.version.get(version)
-        k = self.policy_kind.get(KIND_PRINCIPAL)
-        if not v or not k:
-            res = False
+        if self.memo_enabled:
+            hit = self._exists_cache.get(key)
+            if hit is not None:
+                return hit
+        if self.backend == "bitmap":
+            res = self._scoped_principal_exists_bitmap(version, scopes)
         else:
-            vk = k & v if len(k) < len(v) else v & k
-            res = bool(vk) and any(
-                not vk.isdisjoint(self.scope.get(sc, ())) for sc in scopes
-            )
-        if len(self._exists_cache) > 65536:
-            self._exists_cache.clear()
-        self._exists_cache[key] = res
+            res = self._scoped_principal_exists_legacy(version, scopes)
+        if self.memo_enabled:
+            if len(self._exists_cache) > 65536:
+                self._exists_cache.clear()
+            self._exists_cache[key] = res
         return res
+
+    def _scoped_principal_exists_legacy(self, version: str, scopes: list[str]) -> bool:
+        v = self._version.get(version)
+        k = self._policy_kind.get(KIND_PRINCIPAL)
+        if not v or not k:
+            return False
+        vk = k & v if len(k) < len(v) else v & k
+        return bool(vk) and any(
+            not vk.isdisjoint(self._scope.get(sc) or ()) for sc in scopes
+        )
+
+    def _scoped_principal_exists_bitmap(self, version: str, scopes: list[str]) -> bool:
+        v = self._version.bm.get(version)
+        k = self._policy_kind.bm.get(KIND_PRINCIPAL)
+        if v is None or k is None:
+            return False
+        for sc in scopes:
+            s = self._scope.bm.get(sc)
+            if s is not None and self._any((v.words, k.words, s.words), (v.summary, k.summary, s.summary)):
+                return True
+        return False
+
+    def _any(self, ws: tuple, ss: tuple) -> bool:
+        if _native_bitmap_any is not None:
+            return _native_bitmap_any(ws, ss if self._use_summary else None)
+        return _sweep_numpy(ws, ss, None, None)[0]
 
     def scoped_resource_exists(self, version: str, resource: str, scopes: list[str]) -> bool:
         if not scopes:
             return False
         key = (KIND_RESOURCE, version, resource, tuple(scopes))
-        hit = self._exists_cache.get(key)
-        if hit is not None:
-            return hit
-        res = self._scoped_resource_exists(version, resource, scopes)
-        if len(self._exists_cache) > 65536:
-            self._exists_cache.clear()
-        self._exists_cache[key] = res
+        if self.memo_enabled:
+            hit = self._exists_cache.get(key)
+            if hit is not None:
+                return hit
+        if self.backend == "bitmap":
+            res = self._scoped_resource_exists_bitmap(version, resource, scopes)
+        else:
+            res = self._scoped_resource_exists_legacy(version, resource, scopes)
+        if self.memo_enabled:
+            if len(self._exists_cache) > 65536:
+                self._exists_cache.clear()
+            self._exists_cache[key] = res
         return res
 
-    def _scoped_resource_exists(self, version: str, resource: str, scopes: list[str]) -> bool:
-        v = self.version.get(version)
-        k = self.policy_kind.get(KIND_RESOURCE)
+    def _scoped_resource_exists_legacy(self, version: str, resource: str, scopes: list[str]) -> bool:
+        v = self._version.get(version)
+        k = self._policy_kind.get(KIND_RESOURCE)
         if not v or not k:
             return False
         # start from the (small) per-kind row set and early-exit per scope
@@ -284,7 +691,24 @@ class Index:
         rvk = r & v & k
         if not rvk:
             return False
-        return any(not rvk.isdisjoint(self.scope.get(sc, ())) for sc in scopes)
+        return any(not rvk.isdisjoint(self._scope.get(sc) or ()) for sc in scopes)
+
+    def _scoped_resource_exists_bitmap(self, version: str, resource: str, scopes: list[str]) -> bool:
+        v = self._version.bm.get(version)
+        k = self._policy_kind.bm.get(KIND_RESOURCE)
+        if v is None or k is None:
+            return False
+        r = self.resource.query_bm(resource)
+        if r.n == 0:
+            return False
+        for sc in scopes:
+            s = self._scope.bm.get(sc)
+            if s is not None and self._any(
+                (r.words, v.words, k.words, s.words),
+                (r.summary, v.summary, k.summary, s.summary),
+            ):
+                return True
+        return False
 
     def query(
         self,
@@ -303,18 +727,127 @@ class Index:
         mutation; callers receive a shared list and must not mutate it."""
         if len(self._free_ids) == len(self.rows):  # O(1) empty check
             return []
+        if not self.memo_enabled:
+            return self._query_impl(version, resource, scope, action, roles, policy_kind, principal_id)
         memo_key = (version, resource, scope, action, tuple(roles), policy_kind, principal_id)
         cached = self._query_cache.get(memo_key)
         if cached is not None:
             return cached
 
-        out = self._query_uncached(version, resource, scope, action, roles, policy_kind, principal_id)
+        out = self._query_impl(version, resource, scope, action, roles, policy_kind, principal_id)
         if len(self._query_cache) > 65536:
             self._query_cache.clear()
         self._query_cache[memo_key] = out
         return out
 
-    def _query_uncached(
+    # -- bitmap query path -------------------------------------------------
+
+    def _query_bitmap(
+        self,
+        version: str,
+        resource: str,
+        scope: str,
+        action: str,
+        roles: list[str],
+        policy_kind: str,
+        principal_id: str,
+    ) -> list[RuleRow]:
+        # dims assemble directly into the kernel's (words, summaries) argument
+        # lists; every early [] return matches the legacy path exactly.
+        # Summary arrays are only marshalled when the kernel will use them
+        # (numpy fallback, or a table big enough for block skipping to pay).
+        sweep = _native_bitmap_sweep
+        need_ss = sweep is None or self._use_summary
+
+        if principal_id:
+            p = self._principal.bm.get(principal_id)
+            if p is None:
+                return []
+        else:
+            p = None
+
+        s = self._scope.bm.get(scope)
+        if s is None:
+            return []
+        ws = [s.words]
+        ss = [s.summary] if need_ss else None
+
+        if version:
+            v = self._version.bm.get(version)
+            if v is None:
+                return []
+            ws.append(v.words)
+            if need_ss:
+                ss.append(v.summary)
+        if resource:
+            # inlined query_bm cache hit (hot path)
+            r = self.resource._bm_cache.get(resource)
+            if r is None:
+                r = self.resource.query_bm(resource)
+            if r.n == 0:
+                return []
+            ws.append(r.words)
+            if need_ss:
+                ss.append(r.summary)
+        if roles:
+            rkey = tuple(roles)
+            rb = self.role._bm_multi_cache.get(rkey)
+            if rb is None:
+                rb = self.role.query_multiple_bm(rkey)
+            if rb.n == 0:
+                return []
+            ws.append(rb.words)
+            if need_ss:
+                ss.append(rb.summary)
+        if policy_kind:
+            k = self._policy_kind.bm.get(policy_kind)
+            if k is None:
+                return []
+            ws.append(k.words)
+            if need_ss:
+                ss.append(k.summary)
+        if p is not None:
+            ws.append(p.words)
+            if need_ss:
+                ss.append(p.summary)
+
+        if action:
+            a = self.action._bm_cache.get(action)
+            if a is None:
+                a = self.action.query_bm(action)
+            extra = a.words
+        else:
+            extra = None
+
+        if sweep is not None:
+            base_any, matched = sweep(ws, ss, extra, self.rows)
+        else:
+            base_any, matched = _sweep_numpy(ws, ss, extra, self.rows)
+        if not base_any:
+            # legacy semantics: an empty base intersection suppresses the
+            # synthetic role-policy DENYs too
+            return []
+
+        if not (action and resource and policy_kind == KIND_RESOURCE and self.allow_actions_ids):
+            return matched
+
+        out: list[RuleRow] = []
+        # synthetic role-policy DENYs come first (index.go:303-307); the
+        # synthesis itself is rare (requires role policies) and shares the
+        # legacy set-based implementation for bit-exact parity
+        self._append_role_policy_denies(
+            [resource], roles, [action],
+            version_ids=self._version.get(version) if version else None,
+            scope_ids=self._scope.get(scope),
+            role_ids=self.role.query_multiple(roles) if roles else None,
+            out=out,
+        )
+        out.extend(matched)
+        return out
+
+    # -- legacy (set algebra) query path -----------------------------------
+
+    def _query_legacy(
         self,
         version: str,
         resource: str,
@@ -326,18 +859,18 @@ class Index:
     ) -> list[RuleRow]:
         principal_ids: Optional[frozenset[int] | set[int]] = None
         if principal_id:
-            p = self.principal.get(principal_id)
+            p = self._principal.get(principal_id)
             if not p:
                 return []
             principal_ids = p
 
-        scope_ids = self.scope.get(scope)
+        scope_ids = self._scope.get(scope)
         if scope_ids is None:
             return []
 
         dims: list[set[int] | frozenset[int]] = [scope_ids]
         if version:
-            v = self.version.get(version)
+            v = self._version.get(version)
             if not v:
                 return []
             dims.append(v)
@@ -354,7 +887,7 @@ class Index:
                 return []
             dims.append(role_ids)
         if policy_kind:
-            k = self.policy_kind.get(policy_kind)
+            k = self._policy_kind.get(policy_kind)
             if not k:
                 return []
             dims.append(k)
@@ -384,7 +917,7 @@ class Index:
         if action and resource and policy_kind == KIND_RESOURCE and self.allow_actions_ids:
             self._append_role_policy_denies(
                 [resource], roles, [action],
-                version_ids=self.version.get(version) if version else None,
+                version_ids=self._version.get(version) if version else None,
                 scope_ids=scope_ids,
                 role_ids=role_ids,
                 out=out,

@@ -34,8 +34,10 @@ class PolicyMeta:
 
 
 class RuleTable:
-    def __init__(self) -> None:
-        self.idx = Index()
+    def __init__(self, index_backend: Optional[str] = None) -> None:
+        # index_backend: "bitmap" (default) or "legacy" — see Index; None
+        # defers to the CERBOS_TPU_RULE_INDEX env override
+        self.idx = Index(backend=index_backend)
         self.principal_scope_map: dict[str, bool] = {}
         self.resource_scope_map: dict[str, bool] = {}
         self.scope_scope_permissions: dict[str, str] = {}
@@ -218,8 +220,10 @@ class RuleTable:
         return result
 
 
-def build_rule_table(policies: list[CompiledPolicy]) -> RuleTable:
-    rt = RuleTable()
+def build_rule_table(
+    policies: list[CompiledPolicy], index_backend: Optional[str] = None
+) -> RuleTable:
+    rt = RuleTable(index_backend=index_backend)
     for p in policies:
         rt.ingest_policy(p)
     return rt

@@ -66,12 +66,17 @@ def enable() -> str | None:
             continue
         try:
             jax.config.update("jax_compilation_cache_dir", str(cand))
-            # cache every entry: the default thresholds skip "fast" compiles,
-            # but on this serving path even a 2 s compile is worth persisting
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         except Exception:
             return None
         _enabled = str(cand)
+        # cache every entry: the default thresholds skip "fast" compiles,
+        # but on this serving path even a 2 s compile is worth persisting.
+        # These knobs don't exist on older jax — the cache dir alone must
+        # survive, so they get their own guard instead of unwinding it.
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass
         return _enabled
     return None

@@ -1,0 +1,442 @@
+"""Pure-Python JWT signature verification fallback.
+
+Used by ``cerbos_tpu.auxdata`` when the ``cryptography`` package is not
+installed: verification-only RSA PKCS#1 v1.5 and ECDSA (P-256/P-384/P-521)
+over stdlib big-int arithmetic, plus the minimal ASN.1/PEM parsing needed to
+load the key material the reference's corpus uses (JWK dicts, SPKI public
+keys, PKCS#8/SEC1/PKCS#1 private keys — private keys only ever surface their
+public half here; signing is out of scope).
+
+Performance is irrelevant (a few ms per ECDSA verify); correctness is covered
+by the golden auxdata corpus, which exercises RS256 and ES384 tokens signed
+by the reference implementation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+from dataclasses import dataclass
+from typing import Optional
+
+_HASHES = {"256": hashlib.sha256, "384": hashlib.sha384, "512": hashlib.sha512}
+
+# EMSA-PKCS1-v1_5 DigestInfo prefixes (RFC 8017 §9.2 notes)
+_DIGEST_INFO = {
+    "256": bytes.fromhex("3031300d060960864801650304020105000420"),
+    "384": bytes.fromhex("3041300d060960864801650304020205000430"),
+    "512": bytes.fromhex("3051300d060960864801650304020305000440"),
+}
+
+
+# -- elliptic curves (NIST, short Weierstrass y^2 = x^3 + ax + b) ------------
+
+
+@dataclass(frozen=True)
+class Curve:
+    name: str
+    p: int
+    a: int
+    b: int
+    n: int
+    gx: int
+    gy: int
+
+    @property
+    def size(self) -> int:  # coordinate size in bytes
+        return (self.p.bit_length() + 7) // 8
+
+
+# primes from their generalized-Mersenne definitions (typo-proof); a = p - 3
+# for all three NIST curves
+_P256_P = 2**256 - 2**224 + 2**192 + 2**96 - 1
+_P384_P = 2**384 - 2**128 - 2**96 + 2**32 - 1
+_P521_P = 2**521 - 1
+
+P256 = Curve(
+    name="P-256",
+    p=_P256_P,
+    a=_P256_P - 3,
+    b=0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B,
+    n=0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551,
+    gx=0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296,
+    gy=0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5,
+)
+P384 = Curve(
+    name="P-384",
+    p=_P384_P,
+    a=_P384_P - 3,
+    b=0xB3312FA7E23EE7E4988E056BE3F82D19181D9C6EFE8141120314088F5013875AC656398D8A2ED19D2A85C8EDD3EC2AEF,
+    n=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFC7634D81F4372DDF581A0DB248B0A77AECEC196ACCC52973,
+    gx=0xAA87CA22BE8B05378EB1C71EF320AD746E1D3B628BA79B9859F741E082542A385502F25DBF55296C3A545E3872760AB7,
+    gy=0x3617DE4A96262C6F5D9E98BF9292DC29F8F41DBD289A147CE9DA3113B5F0B8C00A60B1CE1D7E819D7A431D7C90EA0E5F,
+)
+P521 = Curve(
+    name="P-521",
+    p=_P521_P,
+    a=_P521_P - 3,
+    b=0x0051953EB9618E1C9A1F929A21A0B68540EEA2DA725B99B315F3B8B489918EF109E156193951EC7E937B1652C0BD3BB1BF073573DF883D2C34F1EF451FD46B503F00,
+    n=0x01FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFA51868783BF2F966B7FCC0148F709A5D03BB5C9B8899C47AEBB6FB71E91386409,
+    gx=0x00C6858E06B70404E9CD9E3ECB662395B4429C648139053FB521F828AF606B4D3DBAA14B5E77EFE75928FE1DC127A2FFA8DE3348B3C1856A429BF97E7E31C2E5BD66,
+    gy=0x011839296A789A3BC0045C8A5FB42C7D1BD998F54449579B446817AFBD17273E662C97EE72995EF42640C550B9013FAD0761353C7086A272C24088BE94769FD16650,
+)
+
+CURVES = {"P-256": P256, "P-384": P384, "P-521": P521}
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+# Jacobian-coordinate point arithmetic: avoids a modular inverse per step.
+# Points are (X, Y, Z) with x = X/Z^2, y = Y/Z^3; Z == 0 is infinity.
+
+
+def _jac_double(P, curve: Curve):
+    X, Y, Z = P
+    if not Y or not Z:
+        return (0, 1, 0)
+    p = curve.p
+    YY = Y * Y % p
+    S = 4 * X * YY % p
+    M = (3 * X * X + curve.a * Z * Z % p * Z % p * Z) % p
+    X3 = (M * M - 2 * S) % p
+    Y3 = (M * (S - X3) - 8 * YY * YY) % p
+    Z3 = 2 * Y * Z % p
+    return (X3, Y3, Z3)
+
+
+def _jac_add(P, Q, curve: Curve):
+    if not P[2]:
+        return Q
+    if not Q[2]:
+        return P
+    p = curve.p
+    X1, Y1, Z1 = P
+    X2, Y2, Z2 = Q
+    Z1Z1 = Z1 * Z1 % p
+    Z2Z2 = Z2 * Z2 % p
+    U1 = X1 * Z2Z2 % p
+    U2 = X2 * Z1Z1 % p
+    S1 = Y1 * Z2 % p * Z2Z2 % p
+    S2 = Y2 * Z1 % p * Z1Z1 % p
+    if U1 == U2:
+        if S1 != S2:
+            return (0, 1, 0)
+        return _jac_double(P, curve)
+    H = (U2 - U1) % p
+    R = (S2 - S1) % p
+    HH = H * H % p
+    HHH = HH * H % p
+    V = U1 * HH % p
+    X3 = (R * R - HHH - 2 * V) % p
+    Y3 = (R * (V - X3) - S1 * HHH) % p
+    Z3 = Z1 * Z2 % p * H % p
+    return (X3, Y3, Z3)
+
+
+def _jac_mul(k: int, P, curve: Curve):
+    R = (0, 1, 0)
+    while k:
+        if k & 1:
+            R = _jac_add(R, P, curve)
+        P = _jac_double(P, curve)
+        k >>= 1
+    return R
+
+
+def _to_affine(P, curve: Curve) -> Optional[tuple[int, int]]:
+    X, Y, Z = P
+    if not Z:
+        return None
+    zi = _inv(Z, curve.p)
+    zi2 = zi * zi % curve.p
+    return (X * zi2 % curve.p, Y * zi2 % curve.p * zi % curve.p)
+
+
+def ec_derive_public(curve: Curve, d: int) -> tuple[int, int]:
+    """d*G — recover the public point from a private scalar (PKCS#8 EC keys
+    without an embedded public point)."""
+    pt = _to_affine(_jac_mul(d, (curve.gx, curve.gy, 1), curve), curve)
+    if pt is None:
+        raise ValueError("invalid EC private scalar")
+    return pt
+
+
+@dataclass(frozen=True)
+class ECPublicKey:
+    curve: Curve
+    x: int
+    y: int
+
+    def verify(self, r: int, s: int, digest: bytes) -> bool:
+        n = self.curve.n
+        if not (1 <= r < n and 1 <= s < n):
+            return False
+        z = int.from_bytes(digest, "big")
+        excess = len(digest) * 8 - n.bit_length()
+        if excess > 0:
+            z >>= excess
+        w = _inv(s, n)
+        u1 = z * w % n
+        u2 = r * w % n
+        # u1*G + u2*Q via two muls + add (speed is irrelevant here)
+        G = (self.curve.gx, self.curve.gy, 1)
+        Q = (self.x, self.y, 1)
+        R = _jac_add(_jac_mul(u1, G, self.curve), _jac_mul(u2, Q, self.curve), self.curve)
+        pt = _to_affine(R, self.curve)
+        if pt is None:
+            return False
+        return pt[0] % n == r
+
+
+@dataclass(frozen=True)
+class RSAPublicKey:
+    n: int
+    e: int
+
+    def verify_pkcs1v15(self, sig: bytes, digest_info: bytes) -> bool:
+        k = (self.n.bit_length() + 7) // 8
+        if len(sig) != k:
+            return False
+        em = pow(int.from_bytes(sig, "big"), self.e, self.n).to_bytes(k, "big")
+        pad_len = k - len(digest_info) - 3
+        if pad_len < 8:
+            return False
+        expected = b"\x00\x01" + b"\xff" * pad_len + b"\x00" + digest_info
+        return _hmac.compare_digest(em, expected)
+
+
+# -- verification entry point (mirrors auxdata._verify_signature) ------------
+
+
+def verify(alg: str, key, signing_input: bytes, sig: bytes) -> bool:
+    """JWS signature check for HS*/RS*/ES* over softcrypto key objects.
+    ``key`` may also be the ("hmac", secret) tuple auxdata uses for oct keys."""
+    bits = alg[2:]
+    mk_hash = _HASHES.get(bits)
+    if mk_hash is None:
+        return False
+    try:
+        if alg.startswith("HS"):
+            if not (isinstance(key, tuple) and key[0] == "hmac"):
+                return False
+            mac = _hmac.new(key[1], signing_input, mk_hash)
+            return _hmac.compare_digest(mac.digest(), sig)
+        digest = mk_hash(signing_input).digest()
+        if alg.startswith("RS"):
+            if not isinstance(key, RSAPublicKey):
+                return False
+            return key.verify_pkcs1v15(sig, _DIGEST_INFO[bits] + digest)
+        if alg.startswith("ES"):
+            if not isinstance(key, ECPublicKey):
+                return False
+            if len(sig) % 2:
+                return False
+            half = len(sig) // 2
+            r = int.from_bytes(sig[:half], "big")
+            s = int.from_bytes(sig[half:], "big")
+            return key.verify(r, s, digest)
+    except Exception:  # noqa: BLE001 — any malformed input is just "no"
+        return False
+    return False
+
+
+# -- minimal DER / PEM parsing -----------------------------------------------
+
+
+class DERError(ValueError):
+    pass
+
+
+def _der_read(data: bytes, off: int) -> tuple[int, bytes, int]:
+    """One TLV at ``off`` → (tag, value, next_offset)."""
+    if off + 2 > len(data):
+        raise DERError("truncated DER")
+    tag = data[off]
+    length = data[off + 1]
+    off += 2
+    if length & 0x80:
+        nlen = length & 0x7F
+        if nlen == 0 or off + nlen > len(data):
+            raise DERError("bad DER length")
+        length = int.from_bytes(data[off : off + nlen], "big")
+        off += nlen
+    if off + length > len(data):
+        raise DERError("truncated DER value")
+    return tag, data[off : off + length], off + length
+
+
+def _der_seq(data: bytes) -> list[tuple[int, bytes]]:
+    """All TLVs inside a constructed value."""
+    out = []
+    off = 0
+    while off < len(data):
+        tag, val, off = _der_read(data, off)
+        out.append((tag, val))
+    return out
+
+
+def _der_int(val: bytes) -> int:
+    return int.from_bytes(val, "big")
+
+
+_OID_RSA = bytes.fromhex("2a864886f70d010101")  # 1.2.840.113549.1.1.1
+_OID_EC = bytes.fromhex("2a8648ce3d0201")  # 1.2.840.10045.2.1
+_OID_CURVES = {
+    bytes.fromhex("2a8648ce3d030107"): P256,  # 1.2.840.10045.3.1.7
+    bytes.fromhex("2b81040022"): P384,  # 1.3.132.0.34
+    bytes.fromhex("2b81040023"): P521,  # 1.3.132.0.35
+}
+
+
+def _ec_point(curve: Curve, raw: bytes) -> ECPublicKey:
+    if not raw or raw[0] != 0x04 or len(raw) != 1 + 2 * curve.size:
+        raise DERError("unsupported EC point encoding")
+    x = int.from_bytes(raw[1 : 1 + curve.size], "big")
+    y = int.from_bytes(raw[1 + curve.size :], "big")
+    return ECPublicKey(curve=curve, x=x, y=y)
+
+
+def _parse_spki(der: bytes):
+    """SubjectPublicKeyInfo → RSAPublicKey | ECPublicKey."""
+    tag, body, _ = _der_read(der, 0)
+    if tag != 0x30:
+        raise DERError("not a SubjectPublicKeyInfo")
+    items = _der_seq(body)
+    if len(items) != 2 or items[0][0] != 0x30 or items[1][0] != 0x03:
+        raise DERError("not a SubjectPublicKeyInfo")
+    alg_items = _der_seq(items[0][1])
+    if not alg_items or alg_items[0][0] != 0x06:
+        raise DERError("missing algorithm OID")
+    oid = alg_items[0][1]
+    keybits = items[1][1]
+    if keybits[:1] != b"\x00":
+        raise DERError("unsupported BIT STRING padding")
+    keydata = keybits[1:]
+    if oid == _OID_RSA:
+        tag, rsabody, _ = _der_read(keydata, 0)
+        ints = _der_seq(rsabody)
+        if tag != 0x30 or len(ints) < 2:
+            raise DERError("bad RSAPublicKey")
+        return RSAPublicKey(n=_der_int(ints[0][1]), e=_der_int(ints[1][1]))
+    if oid == _OID_EC:
+        if len(alg_items) < 2 or alg_items[1][0] != 0x06:
+            raise DERError("missing EC named curve")
+        curve = _OID_CURVES.get(alg_items[1][1])
+        if curve is None:
+            raise DERError("unsupported EC curve")
+        return _ec_point(curve, keydata)
+    raise DERError("unsupported public key algorithm")
+
+
+def _parse_sec1_ec_private(der: bytes, curve: Optional[Curve]):
+    """SEC1 ECPrivateKey → public half (embedded point, or derived d*G)."""
+    tag, body, _ = _der_read(der, 0)
+    if tag != 0x30:
+        raise DERError("not an ECPrivateKey")
+    d = None
+    pub = None
+    for itag, val in _der_seq(body):
+        if itag == 0x04 and d is None:
+            d = _der_int(val)
+        elif itag == 0xA0:  # [0] ECParameters (named curve)
+            inner = _der_seq(val)
+            if inner and inner[0][0] == 0x06:
+                curve = _OID_CURVES.get(inner[0][1], curve)
+        elif itag == 0xA1:  # [1] public key BIT STRING
+            inner = _der_seq(val)
+            if inner and inner[0][0] == 0x03 and inner[0][1][:1] == b"\x00":
+                pub = inner[0][1][1:]
+    if curve is None:
+        raise DERError("EC private key without a named curve")
+    if pub is not None:
+        return _ec_point(curve, pub)
+    if d is None:
+        raise DERError("EC private key without a scalar")
+    x, y = ec_derive_public(curve, d)
+    return ECPublicKey(curve=curve, x=x, y=y)
+
+
+def _parse_pkcs1_rsa_private(der: bytes) -> RSAPublicKey:
+    tag, body, _ = _der_read(der, 0)
+    ints = _der_seq(body)
+    if tag != 0x30 or len(ints) < 3:
+        raise DERError("bad RSAPrivateKey")
+    return RSAPublicKey(n=_der_int(ints[1][1]), e=_der_int(ints[2][1]))
+
+
+def _parse_pkcs8(der: bytes):
+    """PKCS#8 PrivateKeyInfo → public half of the wrapped key."""
+    tag, body, _ = _der_read(der, 0)
+    if tag != 0x30:
+        raise DERError("not a PrivateKeyInfo")
+    items = _der_seq(body)
+    if len(items) < 3 or items[1][0] != 0x30 or items[2][0] != 0x04:
+        raise DERError("not a PrivateKeyInfo")
+    alg_items = _der_seq(items[1][1])
+    if not alg_items or alg_items[0][0] != 0x06:
+        raise DERError("missing algorithm OID")
+    oid = alg_items[0][1]
+    inner = items[2][1]
+    if oid == _OID_RSA:
+        return _parse_pkcs1_rsa_private(inner)
+    if oid == _OID_EC:
+        curve = None
+        if len(alg_items) > 1 and alg_items[1][0] == 0x06:
+            curve = _OID_CURVES.get(alg_items[1][1])
+        return _parse_sec1_ec_private(inner, curve)
+    raise DERError("unsupported private key algorithm")
+
+
+def parse_pem_block(block: str):
+    """One '-----BEGIN X-----' block → RSAPublicKey | ECPublicKey.
+    Private keys are reduced to their public half."""
+    import base64
+    import re
+
+    m = re.match(
+        r"-----BEGIN ([A-Z0-9 ]+)-----(.*?)-----END \1-----",
+        block,
+        re.DOTALL,
+    )
+    if not m:
+        raise DERError("malformed PEM block")
+    label = m.group(1)
+    der = base64.b64decode("".join(m.group(2).split()))
+    if label == "PUBLIC KEY":
+        return _parse_spki(der)
+    if label == "PRIVATE KEY":
+        return _parse_pkcs8(der)
+    if label == "EC PRIVATE KEY":
+        return _parse_sec1_ec_private(der, None)
+    if label == "RSA PRIVATE KEY":
+        return _parse_pkcs1_rsa_private(der)
+    if label == "RSA PUBLIC KEY":
+        tag, body, _ = _der_read(der, 0)
+        ints = _der_seq(body)
+        if tag != 0x30 or len(ints) < 2:
+            raise DERError("bad RSAPublicKey")
+        return RSAPublicKey(n=_der_int(ints[0][1]), e=_der_int(ints[1][1]))
+    raise DERError(f"unsupported PEM block type {label!r}")
+
+
+def jwk_public_key(k: dict, b64url) -> object:
+    """JWK dict → softcrypto key (the auxdata ``_jwk_from_dict`` fallback).
+    ``b64url`` is the caller's base64url decoder (shared error behavior)."""
+    kty = k.get("kty")
+    if kty == "RSA":
+        return RSAPublicKey(
+            n=int.from_bytes(b64url(k["n"]), "big"),
+            e=int.from_bytes(b64url(k["e"]), "big"),
+        )
+    if kty == "EC":
+        curve = CURVES[k["crv"]]
+        return ECPublicKey(
+            curve=curve,
+            x=int.from_bytes(b64url(k["x"]), "big"),
+            y=int.from_bytes(b64url(k["y"]), "big"),
+        )
+    if kty == "oct":
+        return ("hmac", b64url(k["k"]))
+    raise ValueError(f"unsupported key type {kty!r}")

@@ -1352,6 +1352,230 @@ PyObject* PyEncodeAttrColumnsMulti(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// -- two-level packed bitmap sweep (ruletable/index.py bitmap backend) -------
+//
+// Each dimension arrives as a pair of uint64 numpy arrays: `words` (bit r of
+// words[r>>6] set iff row r is in the posting list) and `summary` (bit w of
+// summary[w>>6] set iff words[w] != 0). The sweep ANDs the summary level to
+// find candidate 64-word blocks, ANDs only the live words, and decodes set
+// bits into ascending row ids — the C twin of index._sweep_numpy.
+
+struct BitmapDims {
+  std::vector<Py_buffer> bufs;       // all acquired buffers (released in dtor)
+  std::vector<const uint64_t*> words;
+  std::vector<Py_ssize_t> words_len; // in uint64 words
+  std::vector<const uint64_t*> sums;
+  std::vector<Py_ssize_t> sums_len;
+  bool ok = false;
+
+  ~BitmapDims() {
+    for (auto& b : bufs) PyBuffer_Release(&b);
+  }
+
+  // sums_seq may be Py_None: small tables skip the summary level entirely
+  // (a linear word AND beats six extra buffer acquisitions).
+  bool Acquire(PyObject* words_seq, PyObject* sums_seq) {
+    PyObject* wfast = PySequence_Fast(words_seq, "words must be a sequence");
+    if (!wfast) return false;
+    PyObject* sfast = nullptr;
+    if (sums_seq != Py_None) {
+      sfast = PySequence_Fast(sums_seq, "summaries must be a sequence");
+      if (!sfast) {
+        Py_DECREF(wfast);
+        return false;
+      }
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(wfast);
+    bool good = n > 0 && (!sfast || PySequence_Fast_GET_SIZE(sfast) == n);
+    if (!good) {
+      PyErr_SetString(PyExc_ValueError, "words/summary dimension mismatch");
+    }
+    bufs.reserve((sfast ? 2 : 1) * (size_t)n);
+    for (Py_ssize_t i = 0; good && i < n; i++) {
+      Py_buffer wb, sb;
+      if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(wfast, i), &wb,
+                             PyBUF_SIMPLE) < 0) {
+        good = false;
+        break;
+      }
+      bufs.push_back(wb);
+      words.push_back(static_cast<const uint64_t*>(wb.buf));
+      words_len.push_back(wb.len / 8);
+      if (sfast) {
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(sfast, i), &sb,
+                               PyBUF_SIMPLE) < 0) {
+          good = false;
+          break;
+        }
+        bufs.push_back(sb);
+        sums.push_back(static_cast<const uint64_t*>(sb.buf));
+        sums_len.push_back(sb.len / 8);
+      }
+    }
+    Py_DECREF(wfast);
+    Py_XDECREF(sfast);
+    ok = good;
+    return good;
+  }
+
+  // shortest common word / summary extents (missing tails are all-zero)
+  Py_ssize_t MinWords() const {
+    Py_ssize_t m = words_len[0];
+    for (size_t i = 1; i < words_len.size(); i++)
+      if (words_len[i] < m) m = words_len[i];
+    return m;
+  }
+  Py_ssize_t MinSums() const {
+    Py_ssize_t m = sums_len[0];
+    for (size_t i = 1; i < sums_len.size(); i++)
+      if (sums_len[i] < m) m = sums_len[i];
+    return m;
+  }
+};
+
+// bitmap_sweep(words_seq, sums_seq, extra_words|None, rows|None)
+//   -> (base_any, list)
+// `extra` is the action dimension: legacy query semantics exclude it from the
+// base-emptiness check (an empty base suppresses role-policy DENY synthesis;
+// an empty action intersect does not), so it is applied after base_any is
+// known. With `rows`, set bits gather rows[rid] (skipping None) instead of
+// returning raw ids.
+PyObject* PyBitmapSweep(PyObject*, PyObject* args) {
+  PyObject *words_seq, *sums_seq, *extra_obj, *rows_obj;
+  if (!PyArg_ParseTuple(args, "OOOO", &words_seq, &sums_seq, &extra_obj,
+                        &rows_obj))
+    return nullptr;
+
+  if (rows_obj != Py_None && !PyList_Check(rows_obj)) {
+    PyErr_SetString(PyExc_TypeError, "rows must be a list or None");
+    return nullptr;
+  }
+  const Py_ssize_t nrows = rows_obj != Py_None ? PyList_GET_SIZE(rows_obj) : 0;
+
+  BitmapDims dims;
+  if (!dims.Acquire(words_seq, sums_seq)) return nullptr;
+
+  Py_buffer extra_b;
+  const uint64_t* extra = nullptr;
+  Py_ssize_t extra_len = 0;
+  if (extra_obj != Py_None) {
+    if (PyObject_GetBuffer(extra_obj, &extra_b, PyBUF_SIMPLE) < 0)
+      return nullptr;
+    extra = static_cast<const uint64_t*>(extra_b.buf);
+    extra_len = extra_b.len / 8;
+  }
+
+  PyObject* out = PyList_New(0);
+  if (!out) {
+    if (extra) PyBuffer_Release(&extra_b);
+    return nullptr;
+  }
+
+  const Py_ssize_t L = dims.MinWords();
+  const size_t nd = dims.words.size();
+  bool base_any = false;
+  bool fail = false;
+
+  auto emit_word = [&](Py_ssize_t w) {
+    uint64_t acc = dims.words[0][w];
+    for (size_t i = 1; i < nd && acc; i++) acc &= dims.words[i][w];
+    if (!acc) return;
+    base_any = true;
+    if (extra) acc &= (w < extra_len) ? extra[w] : 0;
+    while (acc) {
+      const int rbit = __builtin_ctzll(acc);
+      acc &= acc - 1;
+      const Py_ssize_t rid = (w << 6) + rbit;
+      if (rows_obj != Py_None) {
+        if (rid >= nrows) continue;  // capacity words past the row list
+        PyObject* row = PyList_GET_ITEM(rows_obj, rid);  // borrowed
+        if (row == Py_None) continue;
+        if (PyList_Append(out, row) < 0) {
+          fail = true;
+          return;
+        }
+      } else {
+        PyObject* rid_obj = PyLong_FromSsize_t(rid);
+        if (!rid_obj || PyList_Append(out, rid_obj) < 0) {
+          Py_XDECREF(rid_obj);
+          fail = true;
+          return;
+        }
+        Py_DECREF(rid_obj);
+      }
+    }
+  };
+
+  if (dims.sums.empty()) {
+    for (Py_ssize_t w = 0; w < L && !fail; w++) emit_word(w);
+  } else {
+    const Py_ssize_t S = dims.MinSums();
+    for (Py_ssize_t s = 0; s < S && !fail; s++) {
+      uint64_t m = dims.sums[0][s];
+      for (size_t i = 1; i < nd && m; i++) m &= dims.sums[i][s];
+      while (m && !fail) {
+        const int bit = __builtin_ctzll(m);
+        m &= m - 1;
+        const Py_ssize_t w = (s << 6) + bit;
+        if (w >= L) break;  // ascending: later words in this block are past L
+        emit_word(w);
+      }
+    }
+  }
+
+  if (extra) PyBuffer_Release(&extra_b);
+  if (fail) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  PyObject* res = PyTuple_New(2);
+  if (!res) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  PyTuple_SET_ITEM(res, 0, PyBool_FromLong(base_any));
+  PyTuple_SET_ITEM(res, 1, out);
+  return res;
+}
+
+// bitmap_any(words_seq, sums_seq) -> bool — sweep with first-hit early exit
+// (exists checks).
+PyObject* PyBitmapAny(PyObject*, PyObject* args) {
+  PyObject *words_seq, *sums_seq;
+  if (!PyArg_ParseTuple(args, "OO", &words_seq, &sums_seq)) return nullptr;
+
+  BitmapDims dims;
+  if (!dims.Acquire(words_seq, sums_seq)) return nullptr;
+
+  const Py_ssize_t L = dims.MinWords();
+  const size_t nd = dims.words.size();
+
+  if (dims.sums.empty()) {
+    for (Py_ssize_t w = 0; w < L; w++) {
+      uint64_t acc = dims.words[0][w];
+      for (size_t i = 1; i < nd && acc; i++) acc &= dims.words[i][w];
+      if (acc) Py_RETURN_TRUE;
+    }
+    Py_RETURN_FALSE;
+  }
+
+  const Py_ssize_t S = dims.MinSums();
+  for (Py_ssize_t s = 0; s < S; s++) {
+    uint64_t m = dims.sums[0][s];
+    for (size_t i = 1; i < nd && m; i++) m &= dims.sums[i][s];
+    while (m) {
+      const int bit = __builtin_ctzll(m);
+      m &= m - 1;
+      const Py_ssize_t w = (s << 6) + bit;
+      if (w >= L) break;
+      uint64_t acc = dims.words[0][w];
+      for (size_t i = 1; i < nd && acc; i++) acc &= dims.words[i][w];
+      if (acc) Py_RETURN_TRUE;
+    }
+  }
+  Py_RETURN_FALSE;
+}
+
 PyMethodDef kMethods[] = {
     {"glob_match", PyGlobMatch, METH_VARARGS,
      "glob_match(pattern, value) -> bool — gobwas-style glob with ':' separator"},
@@ -1376,6 +1600,12 @@ PyMethodDef kMethods[] = {
     {"decode_node_pool", PyDecodeNodePool, METH_VARARGS,
      "decode_node_pool(raw_nodes, class_map, dec_value) -> list — linear "
      "decode of the bundle codec node pool without running __init__"},
+    {"bitmap_sweep", PyBitmapSweep, METH_VARARGS,
+     "bitmap_sweep(words_seq, sums_seq, extra|None, rows|None) -> "
+     "(base_any, list) — fused two-level packed-bitmap AND sweep"},
+    {"bitmap_any", PyBitmapAny, METH_VARARGS,
+     "bitmap_any(words_seq, sums_seq) -> bool — packed-bitmap AND with "
+     "first-hit early exit"},
     {nullptr, nullptr, 0, nullptr},
 };
 

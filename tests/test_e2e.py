@@ -58,6 +58,7 @@ def _self_signed_cert(cn: str):
     """(cert_pem, key_pem) self-signed for 127.0.0.1."""
     import ipaddress
 
+    pytest.importorskip("cryptography", reason="TLS tests need cert generation")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -252,6 +253,7 @@ def test_grpc_tls_check(pdp):
 
 
 def _server_cert_serial(handle) -> int:
+    pytest.importorskip("cryptography", reason="TLS tests need cert parsing")
     from cryptography import x509
 
     ctx = _tls_context(handle)

@@ -1,0 +1,326 @@
+"""Differential tests for the two-level packed bitmap rule index.
+
+The bitmap backend (ruletable/index.py) must return byte-identical row lists
+to the legacy set-algebra oracle for every query, across arbitrary
+build/delete interleavings and for both sweep kernels (native C and numpy
+fallback). Plus memo-cold regressions: with the request-shape memos disabled,
+queries over the bench corpus shapes must stay correct and the two backends
+must agree.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import cerbos_tpu.ruletable.index as index_mod
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table
+from cerbos_tpu.ruletable.index import Index, PackedBitmap, _sweep_numpy
+from cerbos_tpu.ruletable.rows import KIND_PRINCIPAL, KIND_RESOURCE, RuleRow
+from cerbos_tpu.util import bench_corpus
+
+
+def row_key(r: RuleRow):
+    """Identity of a query result row, synthetic DENYs included."""
+    return (
+        r.id,
+        r.origin_fqn,
+        r.scope,
+        r.version,
+        r.policy_kind,
+        r.resource,
+        r.role,
+        r.action,
+        r.effect,
+        r.from_role_policy,
+        r.no_match_for_scope_permissions,
+    )
+
+
+def assert_identical(bitmap_idx: Index, legacy_idx: Index, query: tuple):
+    got = [row_key(r) for r in bitmap_idx.query(*query)]
+    want = [row_key(r) for r in legacy_idx.query(*query)]
+    assert got == want, f"divergence for query {query!r}"
+
+
+# -- PackedBitmap unit tests --------------------------------------------------
+
+
+class TestPackedBitmap:
+    def test_add_discard_both_levels(self):
+        bm = PackedBitmap()
+        for rid in (0, 63, 64, 4095, 4096, 70000):
+            bm.add(rid)
+        assert bm.n == 6
+        # summary bit j set iff words[j] != 0
+        for w, word in enumerate(bm.words):
+            have = bool(int(bm.summary[w >> 6]) & (1 << (w & 63)))
+            assert have == (int(word) != 0)
+        bm.discard(64)
+        bm.discard(64)  # idempotent
+        bm.discard(10**6)  # out of range: no-op
+        assert bm.n == 5
+        # word 1 is now empty: its summary bit must be cleared (free-id reuse
+        # correctness depends on this)
+        assert not int(bm.summary[0]) & (1 << 1)
+        _, ids = _sweep_numpy([bm.words], [bm.summary], None, None)
+        assert ids == [0, 63, 4095, 4096, 70000]
+
+    def test_add_existing_is_noop(self):
+        bm = PackedBitmap()
+        bm.add(7)
+        bm.add(7)
+        assert bm.n == 1
+
+    def test_union(self):
+        a, b = PackedBitmap(), PackedBitmap()
+        for rid in (1, 100, 5000):
+            a.add(rid)
+        for rid in (100, 200):
+            b.add(rid)
+        u = PackedBitmap.union([a, b])
+        assert u.n == 4
+        _, ids = _sweep_numpy([u.words], [u.summary], None, None)
+        assert ids == [1, 100, 200, 5000]
+        assert PackedBitmap.union([]).n == 0
+
+
+# -- sweep kernel equivalence -------------------------------------------------
+
+
+@pytest.mark.index_parity
+def test_native_and_numpy_kernels_agree():
+    if not index_mod._native_resolved:
+        index_mod._resolve_native()
+    nat = index_mod._native_bitmap_sweep
+    if nat is None:
+        pytest.skip("native extension unavailable")
+    rng = random.Random(7)
+    for trial in range(50):
+        nbits = rng.choice([64, 640, 8192])
+        dims = []
+        for _ in range(rng.randint(1, 5)):
+            bm = PackedBitmap()
+            for _ in range(rng.randint(0, 200)):
+                bm.add(rng.randrange(nbits))
+            # ensure arrays exist even for an empty bitmap
+            bm.add(0)
+            bm.discard(0)
+            dims.append(bm)
+        extra = None
+        if rng.random() < 0.5:
+            ebm = PackedBitmap()
+            for _ in range(rng.randint(0, 50)):
+                ebm.add(rng.randrange(nbits))
+            extra = ebm.words
+        ws = [d.words for d in dims]
+        ss = [d.summary for d in dims]
+        want = _sweep_numpy(ws, ss, extra.copy() if extra is not None else None, None)
+        have_sum = nat(ws, ss, extra, None)
+        have_lin = nat(ws, None, extra, None)
+        assert have_sum == want, f"trial {trial}: summary sweep diverged"
+        assert have_lin == want, f"trial {trial}: linear sweep diverged"
+
+
+# -- seeded fuzz: random build/delete/query interleavings ---------------------
+
+
+SCOPES = ["", "acme", "acme.hr", "acme.hr.uk"]
+VERSIONS = ["default", "v1"]
+RESOURCES = ["leave_request", "purchase_order", "expense:claim", "salary_record"]
+RESOURCE_PATTERNS = RESOURCES + ["*", "expense:*", "leave_*"]
+ROLES = ["employee", "manager", "admin", "auditor"]
+ACTIONS = ["view", "view:public", "approve", "delete", "create"]
+ACTION_PATTERNS = ACTIONS + ["*", "view:*"]
+
+
+def random_row(rng: random.Random, fqn: str) -> RuleRow:
+    kind = rng.choice([KIND_PRINCIPAL, KIND_RESOURCE])
+    role_policy = kind == KIND_RESOURCE and rng.random() < 0.15
+    return RuleRow(
+        origin_fqn=fqn,
+        scope=rng.choice(SCOPES),
+        version=rng.choice(VERSIONS),
+        policy_kind=kind,
+        resource=rng.choice(RESOURCE_PATTERNS),
+        role=rng.choice(ROLES) if rng.random() < 0.8 else "*",
+        action=None if role_policy else rng.choice(ACTION_PATTERNS),
+        allow_actions=(
+            frozenset(rng.sample(ACTION_PATTERNS, rng.randint(1, 3)))
+            if role_policy
+            else None
+        ),
+        principal=rng.choice(["", "", "", "alice", "bob"]) or None,
+        effect=rng.choice(["EFFECT_ALLOW", "EFFECT_DENY"]),
+    )
+
+
+def random_query(rng: random.Random) -> tuple:
+    return (
+        rng.choice(VERSIONS + [""]),
+        rng.choice(RESOURCES + [""]),
+        rng.choice(SCOPES + ["nonexistent"]),
+        rng.choice(ACTIONS + [""]),
+        rng.sample(ROLES, rng.randint(0, 3)),
+        rng.choice([KIND_PRINCIPAL, KIND_RESOURCE, ""]),
+        rng.choice(["", "alice", "bob", "charlie"]),
+    )
+
+
+@pytest.mark.index_parity
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("kernel", ["native", "numpy"])
+def test_differential_fuzz(seed, kernel, monkeypatch):
+    if not index_mod._native_resolved:
+        index_mod._resolve_native()
+    if kernel == "numpy":
+        monkeypatch.setattr(index_mod, "_native_bitmap_sweep", None)
+        monkeypatch.setattr(index_mod, "_native_bitmap_any", None)
+    elif index_mod._native_bitmap_sweep is None:
+        pytest.skip("native extension unavailable")
+
+    rng = random.Random(seed)
+    bitmap_idx = Index(backend="bitmap", memo_enabled=rng.random() < 0.5)
+    legacy_idx = Index(backend="legacy", memo_enabled=rng.random() < 0.5)
+    live_fqns: list[str] = []
+    fqn_counter = 0
+
+    for step in range(300):
+        op = rng.random()
+        if op < 0.35 or not live_fqns:
+            # ingest a policy worth of rows; both indexes assign the same ids
+            # because free-list order is mutation-order deterministic
+            fqn = f"policy.{fqn_counter}"
+            fqn_counter += 1
+            n = rng.randint(1, 5)
+            rows_a = [random_row(rng, fqn) for _ in range(n)]
+            bitmap_idx.index_rules(rows_a)
+            # clone the rows for the oracle: index_rules assigns ids in-place,
+            # so the two indexes must not share RuleRow objects
+            rows_b = [
+                RuleRow(**{
+                    f: getattr(r, f)
+                    for f in (
+                        "origin_fqn", "scope", "version", "policy_kind",
+                        "resource", "role", "action", "allow_actions",
+                        "principal", "effect",
+                    )
+                })
+                for r in rows_a
+            ]
+            legacy_idx.index_rules(rows_b)
+            live_fqns.append(fqn)
+        elif op < 0.5:
+            fqn = rng.choice(live_fqns)
+            live_fqns.remove(fqn)
+            bitmap_idx.delete_policy(fqn)
+            legacy_idx.delete_policy(fqn)
+        else:
+            assert_identical(bitmap_idx, legacy_idx, random_query(rng))
+
+    # final sweep: a fixed battery over the whole surviving table
+    battery_rng = random.Random(seed + 1000)
+    for _ in range(100):
+        assert_identical(bitmap_idx, legacy_idx, random_query(battery_rng))
+    assert [row_key(r) for r in bitmap_idx.get_all_rows()] == [
+        row_key(r) for r in legacy_idx.get_all_rows()
+    ]
+
+
+# -- memo-cold regression over the bench corpus shapes ------------------------
+
+
+@pytest.fixture(scope="module")
+def bench_tables():
+    n_mods = 20  # small slice of the bench corpus: fast but same shapes
+    compiled = compile_policy_set(list(parse_policies(bench_corpus.corpus_yaml(n_mods))))
+    rt_bitmap = build_rule_table(compiled, index_backend="bitmap")
+    rt_legacy = build_rule_table(compiled, index_backend="legacy")
+    return n_mods, rt_bitmap, rt_legacy
+
+
+@pytest.mark.index_parity
+def test_memo_cold_bench_corpus_parity(bench_tables):
+    from bench import index_query_tuples
+
+    n_mods, rt_bitmap, rt_legacy = bench_tables
+    rt_bitmap.idx.set_memo_enabled(False)
+    rt_legacy.idx.set_memo_enabled(False)
+    assert not rt_bitmap.idx.memo_enabled
+
+    qs = index_query_tuples(bench_corpus.requests(128, n_mods))
+    assert qs
+    nonempty = 0
+    for q in qs:
+        got = rt_bitmap.idx.query(*q)
+        want = rt_legacy.idx.query(*q)
+        assert [row_key(r) for r in got] == [row_key(r) for r in want]
+        nonempty += bool(got)
+        # memo really is cold: the result cache must stay empty
+        assert not rt_bitmap.idx._query_cache
+        assert not rt_legacy.idx._query_cache
+    assert nonempty > 0, "corpus queries all came back empty — corpus broken"
+
+
+@pytest.mark.index_parity
+def test_memo_cold_exists_parity(bench_tables):
+    n_mods, rt_bitmap, rt_legacy = bench_tables
+    rt_bitmap.idx.set_memo_enabled(False)
+    rt_legacy.idx.set_memo_enabled(False)
+    scopes_chains = [[""], ["acme", ""], ["nonexistent"]]
+    for version in ("default", "v1", ""):
+        for scopes in scopes_chains:
+            assert rt_bitmap.idx.scoped_principal_exists(version, scopes) == (
+                rt_legacy.idx.scoped_principal_exists(version, scopes)
+            )
+            for res in ("leave_request", "purchase_order", "nope"):
+                assert rt_bitmap.idx.scoped_resource_exists(version, res, scopes) == (
+                    rt_legacy.idx.scoped_resource_exists(version, res, scopes)
+                )
+        assert not rt_bitmap.idx._exists_cache
+
+
+def test_memo_toggle_restores_caching(bench_tables):
+    n_mods, rt_bitmap, _ = bench_tables
+    rt_bitmap.idx.set_memo_enabled(True)
+    q = ("default", "leave_request", "", "view:public", ["employee"], KIND_RESOURCE, "")
+    first = rt_bitmap.idx.query(*q)
+    assert rt_bitmap.idx._query_cache
+    assert rt_bitmap.idx.query(*q) is first  # memo hit returns the shared list
+
+
+def test_env_backend_selection(monkeypatch):
+    monkeypatch.setenv("CERBOS_TPU_RULE_INDEX", "legacy")
+    assert Index().backend == "legacy"
+    monkeypatch.setenv("CERBOS_TPU_RULE_INDEX", "bitmap")
+    assert Index().backend == "bitmap"
+    monkeypatch.setenv("CERBOS_TPU_RULE_INDEX", "bogus")
+    assert Index().backend == "bitmap"  # unknown env value falls back
+    with pytest.raises(ValueError):
+        Index(backend="bogus")
+
+
+def test_free_id_reuse_clears_both_levels():
+    idx = Index(backend="bitmap")
+    rows = [
+        RuleRow(
+            origin_fqn="p.a", scope="", version="default",
+            policy_kind=KIND_RESOURCE, resource="doc", role="admin",
+            action="view", effect="EFFECT_ALLOW",
+        )
+        for _ in range(70)  # spans more than one 64-bit word
+    ]
+    idx.index_rules(rows)
+    q = ("default", "doc", "", "view", ["admin"], KIND_RESOURCE, "")
+    assert len(idx.query(*q)) == 70
+    idx.delete_policy("p.a")
+    assert idx.query(*q) == []
+    # every dimension bitmap must have zeroed both levels
+    for dim in (idx._scope, idx._version, idx._policy_kind):
+        assert not dim.bm
+    assert not idx.resource.lit_bm and not idx.role.lit_bm
+    # re-ingest reuses the freed ids: stale bits would corrupt these results
+    idx.index_rules(rows[:3])
+    assert len(idx.query(*q)) == 3

@@ -490,6 +490,7 @@ class TestTLSHotReload:
     def _self_signed(cn: str):
         import datetime
 
+        pytest.importorskip("cryptography", reason="TLS tests need cert generation")
         from cryptography import x509
         from cryptography.hazmat.primitives import hashes, serialization
         from cryptography.hazmat.primitives.asymmetric import rsa
