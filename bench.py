@@ -206,6 +206,79 @@ def index_only_main(smoke: bool) -> int:
     return 0 if parity_ok else 1
 
 
+def served_main(smoke: bool) -> int:
+    """--served: throughput through the real serving path (BatchingEvaluator).
+
+    The direct-evaluator numbers above measure the device backend in
+    isolation; this mode measures what a gRPC/HTTP client population would
+    actually see. N client threads issue small requests concurrently (the
+    ghz-style load pattern); the batcher coalesces them into padded device
+    batches and streams them through submit/collect with several batches in
+    flight. Reports decisions/sec plus the batcher's own pipeline stats —
+    ``inflight_peak`` ≥ 2 is the signature that streaming engaged.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from cerbos_tpu.engine.batcher import BatchingEvaluator
+
+    evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
+    jax_ok = _merge_probe(evidence, tpu_probe.probe_ladder(attempts=1), "served")
+    tpu_probe.write_artifact(evidence)
+    if jax_ok:
+        tpu_probe.apply_env(evidence)
+    print(
+        f"served-path bench: backend={'jax-' + (evidence['platform'] or '?') if jax_ok else 'numpy'}",
+        flush=True,
+    )
+
+    policies = list(parse_policies(bench_corpus.corpus_yaml(N_MODS)))
+    rt = build_rule_table(compile_policy_set(policies))
+    params = EvalParams()
+    ev = TpuEvaluator(rt, use_jax=jax_ok)
+    batcher = BatchingEvaluator(
+        ev, max_batch=1024, max_wait_ms=2.0, min_batch_to_wait=8, max_inflight=3
+    )
+
+    req_size = 4  # inputs per client request (the classic template's shape)
+    n_clients = 16 if smoke else 64
+    n_rounds = 2 if smoke else 6
+    round_inputs = 2048 if smoke else 8192
+    all_inputs = bench_corpus.requests(round_inputs, N_MODS)
+    reqs = [all_inputs[b : b + req_size] for b in range(0, round_inputs, req_size)]
+    decisions_per_round = sum(len(i.actions) for r in reqs for i in r)
+
+    pool = ThreadPoolExecutor(max_workers=n_clients)
+    try:
+        outs = list(pool.map(lambda r: batcher.check(r, params), reqs))  # warmup
+        gctune.tune_for_serving()
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            outs = list(pool.map(lambda r: batcher.check(r, params), reqs))
+        wall = time.perf_counter() - t0
+    finally:
+        pool.shutdown(wait=True)
+        batcher.close()
+
+    allow = sum(
+        1 for ro in outs for o in ro for e in o.actions.values() if e.effect == "EFFECT_ALLOW"
+    )
+    assert allow > 0, "served workload produced no allows — corpus is broken"
+    rate = decisions_per_round * n_rounds / wall
+    record = {
+        "metric": "served_decisions_per_sec",
+        "value": round(rate, 1),
+        "unit": "decisions/s/chip",
+        "backend": "jax-" + (evidence["platform"] or "?") if jax_ok else "numpy",
+        "clients": n_clients,
+        "request_size": req_size,
+        "vs_baseline": round(rate / REFERENCE_DECISIONS_PER_SEC, 2),
+        "batcher": dict(batcher.stats),
+        "probe": tpu_probe.summarize(evidence),
+    }
+    print(json.dumps(record))
+    return 0
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -216,9 +289,16 @@ def main() -> None:
         "--index-only", action="store_true",
         help="memo-cold rule-index micro-bench + bitmap/legacy parity check only",
     )
+    parser.add_argument(
+        "--served", action="store_true",
+        help="measure through the real BatchingEvaluator serving path "
+        "(concurrent clients, cross-request batching, streaming pipeline)",
+    )
     args = parser.parse_args()
     if args.index_only:
         sys.exit(index_only_main(smoke=args.smoke))
+    if args.served:
+        sys.exit(served_main(smoke=args.smoke))
 
     evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
     probe = tpu_probe.probe_ladder(attempts=1)

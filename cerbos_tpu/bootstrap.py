@@ -76,6 +76,9 @@ def _make_evaluator(rule_table: Any, engine_conf: dict, schema_mgr: Any = None) 
         max_depth=int(tpu_conf.get("maxDepth", 8)),
         use_jax=backend != "numpy",
         min_device_batch=int(tpu_conf.get("minDeviceBatch", 16)),
+        pipeline_chunk=int(tpu_conf.get("pipelineChunk", 4096)),
+        streaming_threshold=int(tpu_conf.get("streamingThreshold", 1024)),
+        inflight_depth=int(tpu_conf.get("inflightDepth", 3)),
     )
 
 
@@ -139,6 +142,7 @@ def initialize(
                 max_batch=int(tpu_conf.get("maxBatch", 4096)),
                 max_wait_ms=float(tpu_conf.get("batchWindowMs", 2.0)),
                 request_timeout_s=float(tpu_conf.get("requestTimeoutMs", 30000)) / 1000.0,
+                max_inflight=int(tpu_conf.get("inflightDepth", 3)),
             )
             dispatch_evaluator = batcher
 
@@ -157,7 +161,10 @@ def initialize(
 
     def swap_engine(rt) -> None:
         engine.rule_table = rt
-        engine.tpu_evaluator = tpu_evaluator
+        # keep traffic on the batcher (it wraps the refreshed evaluator);
+        # rewiring to the raw evaluator here would silently drop
+        # cross-request batching after the first policy reload
+        engine.tpu_evaluator = dispatch_evaluator
         if prev_hook is not None:
             prev_hook(rt)
 

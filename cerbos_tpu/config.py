@@ -27,7 +27,19 @@ DEFAULTS: dict[str, Any] = {
         "defaultScope": "",
         "lenientScopeSearch": False,
         "globals": {},
-        "tpu": {"enabled": True, "batchThreshold": 5, "maxRoles": 8, "maxCandidates": 32, "maxDepth": 8},
+        "tpu": {
+            "enabled": True,
+            "batchThreshold": 5,
+            "maxRoles": 8,
+            "maxCandidates": 32,
+            "maxDepth": 8,
+            # streaming pipeline knobs: chunk size for device batches, batch
+            # size at which check() switches to the chunked pipeline, and how
+            # many device batches the pipeline/batcher keep in flight
+            "pipelineChunk": 4096,
+            "streamingThreshold": 1024,
+            "inflightDepth": 3,
+        },
     },
     "storage": {"driver": "disk", "disk": {"directory": "policies", "watchForChanges": False}},
     "schema": {"enforcement": "none"},

@@ -316,6 +316,186 @@ class OTLPMetricsExporter:
         self.flush()
 
 
+# ---------------------------------------------------------------------------
+# metrics registry (Prometheus text exposition)
+
+
+class Counter:
+    """Monotonic counter; rendered as a Prometheus ``counter``."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> list[str]:
+        return [f"# TYPE {self.name} counter", f"{self.name} {_fmt(self._value)}"]
+
+    def series(self) -> dict[str, float]:
+        return {self.name: self._value}
+
+
+class Gauge:
+    """Point-in-time value; ``track_max`` also exports ``<name>_peak``."""
+
+    __slots__ = ("name", "help", "_value", "_peak", "track_max", "_lock")
+
+    def __init__(self, name: str, help: str = "", track_max: bool = False):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._peak = 0.0
+        self.track_max = track_max
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+            if v > self._peak:
+                self._peak = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+            if self._value > self._peak:
+                self._peak = self._value
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    @property
+    def peak(self) -> float:
+        return self._peak
+
+    def render(self) -> list[str]:
+        out = [f"# TYPE {self.name} gauge", f"{self.name} {_fmt(self._value)}"]
+        if self.track_max:
+            out += [f"# TYPE {self.name}_peak gauge", f"{self.name}_peak {_fmt(self._peak)}"]
+        return out
+
+    def series(self) -> dict[str, float]:
+        out = {self.name: self._value}
+        if self.track_max:
+            out[f"{self.name}_peak"] = self._peak
+        return out
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus cumulative-bucket exposition."""
+
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, help: str = "", buckets: Optional[list[float]] = None):
+        self.name = name
+        self.help = help
+        self.buckets = sorted(buckets or [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0])
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def render(self) -> list[str]:
+        out = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+        out.append(f"{self.name}_sum {_fmt(self._sum)}")
+        out.append(f"{self.name}_count {self._count}")
+        return out
+
+    def series(self) -> dict[str, float]:
+        return {f"{self.name}_sum": self._sum, f"{self.name}_count": float(self._count)}
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class MetricsRegistry:
+    """Process-wide named metrics; get-or-create so forked workers and
+    re-initialized cores share one instrument per name."""
+
+    def __init__(self):
+        self._metrics: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "", track_max: bool = False) -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help, track_max=track_max))
+
+    def histogram(self, name: str, help: str = "", buckets: Optional[list[float]] = None) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help, buckets=buckets))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: list[str] = []
+        for _, m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat gauge view for the OTLP metrics exporter sources."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict[str, float] = {}
+        for m in metrics:
+            out.update(m.series())
+        return out
+
+
+_registry = MetricsRegistry()
+
+
+def metrics() -> MetricsRegistry:
+    return _registry
+
+
 _metrics_exporter: "OTLPMetricsExporter | None" = None
 
 

@@ -27,6 +27,15 @@ class ParseError(ValueError):
         super().__init__(f"{msg}{loc}{src}")
 
 
+class EmptyPolicyFile(ParseError):
+    """A file with no policy documents (empty, whitespace, or comments only).
+
+    The reference index builder silently ignores such files rather than
+    reporting a load failure (tests/golden/index/valid_files.yaml carries
+    empty and comment-only fixtures inside a corpus expected to build
+    cleanly), so loaders that walk directories skip this error."""
+
+
 def _expect_map(v: Any, path: str) -> dict:
     if not isinstance(v, dict):
         raise ParseError(f"expected a mapping, got {type(v).__name__}", path)
@@ -417,6 +426,8 @@ def parse_policy_file(path: str) -> model.Policy:
     with open(path, encoding="utf-8") as f:
         text = f.read()
     docs = _strict_docs(text, path)
+    if len(docs) == 0:
+        raise EmptyPolicyFile("expected exactly one policy document, found 0", source=path)
     if len(docs) != 1:
         raise ParseError(f"expected exactly one policy document, found {len(docs)}", source=path)
     doc, key_pos, val_pos = docs[0]

@@ -598,7 +598,10 @@ class Server:
             "# TYPE cerbos_dev_engine_check_batch_size_total counter",
             f"cerbos_dev_engine_check_batch_size_total {sum(m.batch_sizes)}",
         ]
-        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+        from ..observability import metrics as _obs_metrics
+
+        body = "\n".join(lines) + "\n" + _obs_metrics().render()
+        return web.Response(text=body, content_type="text/plain")
 
     async def _h_check_resources(self, request: web.Request) -> web.Response:
         try:

@@ -15,7 +15,7 @@ import time
 from typing import Optional
 
 from ..policy import model
-from ..policy.parser import ParseError, parse_policy_file
+from ..policy.parser import EmptyPolicyFile, ParseError, parse_policy_file
 from .store import EVENT_ADD_UPDATE, EVENT_DELETE, Event, Store, register_driver
 
 POLICY_EXTS = (".yaml", ".yml", ".json")
@@ -67,6 +67,10 @@ class DiskStore(Store):
         for path in self._iter_policy_files():
             try:
                 pol = parse_policy_file(path)
+            except EmptyPolicyFile:
+                # the reference index builder ignores empty / comment-only
+                # files instead of reporting a load failure
+                continue
             except (ParseError, OSError) as e:
                 errors.append(str(e))
                 continue

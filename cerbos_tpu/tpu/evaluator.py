@@ -16,6 +16,7 @@ tensors are batch-aligned so the same jit works single-chip or multi-chip
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Optional
 
 import numpy as np
@@ -649,16 +650,224 @@ def _pad_arrays(batch: PackedBatch, cols, cand_cond_c, cand_drcond_c, B_pad: int
     )
 
 
+class _BufferPool:
+    """Bounded free-lists of host staging buffers keyed by (shape, dtype).
+
+    The padded transfer matrices built per device batch dominate the host
+    dispatch path's allocations; batches in the same shape bucket need
+    byte-identical buffers, so recycle them instead of reallocating. A
+    buffer is leased at dispatch and released at finalize — by then the
+    single output fetch has completed, so every host->device transfer that
+    read the buffer is done (and outputs never alias inputs: nothing is
+    donated)."""
+
+    MAX_FREE = 4  # per key: bounds idle memory at ~one in-flight window
+
+    def __init__(self):
+        self._free: dict = {}
+        self._lock = threading.Lock()
+
+    def lease(self, shape, dtype) -> np.ndarray:
+        key = (shape, np.dtype(dtype).str)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                return free.pop()
+        return np.empty(shape, dtype=dtype)
+
+    def release(self, arrs) -> None:
+        with self._lock:
+            for a in arrs:
+                free = self._free.setdefault((a.shape, a.dtype.str), [])
+                if len(free) < self.MAX_FREE:
+                    free.append(a)
+
+
+_buffer_pool = _BufferPool()
+
+_layout_memo: dict = {}
+
+
+def _marshal_layout(cols, scope_D: int, has_now: bool) -> _StackLayout:
+    """Memoized _StackLayout marshalling: the sorted row orders only depend
+    on which columns the packer emitted, so key on the raw insertion-order
+    key tuples — cheap to build — and sort once per distinct signature."""
+    raw = (
+        tuple(cols.tags), tuple(cols.ts_his), tuple(cols.list_sids),
+        tuple(int(a.shape[1]) for a in cols.list_sids.values()),
+        tuple(cols.pred_vals), scope_D, has_now,
+    )
+    lay = _layout_memo.get(raw)
+    if lay is None:
+        if len(_layout_memo) > 512:
+            _layout_memo.clear()
+        list_paths = tuple(sorted(cols.list_sids))
+        lay = _StackLayout(
+            tuple(sorted(cols.tags)),
+            tuple(sorted(cols.ts_his)),
+            list_paths,
+            tuple(int(cols.list_sids[p].shape[1]) for p in list_paths),
+            tuple(sorted(cols.pred_vals)),
+            scope_D,
+            has_now,
+        )
+        _layout_memo[raw] = lay
+    return lay
+
+
+def _fill_rows(dst: np.ndarray, rows: list, native) -> None:
+    """Copy unpadded rows into the leading slots of dst's row stride,
+    zeroing each padded tail. Rows pad along their leading axis, so for
+    contiguous byte-compatible arrays this is a prefix memcpy + tail memset
+    — one native call per column family instead of a Python loop."""
+    if native is not None and all(
+        r.flags["C_CONTIGUOUS"]
+        and (r.dtype == dst.dtype or (dst.dtype == np.int8 and r.dtype == np.bool_))
+        for r in rows
+    ):
+        try:
+            native.stack_pad_rows(dst, rows)
+            return
+        except Exception:  # noqa: BLE001  (fall through to numpy)
+            pass
+    for i, r in enumerate(rows):
+        nv = r.shape[0]
+        dst[i, :nv] = r
+        dst[i, nv:] = 0
+
+
+def _pad_stack(batch: PackedBatch, cols, cand_cond_c, cand_drcond_c, B_pad: int, BA_pad: int):
+    """Fused _pad_arrays + _stack_padded for the single-device path.
+
+    The two-step version materializes a padded copy of every column (~100
+    np.concatenate) and then stacks those copies into the transfer matrices
+    (another full pass). Here each column's bytes are written exactly once,
+    straight into pooled padded matrices. Returns (stacked, layout, leased);
+    hand ``leased`` back to ``_buffer_pool`` once the device is done with
+    the batch (see _device_finalize)."""
+    from .. import native as native_mod
+
+    native = native_mod.get()
+    if native is not None and not hasattr(native, "stack_pad_rows"):
+        native = None
+    has_now = cols.now_hi is not None
+    D = batch.scope_sp.shape[2]
+    B = batch.scope_sp.shape[0]
+    lay = _marshal_layout(cols, D, has_now)
+    P, Tn, L, Q = len(lay.paths), len(lay.ts_paths), len(lay.list_paths), len(lay.pred_ids)
+    leased: list[np.ndarray] = []
+
+    def lease(shape, dtype):
+        a = _buffer_pool.lease(shape, dtype)
+        leased.append(a)
+        return a
+
+    n_i32 = 3 * P + 2 * Tn
+    if n_i32:
+        i32_cols = lease((n_i32, B_pad), np.int32)
+        _fill_rows(
+            i32_cols,
+            [cols.his[p] for p in lay.paths]
+            + [cols.los[p] for p in lay.paths]
+            + [cols.sids[p] for p in lay.paths]
+            + [cols.ts_his[p] for p in lay.ts_paths]
+            + [cols.ts_los[p] for p in lay.ts_paths],
+            native,
+        )
+    else:
+        i32_cols = np.zeros((0, B_pad), dtype=np.int32)
+
+    n_i8 = P + Tn + L + 2 * D
+    if n_i8:
+        i8_cols = lease((n_i8, B_pad), np.int8)
+        if P + Tn + L:
+            _fill_rows(
+                i8_cols[: P + Tn + L],
+                [cols.tags[p] for p in lay.paths]
+                + [cols.ts_states[p] for p in lay.ts_paths]
+                + [cols.list_states[p] for p in lay.list_paths],
+                native,
+            )
+        if D:
+            sp = i8_cols[P + Tn + L :]
+            sp[:, :B] = batch.scope_sp.transpose(1, 2, 0).reshape(2 * D, B)
+            sp[:, B:] = 0
+    else:
+        i8_cols = np.zeros((0, B_pad), dtype=np.int8)
+
+    n_bool = P + 2 * Q
+    if n_bool:
+        bool_cols = lease((n_bool, B_pad), np.bool_)
+        _fill_rows(
+            bool_cols,
+            [cols.nans[p] for p in lay.paths]
+            + [cols.pred_vals[q] for q in lay.pred_ids]
+            + [cols.pred_errs[q] for q in lay.pred_ids],
+            native,
+        )
+    else:
+        bool_cols = np.zeros((0, B_pad), dtype=bool)
+
+    if L:
+        wmax = max(lay.list_widths)
+        lists = lease((L, B_pad, wmax), np.int32)
+        for i, p in enumerate(lay.list_paths):
+            a = cols.list_sids[p]
+            nb, w = a.shape
+            lists[i, :nb, :w] = a
+            if w < wmax:
+                lists[i, :nb, w:] = 0
+            if nb < B_pad:
+                lists[i, nb:] = 0
+    else:
+        lists = np.zeros((0, B_pad, 1), dtype=np.int32)
+
+    BA = cand_cond_c.shape[0]
+    cand_i32 = lease((2, BA_pad) + cand_cond_c.shape[1:], np.int32)
+    cand_i32[0, :BA] = cand_cond_c
+    cand_i32[1, :BA] = cand_drcond_c
+    cand_i32[:, BA:] = -1  # pad_ba fill for cond ids
+    cand_i8 = lease((4, BA_pad) + batch.cand_effect.shape[1:], np.int8)
+    cand_i8[0, :BA] = batch.cand_effect
+    cand_i8[1, :BA] = batch.cand_pt
+    cand_i8[2, :BA] = batch.cand_depth
+    cand_i8[3, :BA] = batch.cand_valid
+    cand_i8[:, BA:] = 0
+    cand_i8[2, BA:] = -1  # pad_ba fill for depth
+
+    ba_input = lease((BA_pad,) + batch.ba_input.shape[1:], batch.ba_input.dtype)
+    ba_input[:BA] = batch.ba_input
+    ba_input[BA:] = 0
+
+    now = (
+        np.asarray([int(cols.now_hi), int(cols.now_lo)], dtype=np.int32)
+        if has_now
+        else np.zeros(2, dtype=np.int32)
+    )
+    stacked = dict(
+        i32_cols=i32_cols,
+        i8_cols=i8_cols,
+        bool_cols=bool_cols,
+        lists=lists,
+        cand_i32=cand_i32,
+        cand_i8=cand_i8,
+        ba_input=ba_input,
+        now=now,
+    )
+    return stacked, lay, leased
+
+
 class _DeviceHandle:
     """An in-flight device batch: the queued output array (device->host copy
     already started) plus everything needed to slice results back apart.
     ``ready`` short-circuits degenerate batches that never touch the device."""
 
-    __slots__ = ("ready", "out", "BA", "B", "K", "BA_pad", "B_pad", "col_map")
+    __slots__ = ("ready", "out", "BA", "B", "K", "BA_pad", "B_pad", "col_map", "leased")
 
     def __init__(self):
         self.ready = None
         self.out = None
+        self.leased = ()
 
 
 def _device_dispatch(lt: LoweredTable, batch: PackedBatch, jit_cache: dict) -> _DeviceHandle:
@@ -701,8 +910,9 @@ def _device_dispatch(lt: LoweredTable, batch: PackedBatch, jit_cache: dict) -> _
     col_map, cand_cond_c, cand_drcond_c = _variant_remap(
         variant_key, compiler, C, batch.cand_cond, batch.cand_drcond
     )
-    padded = _pad_arrays(batch, batch.columns, cand_cond_c, cand_drcond_c, B_pad, BA_pad)
-    stacked, layout = _stack_padded(padded)
+    stacked, layout, leased = _pad_stack(
+        batch, batch.columns, cand_cond_c, cand_drcond_c, B_pad, BA_pad
+    )
     key = (B_pad, BA_pad, K, J, D, variant_key, layout.sig)
     fn = jit_cache.get(key)
     if fn is None:
@@ -737,6 +947,7 @@ def _device_dispatch(lt: LoweredTable, batch: PackedBatch, jit_cache: dict) -> _
     h.BA, h.B, h.K = BA, B, K
     h.BA_pad, h.B_pad = BA_pad, B_pad
     h.col_map = col_map
+    h.leased = leased
     return h
 
 
@@ -746,6 +957,11 @@ def _device_finalize(h: _DeviceHandle):
         return h.ready
     K, BA = h.K, h.BA
     flat = np.asarray(h.out)  # ONE device->host fetch
+    if h.leased:
+        # the output is materialized, so every transfer that read the staging
+        # buffers has completed — recycle them for the next batch
+        _buffer_pool.release(h.leased)
+        h.leased = ()
     per_ba = 4 + K * 2 * 2 + K * 2
     cut = h.BA_pad * per_ba
     out_mat = flat[:cut].reshape(h.BA_pad, per_ba)
@@ -788,6 +1004,8 @@ class TpuEvaluator:
         min_device_batch: int = 16,
         mesh=None,
         pipeline_chunk: int = 4096,
+        streaming_threshold: int = 1024,
+        inflight_depth: int = 3,
     ):
         self.rule_table = rule_table
         self.schema_mgr = schema_mgr
@@ -797,6 +1015,12 @@ class TpuEvaluator:
         self.min_device_batch = min_device_batch
         self.mesh = mesh
         self.pipeline_chunk = pipeline_chunk
+        # batch size at which check() switches to the chunked double-buffered
+        # pipeline; 0 disables. Small enough that cross-request batches from
+        # the serving path engage it, not just bench-sized megabatches.
+        self.streaming_threshold = streaming_threshold
+        # device batches kept in flight by the pipelined path
+        self.inflight_depth = max(1, int(inflight_depth))
         if use_jax:
             from .jitcache import enable as _enable_jit_cache
 
@@ -833,7 +1057,8 @@ class TpuEvaluator:
             self.use_jax
             and self.mesh is None
             and self.pipeline_chunk > 0
-            and len(inputs) >= 2 * self.pipeline_chunk
+            and self.streaming_threshold > 0
+            and len(inputs) >= self.streaming_threshold
         ):
             return self._check_pipelined(inputs, params)
         batch = self.packer.pack(inputs, params)
@@ -889,8 +1114,19 @@ class TpuEvaluator:
         """Pipeline-chunk boundaries shared by check() and submit(): fixed
         pipeline_chunk-sized slices, with a tail smaller than the device
         threshold riding with its neighbor rather than paying a dispatch
-        (or an oracle walk) of its own."""
+        (or an oracle walk) of its own.
+
+        Batches below 2x pipeline_chunk would land in a single chunk and get
+        no overlap at all, so the chunk shrinks to split them into roughly
+        ``inflight_depth`` pieces — rounded to the next pow2 bucket so the
+        shrunk chunks reuse already-traced jit shapes (B_pad buckets are
+        pow2 too)."""
         chunk = self.pipeline_chunk if self.pipeline_chunk > 0 else len(inputs)
+        n = len(inputs)
+        if n < 2 * chunk:
+            depth = max(2, self.inflight_depth)
+            target = (n + depth - 1) // depth
+            chunk = min(chunk, _next_bucket(target, max(self.min_device_batch, 16)))
         chunks = [inputs[b : b + chunk] for b in range(0, len(inputs), chunk)]
         if len(chunks) > 1 and len(chunks[-1]) < self.min_device_batch:
             chunks[-2] = chunks[-2] + chunks[-1]
@@ -915,7 +1151,7 @@ class TpuEvaluator:
             batch = self.packer.pack(ch, params)
             h = _device_dispatch(self.lowered, batch, self._jit_cache)
             inflight.append((batch, h))
-            if len(inflight) >= 2:
+            if len(inflight) >= self.inflight_depth:
                 b, hh = inflight.pop(0)
                 outputs.extend(
                     self._assemble_batch(b, *_device_finalize(hh), params)

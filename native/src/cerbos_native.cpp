@@ -1576,6 +1576,56 @@ PyObject* PyBitmapAny(PyObject*, PyObject* args) {
   Py_RETURN_FALSE;
 }
 
+// stack_pad_rows(dst, rows) — fill the 2-D+ transfer matrix `dst`
+// (C-contiguous, len(rows) leading slots of row_bytes each) with the
+// C-contiguous arrays in `rows`: memcpy each row's bytes into its slot and
+// zero the padded tail. Replaces the per-row Python assignment loop in the
+// evaluator's pad+stack pass (one call per column family per batch).
+// Rows pad along their LEADING axis, so prefix-copy + zero-tail is exact.
+PyObject* PyStackPadRows(PyObject*, PyObject* args) {
+  PyObject *dst_obj, *rows_obj;
+  if (!PyArg_ParseTuple(args, "OO", &dst_obj, &rows_obj)) return nullptr;
+
+  Py_buffer dst_b;
+  if (PyObject_GetBuffer(dst_obj, &dst_b, PyBUF_WRITABLE) < 0) return nullptr;
+
+  PyObject* fast = PySequence_Fast(rows_obj, "rows must be a sequence");
+  if (!fast) {
+    PyBuffer_Release(&dst_b);
+    return nullptr;
+  }
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  bool ok = true;
+  if (n == 0 || dst_b.len % n != 0) {
+    PyErr_SetString(PyExc_ValueError, "dst length not divisible by row count");
+    ok = false;
+  }
+  const Py_ssize_t row_bytes = ok ? dst_b.len / n : 0;
+  char* out = static_cast<char*>(dst_b.buf);
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    Py_buffer rb;
+    if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(fast, i), &rb,
+                           PyBUF_SIMPLE) < 0) {
+      ok = false;
+      break;
+    }
+    if (rb.len > row_bytes) {
+      PyErr_SetString(PyExc_ValueError, "row larger than dst slot");
+      PyBuffer_Release(&rb);
+      ok = false;
+      break;
+    }
+    char* slot = out + i * row_bytes;
+    memcpy(slot, rb.buf, rb.len);
+    if (rb.len < row_bytes) memset(slot + rb.len, 0, row_bytes - rb.len);
+    PyBuffer_Release(&rb);
+  }
+  Py_DECREF(fast);
+  PyBuffer_Release(&dst_b);
+  if (!ok) return nullptr;
+  Py_RETURN_NONE;
+}
+
 PyMethodDef kMethods[] = {
     {"glob_match", PyGlobMatch, METH_VARARGS,
      "glob_match(pattern, value) -> bool — gobwas-style glob with ':' separator"},
@@ -1606,6 +1656,9 @@ PyMethodDef kMethods[] = {
     {"bitmap_any", PyBitmapAny, METH_VARARGS,
      "bitmap_any(words_seq, sums_seq) -> bool — packed-bitmap AND with "
      "first-hit early exit"},
+    {"stack_pad_rows", PyStackPadRows, METH_VARARGS,
+     "stack_pad_rows(dst, rows) — memcpy each contiguous row into its "
+     "padded slot of dst and zero the tail (fused pad+stack fill)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -1,0 +1,129 @@
+"""Golden rendering checks for deploy/charts/cerbos-tpu.
+
+``helm template`` is driven over three values variants (defaults, TLS,
+policies-from-ConfigMap + engine overrides) and the rendered manifests are
+asserted structurally. Skips cleanly when helm is not installed; the static
+chart checks at the bottom run regardless.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+import yaml
+
+CHART_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "deploy", "charts", "cerbos-tpu"
+)
+
+HELM = shutil.which("helm")
+
+
+def render(*set_args):
+    cmd = [HELM, "template", "pdp", CHART_DIR]
+    for s in set_args:
+        cmd += ["--set", s]
+    out = subprocess.run(cmd, capture_output=True, text=True, check=True).stdout
+    docs = [d for d in yaml.safe_load_all(out) if d]
+    return {(d["kind"], d["metadata"]["name"]): d for d in docs}
+
+
+def container(deployment):
+    return deployment["spec"]["template"]["spec"]["containers"][0]
+
+
+@pytest.mark.skipif(HELM is None, reason="helm not installed")
+class TestHelmTemplate:
+    def test_default_values(self):
+        docs = render()
+        assert set(docs) == {
+            ("Deployment", "pdp-cerbos-tpu"),
+            ("Service", "pdp-cerbos-tpu"),
+            ("ConfigMap", "pdp-cerbos-tpu-config"),
+        }
+        dep = docs[("Deployment", "pdp-cerbos-tpu")]
+        c = container(dep)
+        assert c["image"] == "cerbos-tpu:latest"
+        assert c["args"] == ["server", "--config", "/config/config.yaml"]
+        # config rollouts restart pods: the checksum annotation must exist
+        ann = dep["spec"]["template"]["metadata"]["annotations"]
+        assert len(ann["checksum/config"]) == 64
+        # probes stay plain HTTP without TLS
+        assert "scheme" not in c["livenessProbe"]["httpGet"]
+        # the rendered config carries the streaming knobs end to end
+        conf = yaml.safe_load(
+            docs[("ConfigMap", "pdp-cerbos-tpu-config")]["data"]["config.yaml"]
+        )
+        tpu = conf["engine"]["tpu"]
+        assert tpu["enabled"] is True
+        assert tpu["streamingThreshold"] == 1024
+        assert tpu["inflightDepth"] == 3
+        assert tpu["pipelineChunk"] == 4096
+        assert "tls" not in conf.get("server", {})
+        svc = docs[("Service", "pdp-cerbos-tpu")]
+        assert {(p["name"], p["port"]) for p in svc["spec"]["ports"]} == {
+            ("http", 3592),
+            ("grpc", 3593),
+        }
+
+    def test_tls_variant(self):
+        docs = render("tls.secretName=pdp-tls")
+        dep = docs[("Deployment", "pdp-cerbos-tpu")]
+        c = container(dep)
+        assert c["livenessProbe"]["httpGet"]["scheme"] == "HTTPS"
+        assert c["readinessProbe"]["httpGet"]["scheme"] == "HTTPS"
+        vols = {v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]}
+        assert vols["tls"]["secret"]["secretName"] == "pdp-tls"
+        assert {"name": "tls", "mountPath": "/tls"} in c["volumeMounts"]
+        conf = yaml.safe_load(
+            docs[("ConfigMap", "pdp-cerbos-tpu-config")]["data"]["config.yaml"]
+        )
+        assert conf["server"]["tls"] == {"cert": "/tls/tls.crt", "key": "/tls/tls.key"}
+
+    def test_policies_configmap_and_engine_overrides(self):
+        docs = render(
+            "policies.configMapName=my-policies",
+            "cerbos.config.engine.tpu.inflightDepth=2",
+            "cerbos.config.engine.tpu.streamingThreshold=512",
+        )
+        dep = docs[("Deployment", "pdp-cerbos-tpu")]
+        vols = {v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]}
+        assert vols["policies"]["configMap"]["name"] == "my-policies"
+        assert {"name": "policies", "mountPath": "/policies"} in container(dep)[
+            "volumeMounts"
+        ]
+        conf = yaml.safe_load(
+            docs[("ConfigMap", "pdp-cerbos-tpu-config")]["data"]["config.yaml"]
+        )
+        assert conf["engine"]["tpu"]["inflightDepth"] == 2
+        assert conf["engine"]["tpu"]["streamingThreshold"] == 512
+
+
+class TestChartStatic:
+    """Checks that hold without helm installed."""
+
+    def test_chart_metadata(self):
+        with open(os.path.join(CHART_DIR, "Chart.yaml"), encoding="utf-8") as f:
+            chart = yaml.safe_load(f)
+        assert chart["name"] == "cerbos-tpu"
+        assert chart["apiVersion"] == "v2"
+
+    def test_default_values_parse_and_match_engine_defaults(self):
+        with open(os.path.join(CHART_DIR, "values.yaml"), encoding="utf-8") as f:
+            values = yaml.safe_load(f)
+        tpu = values["cerbos"]["config"]["engine"]["tpu"]
+        from cerbos_tpu.config import DEFAULTS
+
+        want = DEFAULTS["engine"]["tpu"]
+        for knob in ("streamingThreshold", "inflightDepth", "pipelineChunk"):
+            assert tpu[knob] == want[knob], knob
+
+    def test_all_templates_present(self):
+        tdir = os.path.join(CHART_DIR, "templates")
+        assert {
+            "deployment.yaml",
+            "service.yaml",
+            "configmap.yaml",
+            "_helpers.tpl",
+        } <= set(os.listdir(tdir))

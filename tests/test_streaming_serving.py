@@ -1,0 +1,315 @@
+"""Streaming serving path: the batcher drives submit/collect with several
+device batches in flight, the pipelined check engages at realistic batch
+sizes, and the fused pad+stack transfer staging is bit-exact vs the two-step
+reference implementation.
+"""
+
+import concurrent.futures
+import re
+import time
+
+import numpy as np
+import pytest
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
+from cerbos_tpu.engine.batcher import BatchingEvaluator
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table, check_input
+from cerbos_tpu.tpu import TpuEvaluator
+from cerbos_tpu.tpu import evaluator as evmod
+
+POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: album
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: request.resource.attr.owner == request.principal.id || request.resource.attr.public == true
+    - actions: ["*"]
+      effect: EFFECT_ALLOW
+      roles: [admin]
+"""
+
+
+def table():
+    return build_rule_table(compile_policy_set(list(parse_policies(POLICY))))
+
+
+def inp(i: int) -> CheckInput:
+    return CheckInput(
+        principal=Principal(id=f"u{i}", roles=["user"]),
+        resource=Resource(
+            kind="album",
+            id=f"a{i}",
+            attr={"owner": f"u{i % 7}", "public": i % 3 == 0},
+        ),
+        actions=["view"],
+    )
+
+
+def effects(outs):
+    return [{a: (e.effect, e.policy) for a, e in o.actions.items()} for o in outs]
+
+
+class TestStreamingBatcher:
+    def test_concurrent_requests_keep_batches_in_flight(self):
+        """The acceptance check: concurrent CheckResources through the
+        batcher reach the device via submit/collect with >= 2 batches in
+        flight, and every output is bit-exact vs the CPU oracle."""
+        rt = table()
+        ev = TpuEvaluator(rt, use_jax=True, min_device_batch=4)
+        # max_batch=16 forces 64 requests to drain as 4+ tickets;
+        # min_batch_to_wait=64 with a generous window lets the whole burst
+        # queue before the first drain, so the submit loop demonstrably
+        # stacks tickets instead of racing the clients
+        batcher = BatchingEvaluator(
+            ev,
+            max_batch=16,
+            max_wait_ms=500.0,
+            min_batch_to_wait=64,
+            max_inflight=3,
+        )
+        inputs = [inp(i) for i in range(64)]
+        try:
+            with concurrent.futures.ThreadPoolExecutor(max_workers=64) as pool:
+                results = list(pool.map(lambda i: batcher.check([i])[0], inputs))
+        finally:
+            batcher.close()
+
+        want = [check_input(rt, i, EvalParams()) for i in inputs]
+        assert effects(results) == effects(want)
+        assert batcher.stats["batches"] >= 4
+        assert batcher.stats["batched_requests"] == 64
+        assert batcher.stats["inflight_peak"] >= 2, batcher.stats
+        assert ev.stats["device_inputs"] > 0  # the device path actually ran
+
+    def test_sync_evaluator_fallback(self):
+        """Evaluators without a streaming API still work through the batcher
+        (ready tickets, no in-flight window)."""
+        rt = table()
+
+        class PlainEvaluator:
+            rule_table = rt
+            schema_mgr = None
+
+            def check(self, inputs, params=None):
+                return [check_input(rt, i, params or EvalParams()) for i in inputs]
+
+        batcher = BatchingEvaluator(PlainEvaluator(), max_wait_ms=1.0)
+        inputs = [inp(i) for i in range(8)]
+        try:
+            with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+                results = list(pool.map(lambda i: batcher.check([i])[0], inputs))
+        finally:
+            batcher.close()
+        assert effects(results) == effects([check_input(rt, i, EvalParams()) for i in inputs])
+
+    def test_timeout_serves_from_oracle(self):
+        """A wedged device falls back to the CPU oracle per request, and the
+        fallback is counted (it used to be invisible)."""
+        rt = table()
+
+        class WedgedEvaluator:
+            rule_table = rt
+            schema_mgr = None
+
+            def check(self, inputs, params=None):
+                time.sleep(0.5)
+                return [check_input(rt, i, params or EvalParams()) for i in inputs]
+
+        from cerbos_tpu.observability import metrics
+
+        before = metrics().counter("cerbos_tpu_batcher_oracle_fallbacks_total").value
+        batcher = BatchingEvaluator(WedgedEvaluator(), max_wait_ms=1.0, request_timeout_s=0.05)
+        try:
+            out = batcher.check([inp(0)])
+        finally:
+            batcher.close()
+        assert effects(out) == effects([check_input(rt, inp(0), EvalParams())])
+        assert batcher.stats["oracle_fallbacks"] == 1
+        assert metrics().counter("cerbos_tpu_batcher_oracle_fallbacks_total").value == before + 1
+
+
+class TestStreamingThreshold:
+    @pytest.mark.parametrize("n", [63, 64, 65, 130])
+    def test_parity_around_threshold(self, n):
+        """check() stays bit-exact at, below and above the streaming
+        threshold, and the pipelined path engages exactly at the knob."""
+        rt = table()
+        ev = TpuEvaluator(
+            rt,
+            use_jax=True,
+            min_device_batch=4,
+            pipeline_chunk=32,
+            streaming_threshold=64,
+            inflight_depth=2,
+        )
+        calls = []
+        orig = ev._check_pipelined
+        ev._check_pipelined = lambda i, p: (calls.append(len(i)), orig(i, p))[1]
+        inputs = [inp(i) for i in range(n)]
+        params = EvalParams()
+        got = ev.check(inputs, params)
+        want = [check_input(rt, i, params) for i in inputs]
+        assert effects(got) == effects(want)
+        assert bool(calls) == (n >= 64)
+
+    def test_default_threshold_realistic(self):
+        """ISSUE acceptance: default engagement at <= 1024 inputs."""
+        assert TpuEvaluator(table(), use_jax=False).streaming_threshold <= 1024
+
+    def test_chunking_shrinks_below_two_chunks(self):
+        """Batches below 2x pipeline_chunk split into pipeline-able pieces
+        instead of a single monolithic chunk."""
+        rt = table()
+        ev = TpuEvaluator(
+            rt, use_jax=False, min_device_batch=4, pipeline_chunk=4096,
+            streaming_threshold=1024, inflight_depth=3,
+        )
+        chunks = ev._chunk_inputs([inp(i) for i in range(1024)])
+        assert len(chunks) >= 2
+        assert sum(len(c) for c in chunks) == 1024
+        # pow2 chunk sizes so the shrunk chunks reuse jit shape buckets
+        assert all(len(c) & (len(c) - 1) == 0 for c in chunks[:-1])
+
+
+class TestFusedPadStack:
+    def _packed(self, n=10):
+        rt = table()
+        ev = TpuEvaluator(rt, use_jax=False, min_device_batch=0)
+        return ev.packer.pack([inp(i) for i in range(n)], EvalParams())
+
+    def test_matches_two_step_reference(self):
+        """_pad_stack (fused, pooled, native fill) produces byte-identical
+        transfer matrices to _pad_arrays + _stack_padded."""
+        batch = self._packed()
+        B = batch.scope_sp.shape[0]
+        BA = batch.cand_cond.shape[0]
+        B_pad = evmod._next_bucket(B)
+        BA_pad = evmod._next_bucket(BA)
+        padded = evmod._pad_arrays(
+            batch, batch.columns, batch.cand_cond, batch.cand_drcond, B_pad, BA_pad
+        )
+        want, lay_want = evmod._stack_padded(padded)
+        got, lay_got, leased = evmod._pad_stack(
+            batch, batch.columns, batch.cand_cond, batch.cand_drcond, B_pad, BA_pad
+        )
+        try:
+            assert lay_got.sig == lay_want.sig
+            assert set(got) == set(want)
+            for k in want:
+                assert np.array_equal(np.asarray(got[k]), np.asarray(want[k])), k
+        finally:
+            evmod._buffer_pool.release(leased)
+
+    def test_dirty_pool_buffers_are_fully_overwritten(self):
+        """Recycled buffers carry garbage; a second fused pass over the same
+        shapes must still match the freshly-allocated reference."""
+        batch = self._packed()
+        B_pad = evmod._next_bucket(batch.scope_sp.shape[0])
+        BA_pad = evmod._next_bucket(batch.cand_cond.shape[0])
+        args = (batch, batch.columns, batch.cand_cond, batch.cand_drcond, B_pad, BA_pad)
+        _, _, leased = evmod._pad_stack(*args)
+        for a in leased:
+            a.fill(-1 if a.dtype != np.bool_ else True)  # poison
+        evmod._buffer_pool.release(leased)
+        want, _ = evmod._stack_padded(evmod._pad_arrays(*args))
+        got, _, leased2 = evmod._pad_stack(*args)
+        try:
+            for k in want:
+                assert np.array_equal(np.asarray(got[k]), np.asarray(want[k])), k
+        finally:
+            evmod._buffer_pool.release(leased2)
+
+    def test_buffer_pool_recycles(self):
+        pool = evmod._BufferPool()
+        a = pool.lease((4, 8), np.int32)
+        pool.release([a])
+        b = pool.lease((4, 8), np.int32)
+        assert b is a
+        c = pool.lease((4, 8), np.int32)
+        assert c is not a
+        pool.release([b, c])
+
+    def test_layout_marshalling_memoized(self):
+        batch = self._packed()
+        cols = batch.columns
+        lay1 = evmod._marshal_layout(cols, batch.scope_sp.shape[2], cols.now_hi is not None)
+        lay2 = evmod._marshal_layout(cols, batch.scope_sp.shape[2], cols.now_hi is not None)
+        assert lay1 is lay2
+
+    def test_native_stack_pad_rows(self):
+        from cerbos_tpu import native as native_mod
+
+        native = native_mod.get()
+        if native is None or not hasattr(native, "stack_pad_rows"):
+            pytest.skip("native extension unavailable")
+        dst = np.full((3, 8), 7, dtype=np.int32)
+        rows = [
+            np.arange(5, dtype=np.int32),
+            np.arange(8, dtype=np.int32),
+            np.zeros(0, dtype=np.int32),
+        ]
+        native.stack_pad_rows(dst, rows)
+        assert dst[0].tolist() == [0, 1, 2, 3, 4, 0, 0, 0]
+        assert dst[1].tolist() == list(range(8))
+        assert dst[2].tolist() == [0] * 8
+        with pytest.raises(ValueError):
+            native.stack_pad_rows(np.zeros((1, 2), np.int32), [np.arange(5, dtype=np.int32)])
+
+
+class TestMetricsEndpoint:
+    def test_batcher_metrics_visible(self, tmp_path_factory):
+        """The satellite check: batcher counters reach /_cerbos/metrics."""
+        import json
+        import urllib.request
+
+        from cerbos_tpu.bootstrap import initialize
+        from cerbos_tpu.config import Config
+        from cerbos_tpu.server.server import Server, ServerConfig
+
+        policy_dir = tmp_path_factory.mktemp("metrics-policies")
+        (policy_dir / "album.yaml").write_text(POLICY)
+        config = Config.load(overrides=[f"storage.disk.directory={policy_dir}"])
+        core = initialize(config)
+        core.tpu_evaluator.use_jax = False  # keep the test jax-independent
+        srv = Server(
+            core.service,
+            ServerConfig(http_listen_addr="127.0.0.1:0", grpc_listen_addr="127.0.0.1:0"),
+        )
+        srv.start()
+        try:
+            body = {
+                "requestId": "m-1",
+                "principal": {"id": "alice", "roles": ["user"]},
+                "resources": [
+                    {"actions": ["view"], "resource": {"kind": "album", "id": "a1", "attr": {"owner": "alice"}}}
+                ],
+            }
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.http_port}/api/check/resources",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert json.loads(resp.read())["results"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_port}/_cerbos/metrics"
+            ) as resp:
+                text = resp.read().decode()
+        finally:
+            srv.stop()
+            core.close()
+
+        m = re.search(r"^cerbos_tpu_batcher_batches_total (\d+)", text, re.M)
+        assert m and int(m.group(1)) >= 1, text
+        assert "cerbos_tpu_batcher_batch_size_bucket" in text
+        assert "cerbos_tpu_batcher_queue_wait_seconds_bucket" in text
+        assert "cerbos_tpu_batcher_inflight" in text
