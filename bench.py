@@ -219,7 +219,7 @@ def served_main(smoke: bool) -> int:
     """
     from concurrent.futures import ThreadPoolExecutor
 
-    from cerbos_tpu.engine.batcher import BatchingEvaluator
+    from cerbos_tpu.engine.batcher import BatchingEvaluator, DeviceHealth
 
     evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
     jax_ok = _merge_probe(evidence, tpu_probe.probe_ladder(attempts=1), "served")
@@ -235,8 +235,9 @@ def served_main(smoke: bool) -> int:
     rt = build_rule_table(compile_policy_set(policies))
     params = EvalParams()
     ev = TpuEvaluator(rt, use_jax=jax_ok)
+    health = DeviceHealth()
     batcher = BatchingEvaluator(
-        ev, max_batch=1024, max_wait_ms=2.0, min_batch_to_wait=8, max_inflight=3
+        ev, max_batch=1024, max_wait_ms=2.0, min_batch_to_wait=8, max_inflight=3, health=health
     )
 
     req_size = 4  # inputs per client request (the classic template's shape)
@@ -273,8 +274,16 @@ def served_main(smoke: bool) -> int:
         "request_size": req_size,
         "vs_baseline": round(rate / REFERENCE_DECISIONS_PER_SEC, 2),
         "batcher": dict(batcher.stats),
+        "breaker_trips": health.stats["trips"],
+        "oracle_fallbacks": batcher.stats["oracle_fallbacks"],
+        "deadline_drops": batcher.stats["deadline_drops"],
         "probe": tpu_probe.summarize(evidence),
     }
+    print(
+        "robustness: breaker_trips=%d oracle_fallbacks=%d deadline_drops=%d"
+        % (health.stats["trips"], batcher.stats["oracle_fallbacks"], batcher.stats["deadline_drops"]),
+        flush=True,
+    )
     print(json.dumps(record))
     return 0
 

@@ -134,15 +134,39 @@ def initialize(
             tpu_evaluator = _make_evaluator(manager.rule_table, engine_conf, schema_mgr)
         manager.evaluator_refresh_hook(tpu_evaluator)
         dispatch_evaluator = tpu_evaluator
-        if tpu_conf.get("requestBatching", True):
-            from .engine.batcher import BatchingEvaluator
+        # fault injection (chaos testing): CERBOS_TPU_FAULTS env wins over the
+        # engine.tpu.faults config key; empty means no wrapper at all
+        import os as _os
 
+        fault_spec = _os.environ.get("CERBOS_TPU_FAULTS", "") or str(
+            tpu_conf.get("faults", "") or ""
+        )
+        if fault_spec:
+            from .engine.faults import FaultInjector
+
+            dispatch_evaluator = FaultInjector(tpu_evaluator, fault_spec)
+        if tpu_conf.get("requestBatching", True):
+            from .engine.batcher import BatchingEvaluator, DeviceHealth
+
+            breaker_conf = tpu_conf.get("breaker", {}) or {}
+            health = DeviceHealth(
+                failure_threshold=int(breaker_conf.get("failureThreshold", 5)),
+                timeout_rate_threshold=float(breaker_conf.get("timeoutRateThreshold", 0.5)),
+                timeout_window_s=float(breaker_conf.get("timeoutWindowSeconds", 30)),
+                timeout_min_samples=int(breaker_conf.get("timeoutMinSamples", 10)),
+                probe_backoff_base_s=float(breaker_conf.get("probeBackoffBaseMs", 500)) / 1000.0,
+                probe_backoff_cap_s=float(breaker_conf.get("probeBackoffCapMs", 30000)) / 1000.0,
+                probe_timeout_s=float(breaker_conf.get("probeTimeoutMs", 5000)) / 1000.0,
+                enabled=bool(breaker_conf.get("enabled", True)),
+            )
             batcher = BatchingEvaluator(
-                tpu_evaluator,
+                dispatch_evaluator,
                 max_batch=int(tpu_conf.get("maxBatch", 4096)),
                 max_wait_ms=float(tpu_conf.get("batchWindowMs", 2.0)),
                 request_timeout_s=float(tpu_conf.get("requestTimeoutMs", 30000)) / 1000.0,
                 max_inflight=int(tpu_conf.get("inflightDepth", 3)),
+                health=health,
+                quarantine_max=int(tpu_conf.get("quarantineMax", 128)),
             )
             dispatch_evaluator = batcher
 

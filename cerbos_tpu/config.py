@@ -39,6 +39,22 @@ DEFAULTS: dict[str, Any] = {
             "pipelineChunk": 4096,
             "streamingThreshold": 1024,
             "inflightDepth": 3,
+            # device-path fault domain (docs/ROBUSTNESS.md): circuit breaker
+            # routing check() to the CPU oracle while the device is unhealthy,
+            # poison-input quarantine bound, and the fault-injection spec
+            # (same grammar as the CERBOS_TPU_FAULTS env var, which wins)
+            "breaker": {
+                "enabled": True,
+                "failureThreshold": 5,
+                "timeoutRateThreshold": 0.5,
+                "timeoutWindowSeconds": 30,
+                "timeoutMinSamples": 10,
+                "probeBackoffBaseMs": 500,
+                "probeBackoffCapMs": 30000,
+                "probeTimeoutMs": 5000,
+            },
+            "quarantineMax": 128,
+            "faults": "",
         },
     },
     "storage": {"driver": "disk", "disk": {"directory": "policies", "watchForChanges": False}},

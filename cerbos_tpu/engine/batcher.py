@@ -14,10 +14,24 @@ submitting newer requests; ``collect()`` settles each ticket's futures as
 its results land. Wall-clock under concurrent load approaches
 max(host pack/assembly, device work) instead of their sum — the same
 double-buffering bench.py measures, now on the serving path.
+
+The device path is a supervised fault domain (docs/ROBUSTNESS.md):
+
+- a ``DeviceHealth`` breaker routes ``check()`` straight to the CPU oracle
+  while open (no request ever waits out the future timeout against a dead
+  device) and re-closes via background probe batches;
+- a failed device batch is never surfaced to its co-batched requests:
+  each waiter re-serves its own inputs from the oracle, and the group is
+  bisected off-path to find and quarantine the poison input;
+- per-request deadlines ride in ``_Pending`` and expire at drain time with
+  ``DeadlineExceeded`` instead of spending device work on dead requests;
+- a dead drain loop fails fast: waiters are settled immediately and new
+  requests take the oracle, instead of hanging until timeout forever.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -26,7 +40,29 @@ from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
+from ..ruletable import check_input
 from . import types as T
+from .health import DeviceHealth  # noqa: F401  (re-exported for wiring/tests)
+
+_log = logging.getLogger("cerbos_tpu.engine.batcher")
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline expired before a decision was produced.
+
+    Maps to gRPC DEADLINE_EXCEEDED / HTTP 504 at the server layer."""
+
+
+class _BatchFailed(Exception):
+    """Internal: the device batch carrying this request failed. The waiting
+    ``check()`` thread catches this and re-serves its own inputs from the
+    CPU oracle — co-batched requests each recover independently instead of
+    all erroring together."""
+
+    def __init__(self, cause: Optional[BaseException], reason: str = "batch_error"):
+        super().__init__(reason)
+        self.cause = cause
+        self.reason = reason
 
 
 @dataclass
@@ -35,6 +71,7 @@ class _Pending:
     params: Optional[T.EvalParams]
     future: Future
     enqueued_at: float = field(default_factory=time.perf_counter)
+    deadline: Optional[float] = None  # absolute time.monotonic() deadline
 
 
 @dataclass
@@ -57,9 +94,33 @@ def _settle(fut: Future, result: Any = None, error: Optional[BaseException] = No
         pass
 
 
+def _fingerprint(inp: T.CheckInput) -> int:
+    """Stable identity of a check input for the quarantine set (attrs may
+    hold unhashable values, so they hash via a sorted repr)."""
+    pr, rs = inp.principal, inp.resource
+    return hash(
+        (
+            pr.id,
+            tuple(pr.roles or ()),
+            pr.policy_version,
+            pr.scope,
+            repr(sorted((pr.attr or {}).items())),
+            rs.kind,
+            rs.id,
+            rs.policy_version,
+            rs.scope,
+            repr(sorted((rs.attr or {}).items())),
+            tuple(inp.actions or ()),
+        )
+    )
+
+
 class BatchingEvaluator:
     """Wraps a batch evaluator (TpuEvaluator) with cross-request batching
     and an in-flight streaming window over its submit/collect pipeline."""
+
+    # Engine forwards per-request deadlines only to evaluators that opt in.
+    supports_deadline = True
 
     def __init__(
         self,
@@ -69,6 +130,9 @@ class BatchingEvaluator:
         min_batch_to_wait: int = 2,
         request_timeout_s: float = 30.0,
         max_inflight: int = 3,
+        health: Optional[DeviceHealth] = None,
+        quarantine_max: int = 128,
+        bisect_budget: int = 64,
     ):
         self.evaluator = evaluator
         self.max_batch = max_batch
@@ -76,15 +140,26 @@ class BatchingEvaluator:
         self.max_wait = max_wait_ms / 1000.0
         self.min_batch_to_wait = min_batch_to_wait
         self.max_inflight = max(1, int(max_inflight))
-        self._queue: list[_Pending] = []
+        self.health = health
+        self.quarantine_max = max(1, int(quarantine_max))
+        self.bisect_budget = max(3, int(bisect_budget))
+        self._queue: deque[_Pending] = deque()
         self._lock = threading.Lock()
         self._wakeup = threading.Condition(self._lock)
         self._stop = False
+        self._dead: Optional[BaseException] = None
+        self._draining: list[_Pending] = []
+        self._qlock = threading.Lock()
+        self._quarantine: dict[int, bool] = {}  # insertion-ordered, bounded
+        self._bisect_busy = False
         self.stats = {
             "batches": 0,
             "batched_requests": 0,
             "inflight_peak": 0,
             "oracle_fallbacks": 0,
+            "batch_errors": 0,
+            "deadline_drops": 0,
+            "quarantined": 0,
         }
         self._init_metrics()
         self._thread = threading.Thread(target=self._loop, daemon=True, name="check-batcher")
@@ -109,9 +184,10 @@ class BatchingEvaluator:
             "device batches currently in flight",
             track_max=True,
         )
-        self.m_oracle_fallbacks = reg.counter(
+        self.m_oracle_fallbacks = reg.counter_vec(
             "cerbos_tpu_batcher_oracle_fallbacks_total",
-            "requests served from the CPU oracle after a device timeout",
+            "requests served from the CPU oracle instead of the device path, by reason",
+            label="reason",
         )
         self.m_batches = reg.counter(
             "cerbos_tpu_batcher_batches_total", "device batches submitted"
@@ -119,15 +195,72 @@ class BatchingEvaluator:
         self.m_requests = reg.counter(
             "cerbos_tpu_batcher_requests_total", "requests coalesced into device batches"
         )
+        self.m_deadline_drops = reg.counter(
+            "cerbos_tpu_batcher_deadline_drops_total",
+            "requests dropped with DEADLINE_EXCEEDED before device work",
+        )
+        self.m_quarantined = reg.counter(
+            "cerbos_tpu_batcher_quarantined_total",
+            "poison inputs quarantined after batch bisection",
+        )
 
-    def check(self, inputs: Sequence[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
+    # -- oracle fallback ----------------------------------------------------
+
+    def _serve_oracle(
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams],
+        reason: str,
+    ) -> list[T.CheckOutput]:
+        self.stats["oracle_fallbacks"] += 1
+        self.m_oracle_fallbacks.inc(reason)
+        ev = self.evaluator
+        return [
+            check_input(ev.rule_table, i, params or T.EvalParams(), ev.schema_mgr)
+            for i in inputs
+        ]
+
+    # -- request path -------------------------------------------------------
+
+    def check(
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
+    ) -> list[T.CheckOutput]:
+        if deadline is not None and time.monotonic() >= deadline:
+            self._count_deadline_drop()
+            raise DeadlineExceeded("request deadline expired before evaluation")
+        if self._quarantine and self._has_quarantined(inputs):
+            return self._serve_oracle(inputs, params, "quarantine")
+        health = self.health
+        if health is not None and not health.allow_device():
+            # breaker open: serve from the oracle with NO device wait; a due
+            # probe rides this request's inputs off-path to test re-close
+            token = health.should_probe()
+            if token is not None:
+                self._spawn_probe(token, list(inputs)[:16], params)
+            return self._serve_oracle(inputs, params, "breaker_open")
+        if self._stop or self._dead is not None or not self._thread.is_alive():
+            # drain loop gone (shutdown or crash): fail fast to the oracle
+            return self._serve_oracle(inputs, params, "batcher_dead")
         fut: Future = Future()
-        pending = _Pending(list(inputs), params, fut)
+        pending = _Pending(list(inputs), params, fut, deadline=deadline)
         with self._wakeup:
             self._queue.append(pending)
             self._wakeup.notify()
+        wait = self.request_timeout
+        if deadline is not None:
+            wait = min(wait, max(0.0, deadline - time.monotonic()))
         try:
-            return fut.result(timeout=self.request_timeout)
+            return fut.result(timeout=wait)
+        except DeadlineExceeded:
+            raise
+        except _BatchFailed as e:
+            # the device batch failed (or the batcher is shutting down /
+            # dead, or the breaker opened while queued): recover this
+            # request's own inputs from the oracle
+            return self._serve_oracle(pending.inputs, params, e.reason)
         except (TimeoutError, FutureTimeoutError):  # distinct classes before 3.11
             # a wedged device must not block server threads forever: drop the
             # request from the queue (if still there) and serve it from the
@@ -138,22 +271,47 @@ class BatchingEvaluator:
                     self._queue.remove(pending)
                 except ValueError:
                     pass
-            self.stats["oracle_fallbacks"] += 1
-            self.m_oracle_fallbacks.inc()
-            from ..ruletable import check_input
+            if deadline is not None and time.monotonic() >= deadline:
+                self._count_deadline_drop()
+                raise DeadlineExceeded("request deadline expired while queued") from None
+            if health is not None:
+                health.record_timeout()
+            return self._serve_oracle(pending.inputs, params, "timeout")
 
-            ev = self.evaluator
-            return [
-                check_input(ev.rule_table, i, params or T.EvalParams(), ev.schema_mgr)
-                for i in pending.inputs
-            ]
+    def _count_deadline_drop(self) -> None:
+        self.stats["deadline_drops"] += 1
+        self.m_deadline_drops.inc()
 
     def _queue_nonempty(self) -> bool:
         with self._lock:
             return bool(self._queue)
 
+    # -- drain loop ---------------------------------------------------------
+
     def _loop(self) -> None:
         inflight: deque[_Inflight] = deque()
+        try:
+            self._loop_inner(inflight)
+        except BaseException as e:  # noqa: BLE001  (watchdog: fail fast, not hang)
+            self._dead = e
+            _log.exception("check-batcher drain loop died; requests fail over to the CPU oracle")
+            draining, self._draining = self._draining, []
+            for p in draining:
+                _settle(p.future, error=_BatchFailed(e, "batcher_dead"))
+        # drain on shutdown: settle everything still in flight, then any
+        # requests still queued (waiters must not sleep out their timeout
+        # against a thread that no longer exists)
+        while inflight:
+            flight = inflight.popleft()
+            try:
+                self._collect(flight)
+            except BaseException as e:  # noqa: BLE001
+                for p in flight.group:
+                    _settle(p.future, error=_BatchFailed(e, "batcher_dead"))
+            self.m_inflight.set(len(inflight))
+        self._settle_residual_queue()
+
+    def _loop_inner(self, inflight: deque) -> None:
         while True:
             with self._wakeup:
                 if self._stop:
@@ -174,14 +332,33 @@ class BatchingEvaluator:
                         self._wakeup.wait(remaining)
                 pending: list[_Pending] = []
                 total = 0
+                now = time.monotonic()
                 while self._queue and total < self.max_batch:
                     p = self._queue[0]
                     if pending and total + len(p.inputs) > self.max_batch:
                         break
-                    pending.append(self._queue.pop(0))
+                    self._queue.popleft()
+                    if p.deadline is not None and now >= p.deadline:
+                        # expired while queued: don't spend device work on it
+                        self._count_deadline_drop()
+                        _settle(
+                            p.future,
+                            error=DeadlineExceeded("request deadline expired while queued"),
+                        )
+                        continue
+                    pending.append(p)
                     total += len(p.inputs)
             if pending:
-                self._submit(pending, inflight)
+                health = self.health
+                if health is not None and not health.allow_device():
+                    # breaker opened while these were queued: bounce them to
+                    # their waiters, which recover in parallel via the oracle
+                    for p in pending:
+                        _settle(p.future, error=_BatchFailed(None, "breaker_open"))
+                else:
+                    self._draining = pending
+                    self._submit(pending, inflight)
+                    self._draining = []
             # Collect when the window is full, or when there's nothing left
             # to submit (the pipeline drains while new requests may still
             # arrive; re-check the queue between collects so a fresh burst
@@ -191,10 +368,6 @@ class BatchingEvaluator:
                     break
                 self._collect(inflight.popleft())
                 self.m_inflight.set(len(inflight))
-        # drain on shutdown: settle everything still in flight
-        while inflight:
-            self._collect(inflight.popleft())
-            self.m_inflight.set(len(inflight))
 
     def _submit(self, pending: list[_Pending], inflight: deque) -> None:
         # group by params identity (globals etc. must match within a batch)
@@ -216,8 +389,7 @@ class BatchingEvaluator:
                     # synchronously and carry the result as a ready ticket
                     ticket = _ReadyTicket(self.evaluator.check(all_inputs, group[0].params))
             except Exception as e:  # noqa: BLE001
-                for p in group:
-                    _settle(p.future, error=e)
+                self._batch_failed(group, all_inputs, e)
                 continue
             self.stats["batches"] += 1
             self.stats["batched_requests"] += len(group)
@@ -238,19 +410,155 @@ class BatchingEvaluator:
             else:
                 outputs = self.evaluator.collect(flight.ticket)
         except Exception as e:  # noqa: BLE001
+            all_inputs: list[T.CheckInput] = []
             for p in group:
-                _settle(p.future, error=e)
+                all_inputs.extend(p.inputs)
+            self._batch_failed(group, all_inputs, e)
             return
+        if self.health is not None:
+            self.health.record_success()
         offset = 0
         for p in group:
             _settle(p.future, result=outputs[offset : offset + len(p.inputs)])
             offset += len(p.inputs)
+
+    def _batch_failed(
+        self, group: list[_Pending], all_inputs: list[T.CheckInput], e: Exception
+    ) -> None:
+        """A device batch raised: settle each co-batched waiter with
+        _BatchFailed so they each re-serve from the oracle (never a 5xx),
+        feed the breaker, and bisect the batch off-path for poison."""
+        self.stats["batch_errors"] += 1
+        if self.health is not None:
+            self.health.record_failure()
+        _log.warning(
+            "device batch failed; co-batched requests fall back to the CPU oracle",
+            extra={"fields": {"inputs": len(all_inputs), "error": repr(e)}},
+        )
+        for p in group:
+            _settle(p.future, error=_BatchFailed(e))
+        self._schedule_bisect(all_inputs, group[0].params)
+
+    # -- poison bisection + quarantine --------------------------------------
+
+    def _schedule_bisect(self, inputs: list[T.CheckInput], params) -> None:
+        # a lone failing input has no sibling to prove the device itself is
+        # healthy, so it can't be told apart from a device-wide failure
+        if len(inputs) < 2 or self._bisect_busy:
+            return
+        with self._qlock:
+            if self._bisect_busy:
+                return
+            self._bisect_busy = True
+        threading.Thread(
+            target=self._bisect,
+            args=(list(inputs), params),
+            daemon=True,
+            name="check-batcher-bisect",
+        ).start()
+
+    def _bisect(self, inputs: list[T.CheckInput], params) -> None:
+        """Off-path halving search over a failed batch. Quarantine single
+        inputs that still fail ONLY when some sibling sub-batch succeeded —
+        otherwise the device is simply down and nothing is poisoned."""
+        try:
+            stack: list[list[T.CheckInput]] = [inputs]
+            budget = self.bisect_budget
+            ok_any = False
+            poisons: list[T.CheckInput] = []
+            while stack and budget > 0:
+                part = stack.pop()
+                budget -= 1
+                try:
+                    self.evaluator.check(part, params)
+                    ok_any = True
+                    continue
+                except Exception:  # noqa: BLE001
+                    pass
+                if len(part) == 1:
+                    poisons.append(part[0])
+                else:
+                    mid = len(part) // 2
+                    stack.append(part[:mid])
+                    stack.append(part[mid:])
+            if ok_any:
+                for inp in poisons:
+                    self._quarantine_add(inp)
+        except Exception:  # noqa: BLE001  (bisect is best-effort, off-path)
+            pass
+        finally:
+            self._bisect_busy = False
+
+    def _quarantine_add(self, inp: T.CheckInput) -> None:
+        fp = _fingerprint(inp)
+        with self._qlock:
+            if fp in self._quarantine:
+                return
+            self._quarantine[fp] = True
+            while len(self._quarantine) > self.quarantine_max:
+                self._quarantine.pop(next(iter(self._quarantine)))
+        self.stats["quarantined"] += 1
+        self.m_quarantined.inc()
+        _log.error(
+            "quarantined poison input: it crashes device batches and will be "
+            "served by the CPU oracle",
+            extra={
+                "fields": {
+                    "principal": inp.principal.id,
+                    "resourceKind": inp.resource.kind,
+                    "resourceId": inp.resource.id,
+                    "actions": list(inp.actions or ()),
+                }
+            },
+        )
+
+    def _has_quarantined(self, inputs: Sequence[T.CheckInput]) -> bool:
+        with self._qlock:
+            return any(_fingerprint(i) in self._quarantine for i in inputs)
+
+    # -- breaker probes -----------------------------------------------------
+
+    def _spawn_probe(self, token: int, inputs: list[T.CheckInput], params) -> None:
+        threading.Thread(
+            target=self._probe,
+            args=(token, inputs, params),
+            daemon=True,
+            name="check-batcher-probe",
+        ).start()
+
+    def _probe(self, token: int, inputs: list[T.CheckInput], params) -> None:
+        health = self.health
+        if health is None:
+            return
+        try:
+            submit = getattr(self.evaluator, "submit", None)
+            if submit is not None:
+                self.evaluator.collect(submit(inputs, params))
+            else:
+                self.evaluator.check(inputs, params)
+        except Exception:  # noqa: BLE001
+            health.probe_failed(token)
+        else:
+            health.probe_succeeded(token)
+
+    # -- shutdown -----------------------------------------------------------
+
+    def _settle_residual_queue(self) -> None:
+        with self._wakeup:
+            residual = list(self._queue)
+            self._queue.clear()
+        for p in residual:
+            _settle(p.future, error=_BatchFailed(None, "shutdown"))
 
     def close(self) -> None:
         with self._wakeup:
             self._stop = True
             self._wakeup.notify_all()
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # drain loop is wedged in a device call: settle queued waiters
+            # from here so shutdown doesn't strand them for request_timeout
+            self._settle_residual_queue()
 
 
 class _ReadyTicket:

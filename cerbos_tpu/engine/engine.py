@@ -44,6 +44,7 @@ class Engine:
         self,
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
     ) -> list[T.CheckOutput]:
         from ..observability import start_span
 
@@ -51,7 +52,12 @@ class Engine:
         with start_span("engine.Check", batch_size=len(inputs)) as span:
             if self.tpu_evaluator is not None and len(inputs) >= self.tpu_batch_threshold:
                 span.set_attribute("path", "device")
-                outputs = self.tpu_evaluator.check(list(inputs), params)
+                if deadline is not None and getattr(self.tpu_evaluator, "supports_deadline", False):
+                    # per-request deadline (from the gRPC context) rides down
+                    # to the batcher, which drops expired work at drain time
+                    outputs = self.tpu_evaluator.check(list(inputs), params, deadline=deadline)
+                else:
+                    outputs = self.tpu_evaluator.check(list(inputs), params)
             else:
                 from ..ruletable import check_input
 

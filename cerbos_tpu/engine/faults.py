@@ -1,0 +1,149 @@
+"""Config/env-gated fault injection for the device path.
+
+``FaultInjector`` wraps a device evaluator (``TpuEvaluator`` or anything
+with the same ``check``/``submit``/``collect`` surface) and injects
+deterministic failures per a small comma-separated grammar, e.g.::
+
+    CERBOS_TPU_FAULTS=submit_raise:0.1,collect_delay_ms:200,wedge_after:50
+
+Knobs (all optional; unknown names are a hard error so typos don't
+silently disable a chaos run):
+
+- ``submit_raise:P`` / ``collect_raise:P`` / ``check_raise:P`` — raise
+  ``DeviceFault`` with probability P (0..1) on the respective call.
+- ``submit_delay_ms:N`` / ``collect_delay_ms:N`` — sleep N ms before the
+  real call.
+- ``wedge_after:N`` — after N successful device calls, every subsequent
+  ``submit``/``collect`` blocks for ``wedge_sleep_s`` (default 3600)
+  before raising, simulating a hung device.
+- ``wedge_sleep_s:S`` — how long a wedged call blocks.
+- ``poison_attr:KEY`` — any batch containing an input whose resource attr
+  has KEY raises ``DeviceFault`` (submit and check, so off-path bisection
+  reproduces the failure).
+- ``seed:N`` — PRNG seed for the probabilistic knobs (default 1337).
+
+The wrapper delegates every other attribute (``rule_table``,
+``schema_mgr``, ``stats``, ``refresh`` ...) to the wrapped evaluator, so
+the CPU-oracle fallback and policy reload are unaffected by injection.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class DeviceFault(RuntimeError):
+    """An injected device-path failure."""
+
+
+_FLOAT_KNOBS = {"submit_raise", "collect_raise", "check_raise", "wedge_sleep_s"}
+_INT_KNOBS = {"submit_delay_ms", "collect_delay_ms", "wedge_after", "seed"}
+_STR_KNOBS = {"poison_attr"}
+
+
+def parse_fault_spec(spec: str) -> Dict[str, Any]:
+    """Parse ``name:value,name:value`` into a knob dict; ValueError on
+    unknown names or malformed values."""
+    out: Dict[str, Any] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, raw = part.partition(":")
+        name = name.strip()
+        raw = raw.strip()
+        if not sep or not raw:
+            raise ValueError(f"malformed fault spec entry {part!r} (want name:value)")
+        if name in _FLOAT_KNOBS:
+            out[name] = float(raw)
+        elif name in _INT_KNOBS:
+            out[name] = int(raw)
+        elif name in _STR_KNOBS:
+            out[name] = raw
+        else:
+            raise ValueError(f"unknown fault knob {name!r} in spec {spec!r}")
+    return out
+
+
+class FaultInjector:
+    """Evaluator wrapper applying the parsed fault spec to the device
+    calls the batcher makes. The spec dict is mutable at runtime (the
+    chaos tests flip faults off to exercise breaker re-close)."""
+
+    def __init__(self, evaluator, spec):
+        self._ev = evaluator
+        self.spec = parse_fault_spec(spec) if isinstance(spec, str) else dict(spec or {})
+        self._rng = random.Random(self.spec.get("seed", 1337))
+        self._lock = threading.Lock()
+        self._calls = 0
+        self.stats = getattr(evaluator, "stats", None)
+        self.injected = {"raises": 0, "delays": 0, "wedges": 0, "poisoned": 0}
+
+    def __getattr__(self, name):
+        return getattr(self._ev, name)
+
+    # -- injection plumbing -------------------------------------------------
+
+    def _roll(self, p: Optional[float]) -> bool:
+        if not p:
+            return False
+        with self._lock:
+            return self._rng.random() < p
+
+    def _count_call(self) -> int:
+        with self._lock:
+            self._calls += 1
+            return self._calls
+
+    def _maybe_wedge(self, op: str) -> None:
+        wedge_after = self.spec.get("wedge_after")
+        if wedge_after is None:
+            return
+        if self._count_call() > wedge_after:
+            self.injected["wedges"] += 1
+            time.sleep(float(self.spec.get("wedge_sleep_s", 3600.0)))
+            raise DeviceFault(f"injected wedge on {op}")
+
+    def _maybe_delay(self, knob: str) -> None:
+        delay_ms = self.spec.get(knob)
+        if delay_ms:
+            self.injected["delays"] += 1
+            time.sleep(delay_ms / 1000.0)
+
+    def _maybe_raise(self, knob: str, op: str) -> None:
+        if self._roll(self.spec.get(knob)):
+            self.injected["raises"] += 1
+            raise DeviceFault(f"injected {op} failure")
+
+    def _check_poison(self, inputs) -> None:
+        key = self.spec.get("poison_attr")
+        if not key:
+            return
+        for i in inputs:
+            attr = getattr(getattr(i, "resource", None), "attr", None) or {}
+            if key in attr:
+                self.injected["poisoned"] += 1
+                raise DeviceFault(f"injected poison input (resource attr {key!r})")
+
+    # -- evaluator surface --------------------------------------------------
+
+    def check(self, inputs, params=None):
+        self._check_poison(inputs)
+        self._maybe_raise("check_raise", "check")
+        return self._ev.check(inputs, params)
+
+    def submit(self, inputs, params=None):
+        self._maybe_wedge("submit")
+        self._check_poison(inputs)
+        self._maybe_raise("submit_raise", "submit")
+        self._maybe_delay("submit_delay_ms")
+        return self._ev.submit(inputs, params)
+
+    def collect(self, ticket):
+        self._maybe_wedge("collect")
+        self._maybe_raise("collect_raise", "collect")
+        self._maybe_delay("collect_delay_ms")
+        return self._ev.collect(ticket)

@@ -1,0 +1,222 @@
+"""Device-path circuit breaker: closed → open → half-open.
+
+The serving batcher treats the device pipeline as a supervised fault
+domain. Consecutive ``submit()``/``collect()`` failures — or a high
+request-timeout rate over a sliding window — trip the breaker OPEN, at
+which point ``check()`` routes straight to the CPU oracle with no device
+wait at all (a wedged chip must cost zero latency, not a 30 s future
+timeout per request). While open, background probe batches paced by
+``util.retry.backoff_delay`` move the breaker HALF_OPEN; a probe success
+re-CLOSES it and live traffic returns to the device, a probe failure (or
+a probe that itself wedges past ``probe_timeout_s``) re-opens it with a
+longer backoff.
+
+Breaker state is exported as the ``cerbos_tpu_breaker_state`` gauge
+(0 = closed, 1 = open, 2 = half-open) and trips as
+``cerbos_tpu_breaker_trips_total`` on ``/_cerbos/metrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..util.retry import backoff_delay
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+
+_STATE_CODE = {STATE_CLOSED: 0, STATE_OPEN: 1, STATE_HALF_OPEN: 2}
+
+_log = logging.getLogger("cerbos_tpu.engine.health")
+
+
+class DeviceHealth:
+    """Thread-safe breaker state machine shared by the batcher, its drain
+    loop and the background probe threads.
+
+    A disabled breaker (``enabled=False``) never trips: ``allow_device()``
+    is always True and every record_* call is a no-op, so the batcher's
+    pre-breaker behavior is preserved exactly.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        timeout_rate_threshold: float = 0.5,
+        timeout_window_s: float = 30.0,
+        timeout_min_samples: int = 10,
+        probe_backoff_base_s: float = 0.5,
+        probe_backoff_cap_s: float = 30.0,
+        probe_timeout_s: float = 5.0,
+        enabled: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.enabled = enabled
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.timeout_rate_threshold = float(timeout_rate_threshold)
+        self.timeout_window_s = float(timeout_window_s)
+        self.timeout_min_samples = max(1, int(timeout_min_samples))
+        self.probe_backoff_base_s = float(probe_backoff_base_s)
+        self.probe_backoff_cap_s = float(probe_backoff_cap_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._consecutive_failures = 0
+        # sliding window of (ts, timed_out) request outcomes for the
+        # timeout-rate trip condition (a device can wedge without raising)
+        self._outcomes: deque[tuple[float, bool]] = deque()
+        # consecutive open periods without a successful re-close; paces the
+        # probe cadence through backoff_delay
+        self._trip_streak = 0
+        self._next_probe_at = 0.0
+        self._probe_token = 0
+        self._probe_started_at = 0.0
+        self.stats = {"trips": 0, "probes": 0}
+        self._init_metrics()
+
+    def _init_metrics(self) -> None:
+        from ..observability import metrics
+
+        reg = metrics()
+        self.m_state = reg.gauge(
+            "cerbos_tpu_breaker_state",
+            "device-path breaker state (0=closed, 1=open, 2=half-open)",
+        )
+        self.m_trips = reg.counter(
+            "cerbos_tpu_breaker_trips_total",
+            "times the device-path breaker tripped open",
+        )
+        self.m_state.set(_STATE_CODE[self._state])
+
+    # -- state queries ------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._tick_locked()
+            return self._state
+
+    def allow_device(self) -> bool:
+        """True when live traffic may ride the device path."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            self._tick_locked()
+            return self._state == STATE_CLOSED
+
+    def should_probe(self) -> Optional[int]:
+        """When the breaker is OPEN and the backoff has elapsed, transition
+        to HALF_OPEN and return a probe token; the caller runs one probe
+        batch off-path and reports back with probe_succeeded/probe_failed.
+        Returns None when no probe is due (or one is already in flight)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._tick_locked()
+            if self._state != STATE_OPEN or self._clock() < self._next_probe_at:
+                return None
+            self._state = STATE_HALF_OPEN
+            self._probe_token += 1
+            self._probe_started_at = self._clock()
+            self.stats["probes"] += 1
+            self.m_state.set(_STATE_CODE[self._state])
+            return self._probe_token
+
+    # -- outcome recording --------------------------------------------------
+
+    def record_success(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._consecutive_failures = 0
+            self._observe_locked(timed_out=False)
+
+    def record_failure(self) -> None:
+        """A device submit/collect raised."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._consecutive_failures += 1
+            if (
+                self._state == STATE_CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._trip_locked("consecutive_failures")
+
+    def record_timeout(self) -> None:
+        """A request waited out its future timeout (wedged, not raising)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._observe_locked(timed_out=True)
+            if self._state != STATE_CLOSED:
+                return
+            timeouts = sum(1 for _, t in self._outcomes if t)
+            n = len(self._outcomes)
+            if n >= self.timeout_min_samples and timeouts / n >= self.timeout_rate_threshold:
+                self._trip_locked("timeout_rate")
+
+    def probe_succeeded(self, token: int) -> None:
+        with self._lock:
+            if token != self._probe_token or self._state != STATE_HALF_OPEN:
+                return  # stale probe (expired or superseded): ignore
+            self._state = STATE_CLOSED
+            self._consecutive_failures = 0
+            self._trip_streak = 0
+            self._outcomes.clear()
+            self.m_state.set(_STATE_CODE[self._state])
+            _log.info("device-path breaker re-closed after successful probe")
+
+    def probe_failed(self, token: int) -> None:
+        with self._lock:
+            if token != self._probe_token or self._state != STATE_HALF_OPEN:
+                return
+            self._reopen_locked()
+
+    # -- internals ----------------------------------------------------------
+
+    def _observe_locked(self, timed_out: bool) -> None:
+        now = self._clock()
+        self._outcomes.append((now, timed_out))
+        horizon = now - self.timeout_window_s
+        while self._outcomes and self._outcomes[0][0] < horizon:
+            self._outcomes.popleft()
+
+    def _trip_locked(self, cause: str) -> None:
+        self._state = STATE_OPEN
+        self._trip_streak += 1
+        self._next_probe_at = self._clock() + backoff_delay(
+            self._trip_streak, self.probe_backoff_base_s, self.probe_backoff_cap_s
+        )
+        self.stats["trips"] += 1
+        self.m_trips.inc()
+        self.m_state.set(_STATE_CODE[self._state])
+        _log.error(
+            "device-path breaker tripped open; serving from the CPU oracle",
+            extra={"fields": {"cause": cause, "streak": self._trip_streak}},
+        )
+
+    def _reopen_locked(self) -> None:
+        self._state = STATE_OPEN
+        self._trip_streak += 1
+        self._next_probe_at = self._clock() + backoff_delay(
+            self._trip_streak, self.probe_backoff_base_s, self.probe_backoff_cap_s
+        )
+        self.m_state.set(_STATE_CODE[self._state])
+
+    def _tick_locked(self) -> None:
+        """Expire a probe that never reported back (the probe thread is
+        wedged in a blocking device call): bump the token so its eventual
+        result is ignored and re-open with a longer backoff."""
+        if (
+            self._state == STATE_HALF_OPEN
+            and self._clock() - self._probe_started_at > self.probe_timeout_s
+        ):
+            self._probe_token += 1
+            self._reopen_locked()

@@ -442,6 +442,50 @@ class Histogram:
         return {f"{self.name}_sum": self._sum, f"{self.name}_count": float(self._count)}
 
 
+class CounterVec:
+    """Counter with one label dimension; each label value gets a child
+    series rendered as ``name{label="value"} n``. ``value`` sums all
+    children so callers that read the unlabeled total (back-compat with
+    the plain Counter this may replace) keep working."""
+
+    __slots__ = ("name", "help", "label", "_children", "_lock")
+
+    def __init__(self, name: str, help: str = "", label: str = "reason"):
+        self.name = name
+        self.help = help
+        self.label = label
+        self._children: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, value: str = "", n: float = 1.0) -> None:
+        with self._lock:
+            self._children[value] = self._children.get(value, 0.0) + n
+
+    def get(self, value: str = "") -> float:
+        with self._lock:
+            return self._children.get(value, 0.0)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return sum(self._children.values())
+
+    def render(self) -> list[str]:
+        with self._lock:
+            children = sorted(self._children.items())
+        out = [f"# TYPE {self.name} counter"]
+        if not children:
+            out.append(f"{self.name} 0")
+        for label_value, v in children:
+            out.append(f'{self.name}{{{self.label}="{label_value}"}} {_fmt(v)}')
+        return out
+
+    def series(self) -> dict[str, float]:
+        with self._lock:
+            children = dict(self._children)
+        return {f"{self.name}_{lv}" if lv else self.name: v for lv, v in children.items()}
+
+
 def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
@@ -464,6 +508,23 @@ class MetricsRegistry:
 
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get_or_create(name, lambda: Counter(name, help))
+
+    def counter_vec(self, name: str, help: str = "", label: str = "reason") -> CounterVec:
+        with self._lock:
+            m = self._metrics.get(name)
+            if isinstance(m, Counter):
+                # a plain Counter was registered under this name first (e.g.
+                # a reader touched it before the owner): upgrade in place,
+                # preserving the accumulated total under the empty label
+                vec = CounterVec(name, help or m.help, label=label)
+                if m.value:
+                    vec.inc("", m.value)
+                self._metrics[name] = vec
+                return vec
+            if m is None:
+                m = CounterVec(name, help, label=label)
+                self._metrics[name] = m
+            return m
 
     def gauge(self, name: str, help: str = "", track_max: bool = False) -> Gauge:
         return self._get_or_create(name, lambda: Gauge(name, help, track_max=track_max))

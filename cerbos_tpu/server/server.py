@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from concurrent import futures
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -21,6 +22,7 @@ import grpc
 from aiohttp import web
 
 from ..engine import types as T
+from ..engine.batcher import DeadlineExceeded
 from . import convert, wire_validate
 from .service import CerbosService, RequestLimitExceeded
 
@@ -225,10 +227,18 @@ def _grpc_rpcs(svc: CerbosService):
             if req.HasField("aux_data") and req.aux_data.jwt.token:
                 aux = svc._extract_aux_data(req.aux_data.jwt.token, req.aux_data.jwt.key_set_id)
             inputs = convert.check_resources_request_to_inputs(req, aux)
-            outputs, call_id = svc.check_resources(inputs)
+            # propagate the client's gRPC deadline down the device path so
+            # already-expired requests are dropped instead of evaluated
+            deadline = None
+            remaining = ctx.time_remaining()
+            if remaining is not None:
+                deadline = time.monotonic() + remaining
+            outputs, call_id = svc.check_resources(inputs, deadline=deadline)
             return convert.outputs_to_check_resources_response(req, outputs, call_id)
         except RequestLimitExceeded as e:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except DeadlineExceeded as e:
+            ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:  # noqa: BLE001
             ctx.abort(grpc.StatusCode.INTERNAL, f"check failed: {e}")
 
@@ -626,6 +636,8 @@ class Server:
             return web.json_response(convert.outputs_to_json(body, outputs, request_id, include_meta, call_id))
         except RequestLimitExceeded as e:
             return web.json_response({"code": 3, "message": str(e)}, status=400)
+        except DeadlineExceeded as e:
+            return web.json_response({"code": 4, "message": str(e)}, status=504)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"code": 13, "message": f"check failed: {e}"}, status=500)
 

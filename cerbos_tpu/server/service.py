@@ -88,6 +88,7 @@ class CerbosService:
         self,
         inputs: list[T.CheckInput],
         params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
     ) -> tuple[list[T.CheckOutput], str]:
         if len(inputs) > self.limits.max_resources_per_request:
             raise RequestLimitExceeded(
@@ -102,7 +103,7 @@ class CerbosService:
                 raise RequestLimitExceeded("at least one action must be specified")
         call_id = uuid.uuid4().hex
         t0 = time.perf_counter()
-        outputs = self.engine.check(inputs, params=params)
+        outputs = self.engine.check(inputs, params=params, deadline=deadline)
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
             self.audit_log.write_decision(call_id, inputs, outputs)

@@ -60,6 +60,10 @@ class TestHelmTemplate:
         assert tpu["streamingThreshold"] == 1024
         assert tpu["inflightDepth"] == 3
         assert tpu["pipelineChunk"] == 4096
+        # device-path fault domain defaults (docs/ROBUSTNESS.md)
+        assert tpu["breaker"]["enabled"] is True
+        assert tpu["breaker"]["failureThreshold"] == 5
+        assert tpu["quarantineMax"] == 128
         assert "tls" not in conf.get("server", {})
         svc = docs[("Service", "pdp-cerbos-tpu")]
         assert {(p["name"], p["port"]) for p in svc["spec"]["ports"]} == {
@@ -116,8 +120,10 @@ class TestChartStatic:
         from cerbos_tpu.config import DEFAULTS
 
         want = DEFAULTS["engine"]["tpu"]
-        for knob in ("streamingThreshold", "inflightDepth", "pipelineChunk"):
+        for knob in ("streamingThreshold", "inflightDepth", "pipelineChunk", "quarantineMax"):
             assert tpu[knob] == want[knob], knob
+        for knob in ("enabled", "failureThreshold", "probeBackoffBaseMs", "probeBackoffCapMs"):
+            assert tpu["breaker"][knob] == want["breaker"][knob], knob
 
     def test_all_templates_present(self):
         tdir = os.path.join(CHART_DIR, "templates")

@@ -313,3 +313,8 @@ class TestMetricsEndpoint:
         assert "cerbos_tpu_batcher_batch_size_bucket" in text
         assert "cerbos_tpu_batcher_queue_wait_seconds_bucket" in text
         assert "cerbos_tpu_batcher_inflight" in text
+        # device-path fault domain metrics (docs/ROBUSTNESS.md)
+        assert "cerbos_tpu_breaker_state" in text
+        assert "cerbos_tpu_breaker_trips_total" in text
+        assert "cerbos_tpu_batcher_deadline_drops_total" in text
+        assert "cerbos_tpu_batcher_quarantined_total" in text
