@@ -206,7 +206,29 @@ def index_only_main(smoke: bool) -> int:
     return 0 if parity_ok else 1
 
 
-def served_main(smoke: bool) -> int:
+def _stage_percentiles() -> dict:
+    """Per-stage p50/p99 from the batcher's cerbos_tpu_batch_stage_seconds
+    HistogramVec, for the machine-readable perf artifact."""
+    from cerbos_tpu.observability import metrics
+
+    vec = metrics().instruments().get("cerbos_tpu_batch_stage_seconds")
+    if vec is None:
+        return {}
+    stages = {}
+    with vec._lock:
+        children = dict(vec._children)
+    for stage, hist in sorted(children.items()):
+        _, total, count = hist.snapshot()
+        stages[stage] = {
+            "p50_s": round(hist.percentile(0.50), 6),
+            "p99_s": round(hist.percentile(0.99), 6),
+            "mean_s": round(total / count, 6) if count else 0.0,
+            "count": count,
+        }
+    return stages
+
+
+def served_main(smoke: bool, json_path: str = "") -> int:
     """--served: throughput through the real serving path (BatchingEvaluator).
 
     The direct-evaluator numbers above measure the device backend in
@@ -277,6 +299,11 @@ def served_main(smoke: bool) -> int:
         "breaker_trips": health.stats["trips"],
         "oracle_fallbacks": batcher.stats["oracle_fallbacks"],
         "deadline_drops": batcher.stats["deadline_drops"],
+        # per-stage latency attribution + device-layout economics from the
+        # observability layer (the same series /_cerbos/metrics exposes)
+        "stages": _stage_percentiles(),
+        "occupancy": batcher.m_occupancy.value,
+        "padding_waste_rows": batcher.m_padding_waste.value,
         "probe": tpu_probe.summarize(evidence),
     }
     print(
@@ -285,6 +312,11 @@ def served_main(smoke: bool) -> int:
         flush=True,
     )
     print(json.dumps(record))
+    if json_path:
+        with open(json_path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        print(f"wrote perf artifact: {json_path}", flush=True)
     return 0
 
 
@@ -303,11 +335,16 @@ def main() -> None:
         help="measure through the real BatchingEvaluator serving path "
         "(concurrent clients, cross-request batching, streaming pipeline)",
     )
+    parser.add_argument(
+        "--json", metavar="PATH", default="",
+        help="with --served: also write the JSON record to PATH "
+        "(machine-readable perf artifact, e.g. BENCH_SERVED.json)",
+    )
     args = parser.parse_args()
     if args.index_only:
         sys.exit(index_only_main(smoke=args.smoke))
     if args.served:
-        sys.exit(served_main(smoke=args.smoke))
+        sys.exit(served_main(smoke=args.smoke, json_path=args.json))
 
     evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
     probe = tpu_probe.probe_ladder(attempts=1)

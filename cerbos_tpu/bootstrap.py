@@ -120,6 +120,14 @@ def initialize(
     manager = RuleTableManager(store, prebuilt_table=prebuilt.rule_table if prebuilt else None)
 
     tpu_conf = engine_conf.get("tpu", {})
+    flight_conf = tpu_conf.get("flightRecorder", {}) or {}
+    from .engine import flight as _flight
+
+    _flight.configure(
+        capacity=int(flight_conf.get("capacity", _flight.DEFAULT_CAPACITY)),
+        enabled=bool(flight_conf.get("enabled", True)),
+    )
+    _flight.install_sigquit_dump()
     tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
     tpu_evaluator = None
     dispatch_evaluator = None

@@ -55,6 +55,9 @@ DEFAULTS: dict[str, Any] = {
             },
             "quarantineMax": 128,
             "faults": "",
+            # bounded ring of recent device-batch records + fault events,
+            # served at /_cerbos/debug/flight and dumped on SIGQUIT
+            "flightRecorder": {"enabled": True, "capacity": 256},
         },
     },
     "storage": {"driver": "disk", "disk": {"directory": "policies", "watchForChanges": False}},

@@ -40,8 +40,10 @@ from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
+from ..observability import SpanContext, current_span_context, export_span, start_span
 from ..ruletable import check_input
 from . import types as T
+from .flight import recorder as flight_recorder
 from .health import DeviceHealth  # noqa: F401  (re-exported for wiring/tests)
 
 _log = logging.getLogger("cerbos_tpu.engine.batcher")
@@ -72,6 +74,11 @@ class _Pending:
     future: Future
     enqueued_at: float = field(default_factory=time.perf_counter)
     deadline: Optional[float] = None  # absolute time.monotonic() deadline
+    # the request's span context, detached on the request thread so the
+    # batcher drain thread can parent/link device-batch spans into the
+    # request's trace (span parenting via observability._current is
+    # thread-local and dies at this hop otherwise)
+    ctx: Optional[SpanContext] = None
 
 
 @dataclass
@@ -80,6 +87,14 @@ class _Inflight:
 
     ticket: Any
     group: list[_Pending]
+    batch_id: int = 0
+    n_inputs: int = 0
+    batch_ctx: Optional[SpanContext] = None  # the batch.submit span
+    timings: dict = field(default_factory=dict)  # stage -> seconds
+    submitted_at: float = 0.0  # perf_counter at submit return
+    submitted_wall_ns: int = 0
+    occupancy: float = 1.0
+    layout_key: Optional[str] = None
 
 
 def _settle(fut: Future, result: Any = None, error: Optional[BaseException] = None) -> None:
@@ -203,6 +218,22 @@ class BatchingEvaluator:
             "cerbos_tpu_batcher_quarantined_total",
             "poison inputs quarantined after batch bisection",
         )
+        # device-economics: how full the padded device layouts actually are,
+        # and the per-stage latency attribution the traces aggregate over
+        self.m_occupancy = reg.gauge(
+            "cerbos_tpu_batch_occupancy",
+            "real rows / padded rows of the last device batch (1.0 = no padding waste)",
+        )
+        self.m_padding_waste = reg.counter(
+            "cerbos_tpu_batch_padding_waste_rows_total",
+            "padded device rows that carried no real input",
+        )
+        self.m_stage_seconds = reg.histogram_vec(
+            "cerbos_tpu_batch_stage_seconds",
+            "device-batch pipeline stage latency (pack/submit/device/collect/settle)",
+            label="stage",
+            buckets=[0.0001, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0],
+        )
 
     # -- oracle fallback ----------------------------------------------------
 
@@ -244,39 +275,45 @@ class BatchingEvaluator:
         if self._stop or self._dead is not None or not self._thread.is_alive():
             # drain loop gone (shutdown or crash): fail fast to the oracle
             return self._serve_oracle(inputs, params, "batcher_dead")
-        fut: Future = Future()
-        pending = _Pending(list(inputs), params, fut, deadline=deadline)
-        with self._wakeup:
-            self._queue.append(pending)
-            self._wakeup.notify()
-        wait = self.request_timeout
-        if deadline is not None:
-            wait = min(wait, max(0.0, deadline - time.monotonic()))
-        try:
-            return fut.result(timeout=wait)
-        except DeadlineExceeded:
-            raise
-        except _BatchFailed as e:
-            # the device batch failed (or the batcher is shutting down /
-            # dead, or the breaker opened while queued): recover this
-            # request's own inputs from the oracle
-            return self._serve_oracle(pending.inputs, params, e.reason)
-        except (TimeoutError, FutureTimeoutError):  # distinct classes before 3.11
-            # a wedged device must not block server threads forever: drop the
-            # request from the queue (if still there) and serve it from the
-            # CPU oracle. The future is NOT cancelled — if the device call
-            # eventually returns, _collect's set_result on it must stay legal.
+        with start_span("batcher.enqueue", inputs=len(inputs)) as span:
+            fut: Future = Future()
+            # the span context crosses the batcher thread hop in _Pending so
+            # the device batch's spans land in this request's trace
+            pending = _Pending(list(inputs), params, fut, deadline=deadline, ctx=span.context)
             with self._wakeup:
-                try:
-                    self._queue.remove(pending)
-                except ValueError:
-                    pass
-            if deadline is not None and time.monotonic() >= deadline:
-                self._count_deadline_drop()
-                raise DeadlineExceeded("request deadline expired while queued") from None
-            if health is not None:
-                health.record_timeout()
-            return self._serve_oracle(pending.inputs, params, "timeout")
+                self._queue.append(pending)
+                self._wakeup.notify()
+            wait = self.request_timeout
+            if deadline is not None:
+                wait = min(wait, max(0.0, deadline - time.monotonic()))
+            try:
+                return fut.result(timeout=wait)
+            except DeadlineExceeded:
+                span.set_attribute("outcome", "deadline_exceeded")
+                raise
+            except _BatchFailed as e:
+                # the device batch failed (or the batcher is shutting down /
+                # dead, or the breaker opened while queued): recover this
+                # request's own inputs from the oracle
+                span.set_attribute("outcome", e.reason)
+                return self._serve_oracle(pending.inputs, params, e.reason)
+            except (TimeoutError, FutureTimeoutError):  # distinct classes before 3.11
+                # a wedged device must not block server threads forever: drop the
+                # request from the queue (if still there) and serve it from the
+                # CPU oracle. The future is NOT cancelled — if the device call
+                # eventually returns, _collect's set_result on it must stay legal.
+                with self._wakeup:
+                    try:
+                        self._queue.remove(pending)
+                    except ValueError:
+                        pass
+                if deadline is not None and time.monotonic() >= deadline:
+                    self._count_deadline_drop()
+                    raise DeadlineExceeded("request deadline expired while queued") from None
+                if health is not None:
+                    health.record_timeout()
+                span.set_attribute("outcome", "timeout")
+                return self._serve_oracle(pending.inputs, params, "timeout")
 
     def _count_deadline_drop(self) -> None:
         self.stats["deadline_drops"] += 1
@@ -380,23 +417,67 @@ class BatchingEvaluator:
             for p in group:
                 all_inputs.extend(p.inputs)
                 self.m_queue_wait.observe(now - p.enqueued_at)
+            batch_id = flight_recorder().next_batch_id()
             submit = getattr(self.evaluator, "submit", None)
+            # parent the batch under the first co-batched request's trace and
+            # link the rest: one trace gets real descendants, every other
+            # co-batched trace still reaches the batch via its link
+            links = [p.ctx for p in group if p.ctx is not None]
+            parent = links[0] if links else None
             try:
-                if submit is not None:
-                    ticket = submit(all_inputs, group[0].params)
-                else:
-                    # plain evaluator without a streaming API: evaluate
-                    # synchronously and carry the result as a ready ticket
-                    ticket = _ReadyTicket(self.evaluator.check(all_inputs, group[0].params))
+                with start_span(
+                    "batch.submit",
+                    parent=parent,
+                    links=links,
+                    batch_id=batch_id,
+                    requests=len(group),
+                    inputs=len(all_inputs),
+                ) as span:
+                    batch_ctx = span.context
+                    t0 = time.perf_counter()
+                    if submit is not None:
+                        ticket = submit(all_inputs, group[0].params)
+                    else:
+                        # plain evaluator without a streaming API: evaluate
+                        # synchronously and carry the result as a ready ticket
+                        ticket = _ReadyTicket(self.evaluator.check(all_inputs, group[0].params))
+                    submit_s = time.perf_counter() - t0
             except Exception as e:  # noqa: BLE001
-                self._batch_failed(group, all_inputs, e)
+                self._batch_failed(group, all_inputs, e, batch_id=batch_id)
                 continue
             self.stats["batches"] += 1
             self.stats["batched_requests"] += len(group)
             self.m_batches.inc()
             self.m_requests.inc(len(group))
             self.m_batch_size.observe(len(all_inputs))
-            inflight.append(_Inflight(ticket, group))
+            # stage timings: pack happens inside the evaluator's submit, which
+            # reports it (plus layout economics) as ticket attributes; sync
+            # evaluators have no packed device layout, so occupancy is 1.0
+            pack_s = float(getattr(ticket, "pack_s", 0.0) or 0.0)
+            occupancy = getattr(ticket, "occupancy", None)
+            if occupancy is None:
+                occupancy = 1.0
+            padded_rows = getattr(ticket, "padded_rows", None)
+            flight = _Inflight(
+                ticket,
+                group,
+                batch_id=batch_id,
+                n_inputs=len(all_inputs),
+                batch_ctx=batch_ctx,
+                timings={"pack": pack_s, "submit": max(0.0, submit_s - pack_s)},
+                submitted_at=time.perf_counter(),
+                submitted_wall_ns=time.time_ns(),
+                occupancy=float(occupancy),
+                layout_key=getattr(ticket, "layout_key", None),
+            )
+            self.m_stage_seconds.observe("pack", flight.timings["pack"])
+            self.m_stage_seconds.observe("submit", flight.timings["submit"])
+            self.m_occupancy.set(float(occupancy))
+            if padded_rows:
+                waste = int(round(padded_rows * (1.0 - float(occupancy))))
+                if waste > 0:
+                    self.m_padding_waste.inc(waste)
+            inflight.append(flight)
             depth = len(inflight)
             self.m_inflight.set(depth)
             if depth > self.stats["inflight_peak"]:
@@ -404,26 +485,75 @@ class BatchingEvaluator:
 
     def _collect(self, flight: _Inflight) -> None:
         group = flight.group
+        collect_start = time.perf_counter()
+        # the window between submit returning and collect starting is device
+        # transfer + compute time no host thread executes; synthesize it as
+        # a span so the trace shows where the latency actually went
+        if flight.submitted_at:
+            device_s = max(0.0, collect_start - flight.submitted_at)
+            flight.timings["device"] = device_s
+            self.m_stage_seconds.observe("device", device_s)
+            export_span(
+                "batch.device",
+                flight.batch_ctx,
+                flight.submitted_wall_ns,
+                device_s,
+                batch_id=flight.batch_id,
+            )
         try:
-            if isinstance(flight.ticket, _ReadyTicket):
-                outputs = flight.ticket.outputs
-            else:
-                outputs = self.evaluator.collect(flight.ticket)
+            with start_span(
+                "batch.collect", parent=flight.batch_ctx, batch_id=flight.batch_id
+            ):
+                if isinstance(flight.ticket, _ReadyTicket):
+                    outputs = flight.ticket.outputs
+                else:
+                    outputs = self.evaluator.collect(flight.ticket)
         except Exception as e:  # noqa: BLE001
+            flight.timings["collect"] = time.perf_counter() - collect_start
             all_inputs: list[T.CheckInput] = []
             for p in group:
                 all_inputs.extend(p.inputs)
-            self._batch_failed(group, all_inputs, e)
+            self._batch_failed(group, all_inputs, e, flight=flight)
             return
+        collect_s = time.perf_counter() - collect_start
+        flight.timings["collect"] = collect_s
+        self.m_stage_seconds.observe("collect", collect_s)
         if self.health is not None:
             self.health.record_success()
-        offset = 0
-        for p in group:
-            _settle(p.future, result=outputs[offset : offset + len(p.inputs)])
-            offset += len(p.inputs)
+        settle_start = time.perf_counter()
+        with start_span(
+            "request.settle", parent=flight.batch_ctx, batch_id=flight.batch_id
+        ):
+            offset = 0
+            for p in group:
+                _settle(p.future, result=outputs[offset : offset + len(p.inputs)])
+                offset += len(p.inputs)
+        settle_s = time.perf_counter() - settle_start
+        flight.timings["settle"] = settle_s
+        self.m_stage_seconds.observe("settle", settle_s)
+        self._record_flight(flight, outcome="ok")
+
+    def _record_flight(self, flight: _Inflight, outcome: str) -> None:
+        health = self.health
+        flight_recorder().record_batch(
+            flight.batch_id,
+            trace_ids=sorted({p.ctx.trace_id for p in flight.group if p.ctx is not None}),
+            requests=len(flight.group),
+            inputs=flight.n_inputs,
+            timings=flight.timings,
+            outcome=outcome,
+            occupancy=flight.occupancy,
+            layout_key=flight.layout_key,
+            breaker_state=health.state if health is not None else None,
+        )
 
     def _batch_failed(
-        self, group: list[_Pending], all_inputs: list[T.CheckInput], e: Exception
+        self,
+        group: list[_Pending],
+        all_inputs: list[T.CheckInput],
+        e: Exception,
+        batch_id: int = 0,
+        flight: Optional[_Inflight] = None,
     ) -> None:
         """A device batch raised: settle each co-batched waiter with
         _BatchFailed so they each re-serve from the oracle (never a 5xx),
@@ -434,6 +564,15 @@ class BatchingEvaluator:
         _log.warning(
             "device batch failed; co-batched requests fall back to the CPU oracle",
             extra={"fields": {"inputs": len(all_inputs), "error": repr(e)}},
+        )
+        if flight is None:
+            flight = _Inflight(None, group, batch_id=batch_id, n_inputs=len(all_inputs))
+        self._record_flight(flight, outcome=f"error:{type(e).__name__}")
+        flight_recorder().record_event(
+            "batch_failed",
+            batch_id=flight.batch_id,
+            inputs=len(all_inputs),
+            error=repr(e),
         )
         for p in group:
             _settle(p.future, error=_BatchFailed(e))
@@ -484,6 +623,12 @@ class BatchingEvaluator:
             if ok_any:
                 for inp in poisons:
                     self._quarantine_add(inp)
+            flight_recorder().record_event(
+                "bisect_done",
+                inputs=len(inputs),
+                sibling_ok=ok_any,
+                poisons=len(poisons) if ok_any else 0,
+            )
         except Exception:  # noqa: BLE001  (bisect is best-effort, off-path)
             pass
         finally:
@@ -499,6 +644,12 @@ class BatchingEvaluator:
                 self._quarantine.pop(next(iter(self._quarantine)))
         self.stats["quarantined"] += 1
         self.m_quarantined.inc()
+        flight_recorder().record_event(
+            "quarantine_add",
+            principal=inp.principal.id,
+            resource_kind=inp.resource.kind,
+            resource_id=inp.resource.id,
+        )
         _log.error(
             "quarantined poison input: it crashes device batches and will be "
             "served by the CPU oracle",

@@ -1,0 +1,140 @@
+"""Batch flight recorder: a bounded ring buffer of recent device batches.
+
+A production incident on the device path (a breaker trip, a poisoned batch,
+a latency cliff) is reconstructable after the fact only if the server kept
+the evidence: which requests were co-batched, how long each pipeline stage
+took, how full the padded device layout actually was, and what the fault
+machinery did about failures. The recorder keeps the last N batch records
+plus a parallel ring of discrete events (breaker transitions, bisect
+outcomes, quarantine additions), dumpable as JSON via the
+``/_cerbos/debug/flight`` endpoint and printed to stderr on ``SIGQUIT``.
+
+Recording is a dict append under a lock — never an allocation spike, never
+I/O — so it is safe on the batcher drain loop's hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+DEFAULT_CAPACITY = 256
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of batch records + events."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True):
+        self.capacity = max(1, int(capacity))
+        self.enabled = enabled
+        self._records: deque[dict] = deque(maxlen=self.capacity)
+        self._events: deque[dict] = deque(maxlen=self.capacity)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def next_batch_id(self) -> int:
+        return next(self._ids)
+
+    def record_batch(
+        self,
+        batch_id: int,
+        *,
+        trace_ids: list[str],
+        requests: int,
+        inputs: int,
+        timings: dict[str, float],
+        outcome: str,
+        occupancy: Optional[float] = None,
+        layout_key: Optional[str] = None,
+        breaker_state: Optional[str] = None,
+    ) -> None:
+        if not self.enabled:
+            return
+        rec = {
+            "batch_id": batch_id,
+            "ts": time.time(),
+            "trace_ids": trace_ids,
+            "requests": requests,
+            "inputs": inputs,
+            "timings": {k: round(v, 6) for k, v in timings.items()},
+            "outcome": outcome,
+            "occupancy": round(occupancy, 4) if occupancy is not None else None,
+            "layout_key": layout_key,
+            "breaker_state": breaker_state,
+        }
+        with self._lock:
+            self._records.append(rec)
+
+    def record_event(self, kind: str, **fields: Any) -> None:
+        """Discrete device-path events: breaker transitions, bisect results,
+        quarantine additions, deadline storms."""
+        if not self.enabled:
+            return
+        ev = {"kind": kind, "ts": time.time(), **fields}
+        with self._lock:
+            self._events.append(ev)
+
+    def dump(self) -> dict:
+        with self._lock:
+            records = list(self._records)
+            events = list(self._events)
+        return {
+            "capacity": self.capacity,
+            "batches": records,
+            "events": events,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._events.clear()
+
+
+_recorder = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def configure(capacity: int = DEFAULT_CAPACITY, enabled: bool = True) -> FlightRecorder:
+    """Re-bound the process-wide recorder (bootstrap wiring). Existing
+    references keep working: the instance is mutated, not replaced."""
+    rec = _recorder
+    with rec._lock:
+        rec.capacity = max(1, int(capacity))
+        rec.enabled = enabled
+        rec._records = deque(rec._records, maxlen=rec.capacity)
+        rec._events = deque(rec._events, maxlen=rec.capacity)
+    return rec
+
+
+def install_sigquit_dump() -> bool:
+    """Print the flight dump to stderr on SIGQUIT (the classic "what was the
+    server just doing" signal). Returns False off-main-thread or where the
+    signal doesn't exist; the HTTP debug endpoint still works there."""
+    if not hasattr(signal, "SIGQUIT"):
+        return False
+
+    prev = signal.getsignal(signal.SIGQUIT)
+
+    def dump(_sig, _frm):
+        try:
+            sys.stderr.write(json.dumps(_recorder.dump(), default=str) + "\n")
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001  (diagnostics must never kill serving)
+            pass
+        if callable(prev):
+            prev(_sig, _frm)
+
+    with contextlib.suppress(ValueError):  # non-main threads can't set handlers
+        signal.signal(signal.SIGQUIT, dump)
+        return True
+    return False

@@ -25,6 +25,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..util.retry import backoff_delay
+from .flight import recorder as flight_recorder
 
 STATE_CLOSED = "closed"
 STATE_OPEN = "open"
@@ -92,7 +93,25 @@ class DeviceHealth:
             "cerbos_tpu_breaker_trips_total",
             "times the device-path breaker tripped open",
         )
+        self.m_transitions = reg.counter_vec(
+            "cerbos_tpu_breaker_transitions_total",
+            "breaker state transitions, labeled from_to (e.g. closed_open)",
+            label="transition",
+        )
         self.m_state.set(_STATE_CODE[self._state])
+
+    def _set_state_locked(self, new_state: str, cause: str = "") -> None:
+        """Single choke point for state changes: gauge, transition counter,
+        and a flight-recorder event carrying the from/to edge."""
+        old = self._state
+        if old == new_state:
+            return
+        self._state = new_state
+        self.m_state.set(_STATE_CODE[new_state])
+        self.m_transitions.inc(f"{old}_{new_state}")
+        flight_recorder().record_event(
+            "breaker_transition", frm=old, to=new_state, cause=cause
+        )
 
     # -- state queries ------------------------------------------------------
 
@@ -121,11 +140,10 @@ class DeviceHealth:
             self._tick_locked()
             if self._state != STATE_OPEN or self._clock() < self._next_probe_at:
                 return None
-            self._state = STATE_HALF_OPEN
+            self._set_state_locked(STATE_HALF_OPEN, "probe_due")
             self._probe_token += 1
             self._probe_started_at = self._clock()
             self.stats["probes"] += 1
-            self.m_state.set(_STATE_CODE[self._state])
             return self._probe_token
 
     # -- outcome recording --------------------------------------------------
@@ -166,11 +184,10 @@ class DeviceHealth:
         with self._lock:
             if token != self._probe_token or self._state != STATE_HALF_OPEN:
                 return  # stale probe (expired or superseded): ignore
-            self._state = STATE_CLOSED
+            self._set_state_locked(STATE_CLOSED, "probe_succeeded")
             self._consecutive_failures = 0
             self._trip_streak = 0
             self._outcomes.clear()
-            self.m_state.set(_STATE_CODE[self._state])
             _log.info("device-path breaker re-closed after successful probe")
 
     def probe_failed(self, token: int) -> None:
@@ -189,26 +206,24 @@ class DeviceHealth:
             self._outcomes.popleft()
 
     def _trip_locked(self, cause: str) -> None:
-        self._state = STATE_OPEN
+        self._set_state_locked(STATE_OPEN, cause)
         self._trip_streak += 1
         self._next_probe_at = self._clock() + backoff_delay(
             self._trip_streak, self.probe_backoff_base_s, self.probe_backoff_cap_s
         )
         self.stats["trips"] += 1
         self.m_trips.inc()
-        self.m_state.set(_STATE_CODE[self._state])
         _log.error(
             "device-path breaker tripped open; serving from the CPU oracle",
             extra={"fields": {"cause": cause, "streak": self._trip_streak}},
         )
 
     def _reopen_locked(self) -> None:
-        self._state = STATE_OPEN
+        self._set_state_locked(STATE_OPEN, "probe_failed")
         self._trip_streak += 1
         self._next_probe_at = self._clock() + backoff_delay(
             self._trip_streak, self.probe_backoff_base_s, self.probe_backoff_cap_s
         )
-        self.m_state.set(_STATE_CODE[self._state])
 
     def _tick_locked(self) -> None:
         """Expire a probe that never reported back (the probe thread is

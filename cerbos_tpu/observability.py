@@ -15,11 +15,11 @@ import contextlib
 import json
 import logging
 import os
+import re
 import signal
 import sys
 import threading
 import time
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
 
@@ -69,17 +69,73 @@ def init_logging(level: str = "info", fmt: str = "json") -> None:
 # spans
 
 
+def new_trace_id() -> str:
+    """A proper W3C trace id: 32 lowercase hex chars, never all-zero."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """A proper W3C span id: 16 lowercase hex chars, never all-zero."""
+    return os.urandom(8).hex()
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """Detachable identity of a span: everything needed to parent or link a
+    span created on another thread (the batcher hop) or emitted by a remote
+    caller (W3C ``traceparent``)."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+
+_TRACEPARENT_RX = re.compile(
+    r"^(?P<version>[0-9a-f]{2})-(?P<trace_id>[0-9a-f]{32})-"
+    r"(?P<span_id>[0-9a-f]{16})-(?P<flags>[0-9a-f]{2})$"
+)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[SpanContext]:
+    """W3C trace-context ``traceparent`` → SpanContext, or None when the
+    header is absent or malformed (per spec, a bad header is ignored and the
+    receiver starts a fresh trace)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RX.match(header.strip().lower())
+    if m is None or m.group("version") == "ff":
+        return None
+    trace_id, span_id = m.group("trace_id"), m.group("span_id")
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id, sampled=bool(int(m.group("flags"), 16) & 0x01))
+
+
 @dataclass
 class Span:
     name: str
     trace_id: str
-    span_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+    span_id: str = field(default_factory=new_span_id)
     parent_id: str = ""
     start: float = field(default_factory=time.perf_counter)
+    # wall-clock capture at span START so a late-flushed OTLP export carries
+    # the true start time instead of deriving it backwards from export time
+    start_wall_ns: int = field(default_factory=time.time_ns)
     attributes: dict[str, Any] = field(default_factory=dict)
+    links: list[SpanContext] = field(default_factory=list)
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
+
+    def add_link(self, ctx: SpanContext) -> None:
+        self.links.append(ctx)
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
 
 
 class SpanExporter:
@@ -120,19 +176,27 @@ class OTLPSpanExporter(SpanExporter):
         self._thread.start()
 
     def export(self, span: Span, duration_ms: float) -> None:
-        now_ns = time.time_ns()
+        # ids are generated as proper 32/16-hex W3C ids at span creation;
+        # export them verbatim (padding short ids here would fabricate ids
+        # that collide across spans), and timestamps come from the span's
+        # wall-clock START capture, not from flush time
+        start_ns = span.start_wall_ns
         otlp_span = {
-            "traceId": span.trace_id[:32].ljust(32, "0"),
-            "spanId": span.span_id[:16].ljust(16, "0"),
-            "parentSpanId": span.parent_id[:16].ljust(16, "0") if span.parent_id else "",
+            "traceId": span.trace_id,
+            "spanId": span.span_id,
+            "parentSpanId": span.parent_id,
             "name": span.name,
             "kind": 1,  # SPAN_KIND_INTERNAL
-            "startTimeUnixNano": str(now_ns - int(duration_ms * 1e6)),
-            "endTimeUnixNano": str(now_ns),
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(start_ns + int(duration_ms * 1e6)),
             "attributes": [
                 {"key": k, "value": {"stringValue": str(v)}} for k, v in span.attributes.items()
             ],
         }
+        if span.links:
+            otlp_span["links"] = [
+                {"traceId": l.trace_id, "spanId": l.span_id} for l in span.links
+            ]
         with self._lock:
             self._buf.append(otlp_span)
             if len(self._buf) > self.max_batch * 4:
@@ -213,25 +277,68 @@ def close_exporter() -> None:
         close()
 
 
+def current_span_context() -> Optional[SpanContext]:
+    """Detach the active span's identity so another thread can parent or
+    link to it (span parenting via ``_current`` is thread-local; the batcher
+    hop carries this snapshot in ``_Pending`` instead)."""
+    span = _current.get(threading.get_ident())
+    return span.context if span is not None else None
+
+
 @contextlib.contextmanager
-def start_span(name: str, **attributes: Any) -> Iterator[Span]:
+def start_span(
+    name: str,
+    parent: "SpanContext | Span | None" = None,
+    links: Optional[list[SpanContext]] = None,
+    **attributes: Any,
+) -> Iterator[Span]:
+    """Open a span. Parenting is thread-local by default; pass ``parent=`` —
+    a SpanContext detached via :func:`current_span_context` or parsed from a
+    remote ``traceparent`` — to join a trace across a thread hop or an RPC
+    boundary. ``links=`` attaches non-parent causal references (a device
+    batch links every co-batched request's trace)."""
     tid = threading.get_ident()
-    parent = _current.get(tid)
+    prev = _current.get(tid)
+    eff_parent: "SpanContext | Span | None" = parent if parent is not None else prev
     span = Span(
         name=name,
-        trace_id=parent.trace_id if parent else uuid.uuid4().hex,
-        parent_id=parent.span_id if parent else "",
+        trace_id=eff_parent.trace_id if eff_parent else new_trace_id(),
+        parent_id=eff_parent.span_id if eff_parent else "",
         attributes=dict(attributes),
+        links=list(links or ()),
     )
     _current[tid] = span
     try:
         yield span
     finally:
-        if parent is None:
+        if prev is None:
             _current.pop(tid, None)
         else:
-            _current[tid] = parent
+            _current[tid] = prev
         _exporter.export(span, (time.perf_counter() - span.start) * 1000)
+
+
+def export_span(
+    name: str,
+    parent: Optional[SpanContext],
+    start_wall_ns: int,
+    duration_s: float,
+    links: Optional[list[SpanContext]] = None,
+    **attributes: Any,
+) -> Span:
+    """Synthesize and export a span for an interval measured elsewhere (the
+    in-flight device window has no thread executing it; the batcher stamps
+    its start/end around submit/collect instead)."""
+    span = Span(
+        name=name,
+        trace_id=parent.trace_id if parent else new_trace_id(),
+        parent_id=parent.span_id if parent else "",
+        start_wall_ns=start_wall_ns,
+        attributes=dict(attributes),
+        links=list(links or ()),
+    )
+    _exporter.export(span, duration_s * 1000)
+    return span
 
 
 class OTLPMetricsExporter:
@@ -340,10 +447,13 @@ class Counter:
         return self._value
 
     def render(self) -> list[str]:
-        return [f"# TYPE {self.name} counter", f"{self.name} {_fmt(self._value)}"]
+        with self._lock:
+            v = self._value
+        return [f"# TYPE {self.name} counter", f"{self.name} {_fmt(v)}"]
 
     def series(self) -> dict[str, float]:
-        return {self.name: self._value}
+        with self._lock:
+            return {self.name: self._value}
 
 
 class Gauge:
@@ -383,15 +493,19 @@ class Gauge:
         return self._peak
 
     def render(self) -> list[str]:
-        out = [f"# TYPE {self.name} gauge", f"{self.name} {_fmt(self._value)}"]
+        with self._lock:  # value/peak must come from one consistent snapshot
+            v, peak = self._value, self._peak
+        out = [f"# TYPE {self.name} gauge", f"{self.name} {_fmt(v)}"]
         if self.track_max:
-            out += [f"# TYPE {self.name}_peak gauge", f"{self.name}_peak {_fmt(self._peak)}"]
+            out += [f"# TYPE {self.name}_peak gauge", f"{self.name}_peak {_fmt(peak)}"]
         return out
 
     def series(self) -> dict[str, float]:
-        out = {self.name: self._value}
+        with self._lock:
+            v, peak = self._value, self._peak
+        out = {self.name: v}
         if self.track_max:
-            out[f"{self.name}_peak"] = self._peak
+            out[f"{self.name}_peak"] = peak
         return out
 
 
@@ -427,19 +541,48 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
-    def render(self) -> list[str]:
-        out = [f"# TYPE {self.name} histogram"]
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(bucket counts, sum, count) captured under the lock — a render
+        racing observe() must never expose cumulative buckets that don't sum
+        to ``_count``."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def percentile(self, p: float) -> float:
+        """Estimate the p-quantile (0..1) by linear interpolation within the
+        owning bucket; the +Inf bucket clamps to the largest finite bound."""
+        counts, _, count = self.snapshot()
+        if count == 0:
+            return 0.0
+        rank = p * count
+        cum = 0
+        lo = 0.0
+        for i, b in enumerate(self.buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= rank:
+                frac = (rank - prev) / counts[i] if counts[i] else 0.0
+                return lo + (b - lo) * frac
+            lo = b
+        return self.buckets[-1] if self.buckets else 0.0
+
+    def render(self, label: str = "") -> list[str]:
+        counts, total, count = self.snapshot()
+        sep = "," if label else ""
+        out = [] if label else [f"# TYPE {self.name} histogram"]
         cum = 0
         for i, b in enumerate(self.buckets):
-            cum += self._counts[i]
-            out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
-        out.append(f"{self.name}_sum {_fmt(self._sum)}")
-        out.append(f"{self.name}_count {self._count}")
+            cum += counts[i]
+            out.append(f'{self.name}_bucket{{{label}{sep}le="{_fmt(b)}"}} {cum}')
+        out.append(f'{self.name}_bucket{{{label}{sep}le="+Inf"}} {count}')
+        suffix = f"{{{label}}}" if label else ""
+        out.append(f"{self.name}_sum{suffix} {_fmt(total)}")
+        out.append(f"{self.name}_count{suffix} {count}")
         return out
 
     def series(self) -> dict[str, float]:
-        return {f"{self.name}_sum": self._sum, f"{self.name}_count": float(self._count)}
+        _, total, count = self.snapshot()
+        return {f"{self.name}_sum": total, f"{self.name}_count": float(count)}
 
 
 class CounterVec:
@@ -486,6 +629,58 @@ class CounterVec:
         return {f"{self.name}_{lv}" if lv else self.name: v for lv, v in children.items()}
 
 
+class HistogramVec:
+    """Histogram with one label dimension; each label value gets a child
+    Histogram rendered as ``name_bucket{label="value",le="..."}``. Used for
+    the per-stage device-path latency series so Grafana can do
+    ``histogram_quantile(..., sum by (le, stage))`` over one instrument."""
+
+    __slots__ = ("name", "help", "label", "buckets", "_children", "_lock")
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        label: str = "stage",
+        buckets: Optional[list[float]] = None,
+    ):
+        self.name = name
+        self.help = help
+        self.label = label
+        self.buckets = buckets
+        self._children: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: str) -> Histogram:
+        with self._lock:
+            child = self._children.get(value)
+            if child is None:
+                child = Histogram(self.name, self.help, buckets=self.buckets)
+                self._children[value] = child
+            return child
+
+    def observe(self, value: str, v: float) -> None:
+        self.labels(value).observe(v)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            children = sorted(self._children.items())
+        out = [f"# TYPE {self.name} histogram"]
+        for label_value, child in children:
+            out.extend(child.render(label=f'{self.label}="{label_value}"'))
+        return out
+
+    def series(self) -> dict[str, float]:
+        with self._lock:
+            children = sorted(self._children.items())
+        out: dict[str, float] = {}
+        for label_value, child in children:
+            _, total, count = child.snapshot()
+            out[f"{self.name}_{label_value}_sum"] = total
+            out[f"{self.name}_{label_value}_count"] = float(count)
+        return out
+
+
 def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
@@ -498,16 +693,31 @@ class MetricsRegistry:
         self._metrics: dict[str, Any] = {}
         self._lock = threading.Lock()
 
-    def _get_or_create(self, name: str, factory):
+    def _get_or_create(self, name: str, factory, want: tuple = (), help: str = ""):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = factory()
                 self._metrics[name] = m
+            elif want and not isinstance(m, want):
+                # one name must never serve two instrument types: the second
+                # registrant would silently read/write the wrong semantics
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {want[0].__name__}"
+                )
+            elif help and not m.help:
+                # a reader may have touched the name first with no help text;
+                # the owning registration backfills it
+                m.help = help
             return m
 
     def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(name, lambda: Counter(name, help))
+        # CounterVec is an allowed read-alias: its .value sums all children,
+        # so code holding the unlabeled total keeps working after an upgrade
+        return self._get_or_create(
+            name, lambda: Counter(name, help), want=(Counter, CounterVec), help=help
+        )
 
     def counter_vec(self, name: str, help: str = "", label: str = "reason") -> CounterVec:
         with self._lock:
@@ -524,13 +734,42 @@ class MetricsRegistry:
             if m is None:
                 m = CounterVec(name, help, label=label)
                 self._metrics[name] = m
+            elif not isinstance(m, CounterVec):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}, not CounterVec"
+                )
+            elif help and not m.help:
+                m.help = help
             return m
 
     def gauge(self, name: str, help: str = "", track_max: bool = False) -> Gauge:
-        return self._get_or_create(name, lambda: Gauge(name, help, track_max=track_max))
+        return self._get_or_create(
+            name, lambda: Gauge(name, help, track_max=track_max), want=(Gauge,), help=help
+        )
 
     def histogram(self, name: str, help: str = "", buckets: Optional[list[float]] = None) -> Histogram:
-        return self._get_or_create(name, lambda: Histogram(name, help, buckets=buckets))
+        return self._get_or_create(
+            name, lambda: Histogram(name, help, buckets=buckets), want=(Histogram,), help=help
+        )
+
+    def histogram_vec(
+        self,
+        name: str,
+        help: str = "",
+        label: str = "stage",
+        buckets: Optional[list[float]] = None,
+    ) -> HistogramVec:
+        return self._get_or_create(
+            name,
+            lambda: HistogramVec(name, help, label=label, buckets=buckets),
+            want=(HistogramVec,),
+            help=help,
+        )
+
+    def instruments(self) -> dict[str, Any]:
+        """Snapshot of name → instrument (the metrics-lint walk)."""
+        with self._lock:
+            return dict(self._metrics)
 
     def render(self) -> str:
         with self._lock:

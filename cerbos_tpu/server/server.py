@@ -11,6 +11,7 @@ unchanged.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import threading
 import time
@@ -23,6 +24,8 @@ from aiohttp import web
 
 from ..engine import types as T
 from ..engine.batcher import DeadlineExceeded
+from ..engine.flight import recorder as flight_recorder
+from ..observability import parse_traceparent
 from . import convert, wire_validate
 from .service import CerbosService, RequestLimitExceeded
 
@@ -233,7 +236,19 @@ def _grpc_rpcs(svc: CerbosService):
             remaining = ctx.time_remaining()
             if remaining is not None:
                 deadline = time.monotonic() + remaining
-            outputs, call_id = svc.check_resources(inputs, deadline=deadline)
+            # W3C trace-context rides gRPC metadata; the parsed context
+            # parents the request span so the device batch joins the
+            # caller's trace (shim contexts may lack the metadata accessor)
+            meta_fn = getattr(ctx, "invocation_metadata", None)
+            trace_ctx = parse_traceparent(
+                dict(meta_fn() or ()).get("traceparent") if meta_fn is not None else None
+            )
+            outputs, call_id = svc.check_resources(
+                inputs, deadline=deadline, trace_ctx=trace_ctx
+            )
+            if trace_ctx is not None:
+                with contextlib.suppress(Exception):  # shim contexts may lack it
+                    ctx.set_trailing_metadata((("traceparent", trace_ctx.to_traceparent()),))
             return convert.outputs_to_check_resources_response(req, outputs, call_id)
         except RequestLimitExceeded as e:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -561,6 +576,7 @@ class Server:
         app.router.add_post("/api/x/check_resource_batch", self._h_check_resource_batch)
         app.router.add_get("/_cerbos/health", self._h_health)
         app.router.add_get("/_cerbos/metrics", self._h_metrics)
+        app.router.add_get("/_cerbos/debug/flight", self._h_flight)
         app.router.add_get("/api/server_info", self._h_server_info)
         # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
         app.router.add_get("/schema/swagger.json", self._h_swagger)
@@ -573,6 +589,11 @@ class Server:
 
     async def _h_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "SERVING"})
+
+    async def _h_flight(self, request: web.Request) -> web.Response:
+        """Flight-recorder dump: the last N device batches (trace ids, stage
+        timings, occupancy, outcome) plus breaker/bisect/quarantine events."""
+        return web.json_response(flight_recorder().dump(), dumps=lambda o: json.dumps(o, default=str))
 
     async def _h_swagger(self, request: web.Request) -> web.Response:
         from .openapi import build_swagger
@@ -627,13 +648,21 @@ class Server:
             if aux_j.get("token"):
                 aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
             inputs, request_id, include_meta = convert.json_to_check_inputs(body, aux)
+            trace_ctx = parse_traceparent(request.headers.get("traceparent"))
             if self.config.direct_dispatch:
-                outputs, call_id = self.svc.check_resources(inputs)
+                outputs, call_id = self.svc.check_resources(inputs, trace_ctx=trace_ctx)
             else:
-                outputs, call_id = await asyncio.get_running_loop().run_in_executor(
-                    None, self.svc.check_resources, inputs
+                loop = asyncio.get_running_loop()
+                outputs, call_id = await loop.run_in_executor(
+                    None, lambda: self.svc.check_resources(inputs, trace_ctx=trace_ctx)
                 )
-            return web.json_response(convert.outputs_to_json(body, outputs, request_id, include_meta, call_id))
+            resp = web.json_response(
+                convert.outputs_to_json(body, outputs, request_id, include_meta, call_id)
+            )
+            if trace_ctx is not None:
+                # echo the trace the work joined so callers can correlate
+                resp.headers["traceparent"] = trace_ctx.to_traceparent()
+            return resp
         except RequestLimitExceeded as e:
             return web.json_response({"code": 3, "message": str(e)}, status=400)
         except DeadlineExceeded as e:

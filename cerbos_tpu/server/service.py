@@ -14,6 +14,7 @@ from typing import Any, Optional
 from .. import __version__
 from ..engine import types as T
 from ..engine.engine import Engine
+from ..observability import SpanContext, start_span
 
 
 class RequestLimitExceeded(ValueError):
@@ -89,6 +90,7 @@ class CerbosService:
         inputs: list[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
+        trace_ctx: Optional[SpanContext] = None,
     ) -> tuple[list[T.CheckOutput], str]:
         if len(inputs) > self.limits.max_resources_per_request:
             raise RequestLimitExceeded(
@@ -103,7 +105,13 @@ class CerbosService:
                 raise RequestLimitExceeded("at least one action must be specified")
         call_id = uuid.uuid4().hex
         t0 = time.perf_counter()
-        outputs = self.engine.check(inputs, params=params, deadline=deadline)
+        # trace_ctx is the caller's W3C traceparent (gRPC metadata / HTTP
+        # header); with parent=None this still roots a fresh local trace
+        with start_span(
+            "request.CheckResources", parent=trace_ctx, resources=len(inputs)
+        ) as span:
+            span.set_attribute("call_id", call_id)
+            outputs = self.engine.check(inputs, params=params, deadline=deadline)
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
             self.audit_log.write_decision(call_id, inputs, outputs)

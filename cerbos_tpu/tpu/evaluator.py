@@ -17,12 +17,14 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Optional
 
 import numpy as np
 
 from .. import namer
 from ..engine import types as T
+from ..observability import start_span
 from ..ruletable.check import EvalContext, build_request_messages, check_input
 from ..ruletable.table import RuleTable
 from .condcompile import Refs
@@ -976,12 +978,18 @@ def _device_finalize(h: _DeviceHandle):
 class CheckTicket:
     """An in-flight batch submitted via TpuEvaluator.submit."""
 
-    __slots__ = ("parts", "ready", "params")
+    __slots__ = ("parts", "ready", "params", "pack_s", "occupancy", "layout_key", "padded_rows")
 
     def __init__(self):
         self.parts = None  # [(PackedBatch, _DeviceHandle)]
         self.ready = None
         self.params = None
+        # device-economics attribution read by the serving batcher: host
+        # pack time, real/padded row ratio, and the padded layout shape
+        self.pack_s = 0.0
+        self.occupancy = None  # None = no packed device layout (sync path)
+        self.layout_key = None
+        self.padded_rows = None
 
 
 class TpuEvaluator:
@@ -1094,9 +1102,18 @@ class TpuEvaluator:
         # instead of compiling a monolithic one
         chunks = self._chunk_inputs(inputs)
         t.parts = []
-        for ch in chunks:
-            batch = self.packer.pack(ch, params)
-            t.parts.append((batch, _device_dispatch(self.lowered, batch, self._jit_cache)))
+        with start_span("batch.pack", inputs=len(inputs), chunks=len(chunks)):
+            for ch in chunks:
+                p0 = time.perf_counter()
+                batch = self.packer.pack(ch, params)
+                t.pack_s += time.perf_counter() - p0
+                t.parts.append((batch, _device_dispatch(self.lowered, batch, self._jit_cache)))
+        real = sum(h.B for _, h in t.parts)
+        padded = sum(h.B_pad for _, h in t.parts)
+        if padded:
+            t.occupancy = real / padded
+            t.padded_rows = padded
+            t.layout_key = "+".join(f"B{h.B_pad}xBA{h.BA_pad}" for _, h in t.parts)
         return t
 
     def collect(self, ticket: "CheckTicket") -> list[T.CheckOutput]:

@@ -124,6 +124,41 @@ class TestChartStatic:
             assert tpu[knob] == want[knob], knob
         for knob in ("enabled", "failureThreshold", "probeBackoffBaseMs", "probeBackoffCapMs"):
             assert tpu["breaker"][knob] == want["breaker"][knob], knob
+        for knob in ("enabled", "capacity"):
+            assert tpu["flightRecorder"][knob] == want["flightRecorder"][knob], knob
+
+    def test_prometheus_scrape_annotations(self):
+        with open(os.path.join(CHART_DIR, "values.yaml"), encoding="utf-8") as f:
+            values = yaml.safe_load(f)
+        assert values["metrics"] == {"scrape": True, "path": "/_cerbos/metrics"}
+        with open(
+            os.path.join(CHART_DIR, "templates", "deployment.yaml"), encoding="utf-8"
+        ) as f:
+            tpl = f.read()
+        for ann in ("prometheus.io/scrape", "prometheus.io/path", "prometheus.io/port"):
+            assert ann in tpl, ann
+
+    def test_grafana_dashboard_parses_and_targets_registry_metrics(self):
+        import json
+        import re
+
+        path = os.path.join(os.path.dirname(CHART_DIR), "..", "grafana-dashboard.json")
+        with open(path, encoding="utf-8") as f:
+            dash = json.load(f)
+        assert dash["panels"], "dashboard has no panels"
+        exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
+        assert exprs
+        # every metric the dashboard queries must follow the naming scheme
+        for name in re.findall(r"cerbos_tpu_[a-z0-9_]+", " ".join(exprs)):
+            assert re.fullmatch(r"cerbos_tpu_[a-z0-9_]+", name)
+        joined = " ".join(exprs)
+        for needle in (
+            "cerbos_tpu_batch_stage_seconds_bucket",
+            "cerbos_tpu_batch_occupancy",
+            "cerbos_tpu_breaker_state",
+            "cerbos_tpu_breaker_transitions_total",
+        ):
+            assert needle in joined, needle
 
     def test_all_templates_present(self):
         tdir = os.path.join(CHART_DIR, "templates")

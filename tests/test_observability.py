@@ -1,0 +1,346 @@
+"""Observability layer: W3C trace-context propagation, span export, torn-read
+safety of the metrics registry, and the OTLP span/metrics exporters against an
+in-process HTTP capture server.
+"""
+
+import http.server
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from cerbos_tpu import observability as obs
+
+
+class _Capture(obs.SpanExporter):
+    def __init__(self):
+        self.spans = []
+
+    def export(self, span, duration_ms):
+        self.spans.append((span, duration_ms))
+
+
+class _exporter_swap:
+    """Temporarily install an exporter; always restores the previous one."""
+
+    def __init__(self, exporter):
+        self.exporter = exporter
+
+    def __enter__(self):
+        self._old = obs._exporter
+        obs.set_exporter(self.exporter)
+        return self.exporter
+
+    def __exit__(self, *exc):
+        obs.set_exporter(self._old)
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        ctx = obs.SpanContext(obs.new_trace_id(), obs.new_span_id())
+        assert obs.parse_traceparent(ctx.to_traceparent()) == ctx
+
+    def test_format(self):
+        ctx = obs.SpanContext("a" * 32, "b" * 16)
+        assert ctx.to_traceparent() == f"00-{'a' * 32}-{'b' * 16}-01"
+        assert obs.SpanContext("a" * 32, "b" * 16, sampled=False).to_traceparent().endswith("-00")
+
+    @pytest.mark.parametrize(
+        "header",
+        [
+            None,
+            "",
+            "garbage",
+            "00-short-id-01",
+            f"ff-{'a' * 32}-{'b' * 16}-01",  # version ff is forbidden
+            f"00-{'0' * 32}-{'b' * 16}-01",  # all-zero trace id
+            f"00-{'a' * 32}-{'0' * 16}-01",  # all-zero span id
+            f"00-{'A' * 31}Z-{'b' * 16}-01",  # non-hex
+        ],
+    )
+    def test_malformed_rejected(self, header):
+        assert obs.parse_traceparent(header) is None
+
+    def test_case_and_whitespace_tolerated(self):
+        got = obs.parse_traceparent(f"  00-{'A' * 32}-{'B' * 16}-01  ")
+        assert got == obs.SpanContext("a" * 32, "b" * 16)
+
+    def test_unsampled_flag(self):
+        got = obs.parse_traceparent(f"00-{'a' * 32}-{'b' * 16}-00")
+        assert got is not None and got.sampled is False
+
+
+class TestIds:
+    def test_proper_w3c_lengths(self):
+        """Ids are generated full-width, never zero-padded at export time."""
+        for _ in range(16):
+            assert re.fullmatch(r"[0-9a-f]{32}", obs.new_trace_id())
+            assert re.fullmatch(r"[0-9a-f]{16}", obs.new_span_id())
+        span = obs.Span(name="x", trace_id=obs.new_trace_id())
+        assert len(span.span_id) == 16
+
+
+class TestSpanParenting:
+    def test_parent_override_crosses_threads(self):
+        cap = _Capture()
+        with _exporter_swap(cap):
+            with obs.start_span("request") as req:
+                ctx = req.context
+            done = threading.Event()
+
+            def other_thread():
+                with obs.start_span("remote.child", parent=ctx):
+                    pass
+                done.set()
+
+            threading.Thread(target=other_thread).start()
+            assert done.wait(5)
+        child = next(s for s, _ in cap.spans if s.name == "remote.child")
+        assert child.trace_id == req.trace_id
+        assert child.parent_id == req.span_id
+
+    def test_links_attach(self):
+        cap = _Capture()
+        others = [obs.SpanContext(obs.new_trace_id(), obs.new_span_id()) for _ in range(3)]
+        with _exporter_swap(cap):
+            with obs.start_span("batch", links=others):
+                pass
+        span = cap.spans[0][0]
+        assert span.links == others
+
+    def test_thread_local_nesting_restored(self):
+        cap = _Capture()
+        with _exporter_swap(cap):
+            with obs.start_span("outer") as outer:
+                with obs.start_span("inner", parent=obs.SpanContext("c" * 32, "d" * 16)):
+                    pass
+                # the explicit-parent span must not leak as current
+                assert obs.current_span_context() == outer.context
+
+    def test_export_span_synthesizes_interval(self):
+        cap = _Capture()
+        parent = obs.SpanContext(obs.new_trace_id(), obs.new_span_id())
+        t0 = time.time_ns()
+        with _exporter_swap(cap):
+            obs.export_span("batch.device", parent, t0, 0.25, batch_id=7)
+        span, duration_ms = cap.spans[0]
+        assert span.trace_id == parent.trace_id and span.parent_id == parent.span_id
+        assert span.start_wall_ns == t0
+        assert duration_ms == pytest.approx(250.0)
+
+
+class TestTornReads:
+    def test_histogram_render_is_consistent_under_writes(self):
+        """A render racing observe() must never expose cumulative buckets
+        that don't sum to _count (the torn read the lock snapshot fixes)."""
+        h = obs.Histogram("t_torn_hist", "x", buckets=[0.1, 1.0, 10.0])
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                h.observe((i % 3) * 0.09 + 0.01)
+                i += 1
+
+        threads = [threading.Thread(target=writer, daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                counts, total, count = h.snapshot()
+                assert sum(counts) == count
+                lines = h.render()
+                inf = int(lines[-3].rsplit(" ", 1)[1])
+                n = int(lines[-1].rsplit(" ", 1)[1])
+                assert inf == n, lines  # +Inf cumulative == count
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(2)
+
+    def test_gauge_render_snapshot(self):
+        g = obs.Gauge("t_torn_gauge", "x", track_max=True)
+        g.set(3)
+        lines = g.render()
+        assert lines[1].endswith(" 3") and lines[3].endswith(" 3")
+
+    def test_percentile_interpolation(self):
+        h = obs.Histogram("t_pct", "x", buckets=[1.0, 2.0, 4.0])
+        for v in (0.5, 1.5, 1.5, 3.0):
+            h.observe(v)
+        assert 0 < h.percentile(0.5) <= 2.0
+        assert h.percentile(0.99) <= 4.0
+        assert obs.Histogram("t_pct_empty", "x").percentile(0.5) == 0.0
+
+
+class TestHistogramVec:
+    def test_renders_per_label_series(self):
+        vec = obs.HistogramVec("t_stage_seconds", "stage latency", label="stage", buckets=[0.1, 1.0])
+        vec.observe("pack", 0.05)
+        vec.observe("device", 0.5)
+        text = "\n".join(vec.render())
+        assert '# TYPE t_stage_seconds histogram' in text
+        assert 't_stage_seconds_bucket{stage="pack",le="0.1"} 1' in text
+        assert 't_stage_seconds_bucket{stage="device",le="+Inf"} 1' in text
+        assert 't_stage_seconds_count{stage="pack"} 1' in text
+
+    def test_series_per_label(self):
+        vec = obs.HistogramVec("t_sv", "x", label="stage")
+        vec.observe("pack", 0.5)
+        s = vec.series()
+        assert s["t_sv_pack_count"] == 1.0
+
+
+class TestRegistryTypes:
+    def test_conflicting_instrument_type_raises(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("t_conflict_total", "x")
+        with pytest.raises(TypeError):
+            reg.gauge("t_conflict_total", "x")
+        with pytest.raises(TypeError):
+            reg.histogram("t_conflict_total", "x")
+        reg.gauge("t_conflict_gauge", "x")
+        with pytest.raises(TypeError):
+            reg.counter_vec("t_conflict_gauge", "x")
+
+    def test_counter_upgrade_to_vec_preserves_total(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("t_up_total", "x")
+        c.inc(3)
+        vec = reg.counter_vec("t_up_total", "x", label="reason")
+        assert vec.value == 3.0
+        # existing readers holding counter() still see the summed total
+        assert reg.counter("t_up_total").value == 3.0
+
+    def test_instruments_walk(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("t_walk_a_total", "a")
+        reg.histogram_vec("t_walk_b_seconds", "b")
+        inst = reg.instruments()
+        assert set(inst) == {"t_walk_a_total", "t_walk_b_seconds"}
+
+
+class _Sink(http.server.BaseHTTPRequestHandler):
+    received = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        type(self).received.append((self.path, json.loads(body)))
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+
+@pytest.fixture()
+def sink():
+    _Sink.received = []
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Sink)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+
+
+class TestOTLPSpanExporter:
+    def test_payload_shape_ids_timestamps_links(self, sink):
+        exp = obs.OTLPSpanExporter(
+            f"http://127.0.0.1:{sink.server_port}", service_name="t-svc", flush_interval_s=60
+        )
+        link = obs.SpanContext(obs.new_trace_id(), obs.new_span_id())
+        span = obs.Span(name="batch.submit", trace_id=obs.new_trace_id(), links=[link])
+        span.set_attribute("batch_id", 3)
+        wall = span.start_wall_ns
+        exp.export(span, 12.0)
+        exp.close()
+        assert _Sink.received
+        path, body = _Sink.received[0]
+        assert path == "/v1/traces"
+        res = body["resourceSpans"][0]
+        attrs = {a["key"]: a["value"]["stringValue"] for a in res["resource"]["attributes"]}
+        assert attrs["service.name"] == "t-svc"
+        s = res["scopeSpans"][0]["spans"][0]
+        # ids export verbatim as full-width W3C hex — no zero padding
+        assert s["traceId"] == span.trace_id and len(s["traceId"]) == 32
+        assert s["spanId"] == span.span_id and len(s["spanId"]) == 16
+        # timestamps anchor on the span's wall-clock START, not flush time
+        assert int(s["startTimeUnixNano"]) == wall
+        assert int(s["endTimeUnixNano"]) == wall + 12_000_000
+        assert s["links"] == [{"traceId": link.trace_id, "spanId": link.span_id}]
+        assert {"key": "batch_id", "value": {"stringValue": "3"}} in s["attributes"]
+
+    def test_batching_splits_at_max_batch(self, sink):
+        exp = obs.OTLPSpanExporter(
+            f"http://127.0.0.1:{sink.server_port}", flush_interval_s=60, max_batch=4
+        )
+        for i in range(10):
+            exp.export(obs.Span(name=f"s{i}", trace_id=obs.new_trace_id()), 1.0)
+        exp.close()
+        sizes = [len(b["resourceSpans"][0]["scopeSpans"][0]["spans"]) for _, b in _Sink.received]
+        assert sum(sizes) == 10
+        assert max(sizes) <= 4
+
+    def test_bounded_buffer_drops_oldest(self):
+        # endpoint points nowhere; nothing ever flushes, so the buffer bounds
+        exp = obs.OTLPSpanExporter("http://127.0.0.1:1", flush_interval_s=3600, max_batch=2)
+        try:
+            for i in range(50):
+                exp.export(obs.Span(name=f"s{i}", trace_id="a" * 32), 1.0)
+            with exp._lock:
+                names = [s["name"] for s in exp._buf]
+            assert len(names) <= exp.max_batch * 4
+            assert names[-1] == "s49"  # newest kept; oldest dropped
+        finally:
+            exp._stop.set()
+
+    def test_collector_down_drops_without_blocking(self):
+        exp = obs.OTLPSpanExporter("http://127.0.0.1:1", flush_interval_s=3600)
+        try:
+            exp.export(obs.Span(name="x", trace_id="a" * 32), 1.0)
+            t0 = time.perf_counter()
+            exp.flush()  # connection refused: drop, don't block or raise
+            assert time.perf_counter() - t0 < 5.0
+            with exp._lock:
+                assert exp._buf == []
+        finally:
+            exp._stop.set()
+
+
+class TestOTLPMetricsExporter:
+    def test_payload_shape(self, sink):
+        exp = obs.OTLPMetricsExporter(
+            f"http://127.0.0.1:{sink.server_port}", service_name="t-svc", interval_s=3600
+        )
+        exp.add_source(lambda: {"cerbos_tpu_test_gauge": 4.5})
+        exp.close()
+        assert _Sink.received
+        path, body = _Sink.received[0]
+        assert path == "/v1/metrics"
+        m = body["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]
+        assert m["name"] == "cerbos_tpu_test_gauge"
+        assert m["gauge"]["dataPoints"][0]["asDouble"] == 4.5
+
+    def test_collector_down_drops(self):
+        exp = obs.OTLPMetricsExporter("http://127.0.0.1:1", interval_s=3600)
+        exp.add_source(lambda: {"x": 1.0})
+        t0 = time.perf_counter()
+        exp.close()  # flush against a dead collector must not raise or hang
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_broken_source_skipped(self, sink):
+        exp = obs.OTLPMetricsExporter(f"http://127.0.0.1:{sink.server_port}", interval_s=3600)
+        exp.add_source(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        exp.add_source(lambda: {"ok_metric": 1.0})
+        exp.close()
+        names = {
+            m["name"]
+            for _, b in _Sink.received
+            for m in b["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+        }
+        assert names == {"ok_metric"}
