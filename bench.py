@@ -228,6 +228,21 @@ def _stage_percentiles() -> dict:
     return stages
 
 
+def _compile_economy() -> dict:
+    """Compile-side economics for the perf artifact: how much XLA work the
+    run paid and how well the jit cache amortized it — the figures that
+    make compile amortization diffable across PRs (BENCH_*.json)."""
+    from cerbos_tpu.tpu.compilestats import stats as compile_stats
+
+    snap = compile_stats().snapshot()
+    return {
+        "compiles": snap["compiles"],
+        "compile_seconds_total": snap["compile_seconds_total"],
+        "cache_hits": snap["cache_hits"],
+        "layout_cardinality": snap["layout_cardinality"],
+    }
+
+
 def served_main(smoke: bool, json_path: str = "") -> int:
     """--served: throughput through the real serving path (BatchingEvaluator).
 
@@ -304,6 +319,7 @@ def served_main(smoke: bool, json_path: str = "") -> int:
         "stages": _stage_percentiles(),
         "occupancy": batcher.m_occupancy.value,
         "padding_waste_rows": batcher.m_padding_waste.value,
+        "compile": _compile_economy(),
         "probe": tpu_probe.summarize(evidence),
     }
     print(

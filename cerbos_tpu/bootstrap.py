@@ -7,6 +7,7 @@ manager (subscribed to store events) → engine → aux data.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -20,6 +21,8 @@ from .ruletable.manager import RuleTableManager
 from .schema import SchemaManager
 from .server.service import CerbosService, ServiceLimits
 from .storage import new_store
+
+_log = logging.getLogger("cerbos_tpu.bootstrap")
 
 
 @dataclass
@@ -128,10 +131,22 @@ def initialize(
         enabled=bool(flight_conf.get("enabled", True)),
     )
     _flight.install_sigquit_dump()
+    # on-demand device profiling endpoint (off unless explicitly enabled)
+    prof_conf = tpu_conf.get("profiler", {}) or {}
+    from .tpu import profiler as _profiler
+
+    _profiler.configure(
+        enabled=bool(prof_conf.get("enabled", False)),
+        dir=str(prof_conf.get("dir", "") or ""),
+        max_artifacts=int(prof_conf.get("maxArtifacts", 4)),
+        max_seconds=float(prof_conf.get("maxSeconds", 30)),
+    )
+
     tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
     tpu_evaluator = None
     dispatch_evaluator = None
     batcher = None
+    health = None
     if tpu_enabled:
         if prebuilt is not None and prebuilt.tpu_evaluator is not None:
             # adopt the pre-lowered evaluator (COW-shared across forked
@@ -177,6 +192,46 @@ def initialize(
                 quarantine_max=int(tpu_conf.get("quarantineMax", 128)),
             )
             dispatch_evaluator = batcher
+
+    # readiness (split from liveness) + the compile-economy warmup driver:
+    # /_cerbos/ready and the gRPC health service withhold traffic until the
+    # dominant device layouts are compiled, then report degraded-but-live
+    # whenever the breaker routes around the device
+    from .engine import readiness as _readiness
+
+    rstate = _readiness.state()
+    rstate.bind_health((lambda: health.state) if health is not None else None)
+    warm_conf = tpu_conf.get("warmup", {}) or {}
+    if tpu_enabled and tpu_evaluator is not None and bool(warm_conf.get("enabled", False)):
+        from .tpu.warmup import WarmupDriver
+
+        driver = WarmupDriver(
+            tpu_evaluator,
+            batch_sizes=[int(s) for s in (warm_conf.get("batchSizes") or [16, 64])],
+            corpus=warm_conf.get("synthetic") or None,
+            max_kinds=int(warm_conf.get("maxKinds", 8)),
+            timeout_s=float(warm_conf.get("timeoutSeconds", 120)),
+            readiness=rstate,
+        )
+        rstate.begin_warmup(expected=driver.expected)
+        if bool(warm_conf.get("background", True)):
+            driver.start()
+        else:
+            driver.run()
+    else:
+        rstate.mark_ready()
+
+    if tpu_evaluator is not None and getattr(tpu_evaluator, "use_jax", False):
+        from .tpu import jitcache as _jitcache
+
+        cache_status = _jitcache.status()
+        _log.info(
+            "xla persistent cache: enabled=%s dir=%s entries=%s warm=%s",
+            cache_status["enabled"],
+            cache_status["dir"],
+            cache_status["entries"],
+            cache_status["warm_at_enable"],
+        )
 
     engine = Engine(
         manager.rule_table,

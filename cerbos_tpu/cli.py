@@ -130,7 +130,15 @@ def cmd_server(args: argparse.Namespace) -> int:
     wire_metrics(core)
     server = _build_server(core, config)
     server.start()
-    print(f"cerbos-tpu serving: http={server.http_port} grpc={server.grpc_port}", flush=True)
+    from .tpu import jitcache
+
+    cache_status = jitcache.status()
+    xla_cache = cache_status["dir"] if cache_status["enabled"] else "off"
+    print(
+        f"cerbos-tpu serving: http={server.http_port} grpc={server.grpc_port} "
+        f"xla_cache={xla_cache}",
+        flush=True,
+    )
     try:
         server.wait()
     except KeyboardInterrupt:

@@ -58,6 +58,27 @@ DEFAULTS: dict[str, Any] = {
             # bounded ring of recent device-batch records + fault events,
             # served at /_cerbos/debug/flight and dumped on SIGQUIT
             "flightRecorder": {"enabled": True, "capacity": 256},
+            # bootstrap warmup: pre-compile the dominant device layouts
+            # before /_cerbos/ready opens the gates (docs/OBSERVABILITY.md,
+            # "Compile economy"). synthetic: optional explicit corpus of
+            # {kind, actions, roles} entries; empty derives one from the
+            # loaded rule table
+            "warmup": {
+                "enabled": False,
+                "batchSizes": [16, 64],
+                "background": True,
+                "timeoutSeconds": 120,
+                "maxKinds": 8,
+                "synthetic": [],
+            },
+            # operator-gated /_cerbos/debug/profile?seconds=N endpoint:
+            # captures a jax.profiler.trace into a bounded directory
+            "profiler": {
+                "enabled": False,
+                "dir": "",
+                "maxArtifacts": 4,
+                "maxSeconds": 30,
+            },
         },
     },
     "storage": {"driver": "disk", "disk": {"directory": "policies", "watchForChanges": False}},

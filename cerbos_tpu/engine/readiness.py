@@ -1,0 +1,136 @@
+"""Readiness split from liveness.
+
+``/_cerbos/health`` answers "is the process alive" and must stay green the
+moment the listeners bind. But a replica whose dominant device layouts are
+not compiled yet will hand its first unlucky callers a multi-second XLA
+compile — so ``/_cerbos/ready`` (HTTP and the gRPC health service) answers
+the different question "is it safe to route traffic here", reporting
+``{status, compiled_layouts, expected}``:
+
+- ``warming``  — the warmup driver is still pre-compiling; NOT serving
+  (HTTP 503 / gRPC NOT_SERVING) so load balancers hold traffic back;
+- ``ready``    — all expected layouts compiled (or no warmup configured);
+- ``degraded`` — warm, but the device circuit breaker is open and requests
+  are riding the CPU oracle. Still SERVING: degraded-but-live beats a
+  restart loop, and the breaker state is exported for alerting.
+
+One process-global instance (the flight-recorder pattern): bootstrap drives
+the transitions, both servers read it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..observability import metrics
+
+_STATUS_CODE = {"warming": 0.0, "ready": 1.0, "degraded": 2.0}
+
+
+class ReadinessState:
+    """Thread-safe readiness snapshot: warming → ready (→ degraded while the
+    breaker is open). ``clock`` is injectable for tests."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        reg = metrics()
+        self.m_state = reg.gauge(
+            "cerbos_tpu_readiness_state",
+            "0 warming (not serving), 1 ready, 2 degraded (breaker open, oracle serving)",
+        )
+        self.m_expected = reg.gauge(
+            "cerbos_tpu_warmup_expected_layouts",
+            "Device layouts the warmup driver intends to pre-compile",
+        )
+        self.m_compiled = reg.gauge(
+            "cerbos_tpu_warmup_compiled_layouts",
+            "Device layouts the warmup driver has pre-compiled so far",
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        # a server with no warmup configured is born ready: readiness must
+        # never gate deployments that opted out of pre-compilation
+        self._ready = True
+        self._expected = 0
+        self._compiled = 0
+        self._warmup_error: Optional[str] = None
+        self._warmed_at: Optional[float] = None
+        self._health: Optional[Callable[[], str]] = None
+        self.m_state.set(_STATUS_CODE["ready"])
+
+    # -- transitions (driven by bootstrap / the warmup driver) -------------
+
+    def begin_warmup(self, expected: int) -> None:
+        with self._lock:
+            self._ready = False
+            self._expected = int(expected)
+            self._compiled = 0
+            self._warmup_error = None
+            self._warmed_at = None
+        self.m_expected.set(float(expected))
+        self.m_compiled.set(0.0)
+        self.m_state.set(_STATUS_CODE["warming"])
+
+    def layout_compiled(self) -> None:
+        with self._lock:
+            self._compiled += 1
+            compiled = self._compiled
+        self.m_compiled.set(float(compiled))
+
+    def mark_ready(self, error: Optional[str] = None) -> None:
+        """Warmup finished — or failed: a failed warmup still opens the
+        gates (with the error recorded), because never-ready is a worse
+        failure mode than cold-compiling under traffic."""
+        with self._lock:
+            self._ready = True
+            self._warmup_error = error
+            self._warmed_at = self._clock()
+
+    def bind_health(self, provider: Optional[Callable[[], str]]) -> None:
+        """Wire the device breaker's state in: an open breaker after warmup
+        reports ``degraded`` (still serving). ``provider`` returns the
+        breaker state string (``closed`` / ``open`` / ``half_open``)."""
+        self._health = provider
+
+    # -- reads (servers, probes, tests) ------------------------------------
+
+    def status(self) -> str:
+        with self._lock:
+            ready = self._ready
+        st = "ready"
+        if not ready:
+            st = "warming"
+        else:
+            provider = self._health
+            if provider is not None:
+                try:
+                    if provider() == "open":
+                        st = "degraded"
+                except Exception:
+                    pass
+        self.m_state.set(_STATUS_CODE[st])
+        return st
+
+    def serving(self) -> bool:
+        """Gate decision: warming withholds traffic; degraded is live."""
+        return self.status() != "warming"
+
+    def snapshot(self) -> dict:
+        st = self.status()
+        with self._lock:
+            out = {
+                "status": st,
+                "compiled_layouts": self._compiled,
+                "expected": self._expected,
+            }
+            if self._warmup_error:
+                out["warmup_error"] = self._warmup_error
+        return out
+
+
+_state = ReadinessState()
+
+
+def state() -> ReadinessState:
+    return _state

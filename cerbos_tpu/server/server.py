@@ -25,6 +25,7 @@ from aiohttp import web
 from ..engine import types as T
 from ..engine.batcher import DeadlineExceeded
 from ..engine.flight import recorder as flight_recorder
+from ..engine.readiness import state as readiness_state
 from ..observability import parse_traceparent
 from . import convert, wire_validate
 from .service import CerbosService, RequestLimitExceeded
@@ -421,6 +422,35 @@ def _grpc_handlers(svc: CerbosService):
     return grpc.method_handlers_generic_handler("cerbos.svc.v1.CerbosService", _grpc_rpcs(svc))
 
 
+# -- grpc.health.v1 ---------------------------------------------------------
+#
+# The standard gRPC health protocol, hand-encoded: the container does not
+# ship grpcio-health-checking, and the two messages involved are trivial.
+# HealthCheckRequest{string service = 1} is ignored (one readiness domain
+# covers the whole PDP); HealthCheckResponse{ServingStatus status = 1} is a
+# single varint field: SERVING=1, NOT_SERVING=2.
+
+_HEALTH_SERVING = b"\x08\x01"
+_HEALTH_NOT_SERVING = b"\x08\x02"
+
+
+def _health_rpcs() -> dict:
+    def check(req: bytes, ctx) -> bytes:
+        return _HEALTH_SERVING if readiness_state().serving() else _HEALTH_NOT_SERVING
+
+    return {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            check,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        ),
+    }
+
+
+def _health_handler():
+    return grpc.method_handlers_generic_handler("grpc.health.v1.Health", _health_rpcs())
+
+
 def _plan_from_json(svc: CerbosService, body: dict, aux: Optional[T.AuxData]) -> tuple[dict, str]:
     from ..plan.types import PlanInput
 
@@ -500,7 +530,7 @@ class Server:
             futures.ThreadPoolExecutor(max_workers=self.config.max_workers),
             options=self._grpc_options(),
         )
-        server.add_generic_rpc_handlers((_grpc_handlers(self.svc),))
+        server.add_generic_rpc_handlers((_grpc_handlers(self.svc), _health_handler()))
         if self.admin_service is not None:
             handler = self.admin_service.grpc_handler()
             if handler is not None:
@@ -520,7 +550,11 @@ class Server:
         the sync server's dominant per-call overhead on small hosts."""
         server = grpc.aio.server(options=self._grpc_options())
         inline = self.config.direct_dispatch
-        handlers = [aio_generic_handler("cerbos.svc.v1.CerbosService", _grpc_rpcs(self.svc), inline)]
+        handlers = [
+            aio_generic_handler("cerbos.svc.v1.CerbosService", _grpc_rpcs(self.svc), inline),
+            # health checks are tiny and non-blocking: always inline
+            aio_generic_handler("grpc.health.v1.Health", _health_rpcs(), inline=True),
+        ]
         if self.admin_service is not None:
             handlers.append(
                 aio_generic_handler(
@@ -575,8 +609,10 @@ class Server:
         # legacy alias kept for clients that used the pre-parity route
         app.router.add_post("/api/x/check_resource_batch", self._h_check_resource_batch)
         app.router.add_get("/_cerbos/health", self._h_health)
+        app.router.add_get("/_cerbos/ready", self._h_ready)
         app.router.add_get("/_cerbos/metrics", self._h_metrics)
         app.router.add_get("/_cerbos/debug/flight", self._h_flight)
+        app.router.add_get("/_cerbos/debug/profile", self._h_profile)
         app.router.add_get("/api/server_info", self._h_server_info)
         # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
         app.router.add_get("/schema/swagger.json", self._h_swagger)
@@ -590,10 +626,53 @@ class Server:
     async def _h_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "SERVING"})
 
+    async def _h_ready(self, request: web.Request) -> web.Response:
+        """Readiness, split from liveness: 503 while the warmup driver is
+        still pre-compiling device layouts, 200 once warm — including
+        ``degraded`` (breaker open, oracle serving), which is live."""
+        snap = readiness_state().snapshot()
+        return web.json_response(snap, status=200 if snap["status"] != "warming" else 503)
+
     async def _h_flight(self, request: web.Request) -> web.Response:
         """Flight-recorder dump: the last N device batches (trace ids, stage
-        timings, occupancy, outcome) plus breaker/bisect/quarantine events."""
-        return web.json_response(flight_recorder().dump(), dumps=lambda o: json.dumps(o, default=str))
+        timings, occupancy, outcome) plus breaker/bisect/quarantine events.
+        The persistent-XLA-cache status rides a response header so one curl
+        answers both "what just happened" and "is the compile cache live"."""
+        resp = web.json_response(
+            flight_recorder().dump(), dumps=lambda o: json.dumps(o, default=str)
+        )
+        try:
+            from ..tpu import jitcache
+
+            resp.headers["X-Cerbos-Jitcache"] = json.dumps(jitcache.status(), default=str)
+        except Exception:  # pragma: no cover - status must never break the dump
+            pass
+        return resp
+
+    async def _h_profile(self, request: web.Request) -> web.Response:
+        """Operator-gated jax.profiler capture; see tpu/profiler.py."""
+        from ..tpu import profiler
+
+        if not profiler.enabled():
+            return web.json_response(
+                {"error": "profiling disabled (set engine.tpu.profiler.enabled)"}, status=403
+            )
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.json_response({"error": "seconds must be a number"}, status=400)
+        loop = asyncio.get_running_loop()
+        try:
+            artifact = await loop.run_in_executor(None, profiler.capture, seconds)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except profiler.ProfilerBusy as e:
+            return web.json_response({"error": str(e)}, status=409)
+        except profiler.ProfilerDisabled as e:
+            return web.json_response({"error": str(e)}, status=403)
+        except profiler.ProfilerUnavailable as e:
+            return web.json_response({"error": str(e)}, status=501)
+        return web.json_response(artifact)
 
     async def _h_swagger(self, request: web.Request) -> web.Response:
         from .openapi import build_swagger

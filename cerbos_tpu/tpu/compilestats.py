@@ -1,0 +1,299 @@
+"""Compile-economy telemetry: the other half of the device serving cost.
+
+The request path is lit end to end (spans, stage histograms, the flight
+recorder), but XLA compilation — ~35 s cold per distinct trace, the single
+largest latency event a replica can produce — was dark. This module wraps
+every ``jit_cache`` population site in :mod:`evaluator` plus the persistent
+cache in :mod:`jitcache` and answers, per process:
+
+- how many compiles happened, how long each took, and whether the
+  persistent cache absorbed them (``cerbos_tpu_xla_compiles_total{source}``,
+  ``cerbos_tpu_xla_compile_seconds``);
+- how often the live jit cache hit vs missed
+  (``cerbos_tpu_jit_cache_{hits,misses}_total``);
+- how many distinct compiled layouts exist
+  (``cerbos_tpu_xla_layout_cardinality``) — the figure that bounds both
+  device program memory and worst-case warmup time;
+- device memory from ``device.memory_stats()`` when a backend exposes it;
+- whether the layout keyspace is CHURNING: the recompile-storm detector
+  fires when >= N distinct layouts compile within W seconds, meaning the
+  shape-bucket ladder or variant budget no longer amortizes and the replica
+  is spending its time in XLA instead of serving.
+
+Everything is process-global (like the metrics registry it feeds) so the
+serving batcher, the pipelined path, and bench all account into one place.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..engine.flight import recorder as flight_recorder
+from ..observability import metrics
+
+_log = logging.getLogger("cerbos_tpu.compilestats")
+
+# compile latencies span four orders of magnitude: sub-second persistent
+# cache loads up to multi-minute cold TPU compiles
+_COMPILE_BUCKETS = [0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0]
+
+STORM_THRESHOLD = 8
+STORM_WINDOW_S = 120.0
+
+
+class RecompileStormDetector:
+    """Sliding-window detector over compile events.
+
+    A healthy replica compiles each dominant layout once and then serves
+    from cache; a storm (>= ``threshold`` DISTINCT layout keys compiled
+    within ``window_s`` seconds) means traffic shapes are defeating the
+    pow2 bucket ladder / variant budget. Fires once per excursion: after
+    tripping, it stays quiet until the distinct count falls back below the
+    threshold, so a sustained storm is one event, not one per compile.
+
+    ``clock`` is injectable for deterministic tests (same pattern as
+    ``engine.health.DeviceHealth``).
+    """
+
+    def __init__(
+        self,
+        threshold: int = STORM_THRESHOLD,
+        window_s: float = STORM_WINDOW_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._events: deque[tuple[float, Any]] = deque()
+        self._lock = threading.Lock()
+        self._in_storm = False
+        self.storms = 0
+
+    def observe(self, layout_key: Any) -> Optional[int]:
+        """Record one compile; returns the distinct-layout count when this
+        observation trips a NEW storm, else None."""
+        now = self._clock()
+        with self._lock:
+            self._events.append((now, layout_key))
+            cutoff = now - self.window_s
+            while self._events and self._events[0][0] < cutoff:
+                self._events.popleft()
+            distinct = len({k for _, k in self._events})
+            if distinct < self.threshold:
+                self._in_storm = False
+                return None
+            if self._in_storm:
+                return None
+            self._in_storm = True
+            self.storms += 1
+            return distinct
+
+
+class CompileStats:
+    """Process-wide compile accounting feeding the shared metrics registry."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        storm_threshold: int = STORM_THRESHOLD,
+        storm_window_s: float = STORM_WINDOW_S,
+    ):
+        reg = metrics()
+        self.m_compiles = reg.counter_vec(
+            "cerbos_tpu_xla_compiles_total",
+            "XLA compilations by source: fresh (XLA ran) or persistent (loaded from the on-disk cache)",
+            label="source",
+        )
+        self.m_compile_seconds = reg.histogram(
+            "cerbos_tpu_xla_compile_seconds",
+            "Wall time of each XLA compile (first invocation of a new jit trace)",
+            buckets=_COMPILE_BUCKETS,
+        )
+        self.m_hits = reg.counter(
+            "cerbos_tpu_jit_cache_hits_total",
+            "Device dispatches served by an already-compiled jit trace",
+        )
+        self.m_misses = reg.counter(
+            "cerbos_tpu_jit_cache_misses_total",
+            "Device dispatches that had to build (and compile) a new jit trace",
+        )
+        self.m_cardinality = reg.gauge(
+            "cerbos_tpu_xla_layout_cardinality",
+            "Distinct compiled device layouts (shape bucket x variant x column layout) this process",
+        )
+        self.m_storms = reg.counter(
+            "cerbos_tpu_recompile_storms_total",
+            "Recompile storms: sliding-window excursions of distinct-layout compiles",
+        )
+        self.m_variant_fallbacks = reg.counter(
+            "cerbos_tpu_variant_budget_fallbacks_total",
+            "Batches forced onto the full variant because the distinct-variant budget was exhausted",
+        )
+        self.m_mem_in_use = reg.gauge(
+            "cerbos_tpu_device_memory_bytes_in_use",
+            "Device memory in use (device.memory_stats, 0 when the backend reports none)",
+        )
+        self.m_mem_limit = reg.gauge(
+            "cerbos_tpu_device_memory_bytes_limit",
+            "Device memory capacity (device.memory_stats, 0 when the backend reports none)",
+        )
+        self.m_mem_peak = reg.gauge(
+            "cerbos_tpu_device_memory_peak_bytes_in_use",
+            "Peak device memory in use (device.memory_stats, 0 when the backend reports none)",
+        )
+        self.detector = RecompileStormDetector(
+            threshold=storm_threshold, window_s=storm_window_s, clock=clock
+        )
+        self._lock = threading.Lock()
+        self._layouts: set[Any] = set()
+        self._per_layout: dict[str, int] = {}
+        self._compiles = 0
+        self._compile_seconds = 0.0
+        self._persistent = 0
+        self._hits = 0
+        self._misses = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def record_compile(
+        self, layout_key: str, seconds: float, source: str = "fresh", trace_key: Any = None
+    ) -> None:
+        """One compile completed. ``layout_key`` is the display shape
+        signature (``B64xBA128``-style); ``trace_key`` is the exact jit-cache
+        key, so cardinality/storm detection see variant and column-layout
+        churn that shares a shape bucket."""
+        tk = trace_key if trace_key is not None else layout_key
+        self.m_compiles.inc(source)
+        self.m_compile_seconds.observe(seconds)
+        with self._lock:
+            self._compiles += 1
+            self._compile_seconds += seconds
+            if source == "persistent":
+                self._persistent += 1
+            self._layouts.add(tk)
+            self._per_layout[layout_key] = self._per_layout.get(layout_key, 0) + 1
+            card = len(self._layouts)
+        self.m_cardinality.set(card)
+        distinct = self.detector.observe(tk)
+        if distinct is not None:
+            self.m_storms.inc()
+            _log.warning(
+                "recompile storm: %d distinct device layouts compiled within %.0fs "
+                "(threshold %d, last layout %s) — shape buckets or variant budget "
+                "are churning faster than the cache amortizes",
+                distinct,
+                self.detector.window_s,
+                self.detector.threshold,
+                layout_key,
+            )
+            flight_recorder().record_event(
+                "recompile_storm",
+                distinct=distinct,
+                window_s=self.detector.window_s,
+                threshold=self.detector.threshold,
+                layout_key=layout_key,
+            )
+        self.refresh_device_memory()
+
+    def record_hit(self) -> None:
+        self.m_hits.inc()
+        with self._lock:
+            self._hits += 1
+
+    def record_miss(self) -> None:
+        self.m_misses.inc()
+        with self._lock:
+            self._misses += 1
+
+    def record_variant_fallback(self) -> None:
+        self.m_variant_fallbacks.inc()
+
+    def refresh_device_memory(self) -> None:
+        """Update the device memory gauges when a backend reports them.
+
+        Reads ``sys.modules`` instead of importing: telemetry must never be
+        the thing that initializes a jax backend."""
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return
+        try:
+            devs = jax.devices()
+        except Exception:
+            return
+        if not devs:
+            return
+        try:
+            stats = devs[0].memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return
+        if "bytes_in_use" in stats:
+            self.m_mem_in_use.set(float(stats["bytes_in_use"]))
+        if "bytes_limit" in stats:
+            self.m_mem_limit.set(float(stats["bytes_limit"]))
+        if "peak_bytes_in_use" in stats:
+            self.m_mem_peak.set(float(stats["peak_bytes_in_use"]))
+
+    # -- reading -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Machine-readable compile economics (bench artifact, jitcache
+        status, debug surfaces)."""
+        with self._lock:
+            return {
+                "compiles": self._compiles,
+                "compile_seconds_total": round(self._compile_seconds, 6),
+                "persistent_loads": self._persistent,
+                "cache_hits": self._hits,
+                "cache_misses": self._misses,
+                "layout_cardinality": len(self._layouts),
+                "storms": self.detector.storms,
+                "per_layout_compiles": dict(self._per_layout),
+            }
+
+
+_stats = CompileStats()
+
+
+def stats() -> CompileStats:
+    return _stats
+
+
+def configure(storm_threshold: Optional[int] = None, storm_window_s: Optional[float] = None) -> CompileStats:
+    """Re-bound the global detector in place (bootstrap), preserving the
+    instance every instrumented module already holds."""
+    det = _stats.detector
+    if storm_threshold is not None:
+        det.threshold = int(storm_threshold)
+    if storm_window_s is not None:
+        det.window_s = float(storm_window_s)
+    return _stats
+
+
+def timed_first_call(layout_key: str, fn: Callable[..., Any], kwargs: dict, trace_key: Any = None):
+    """Invoke a FRESHLY BUILT jit function, timing its first call.
+
+    ``jax.jit`` defers trace+compile to the first invocation (dispatch of
+    the compiled program stays async, so the measured wall time is the
+    compile, not the device execution). The persistent-cache entry count
+    before/after classifies the source: a compile that writes no new entry
+    while the cache is enabled was loaded from disk."""
+    from . import jitcache
+
+    before = jitcache.entry_count()
+    t0 = time.perf_counter()
+    out = fn(**kwargs)
+    dt = time.perf_counter() - t0
+    source = "fresh"
+    if before is not None:
+        after = jitcache.entry_count()
+        if after is not None and after <= before:
+            source = "persistent"
+    _stats.record_compile(layout_key, dt, source=source, trace_key=trace_key)
+    return out

@@ -27,6 +27,7 @@ from ..engine import types as T
 from ..observability import start_span
 from ..ruletable.check import EvalContext, build_request_messages, check_input
 from ..ruletable.table import RuleTable
+from . import compilestats
 from .condcompile import Refs
 from .lowering import (
     EFFECT_ALLOW_CODE,
@@ -464,6 +465,7 @@ def _select_variant(lt: LoweredTable, batch: PackedBatch, jit_cache: dict):
         and variant_key not in seen_variants
         and len(seen_variants) >= 32
     ):
+        compilestats.stats().record_variant_fallback()
         return full_variant
     seen_variants.add(variant_key)
     return variant_key
@@ -601,7 +603,14 @@ def _device_eval(
         vt = variant_key  # bind the static variant into the trace
         fn = jax.jit(lambda **kw: _compute(jnp, compiler, K, J, D, variant=vt, **kw))
         jit_cache[key] = fn
-    final, role_results, win_j, sat_arr = fn(**padded)
+        compilestats.stats().record_miss()
+        # the first call runs trace + XLA compile synchronously
+        final, role_results, win_j, sat_arr = compilestats.timed_first_call(
+            f"B{B_pad}xBA{BA_pad}", fn, padded, trace_key=key
+        )
+    else:
+        compilestats.stats().record_hit()
+        final, role_results, win_j, sat_arr = fn(**padded)
     return (
         np.asarray(final)[:BA],
         np.asarray(role_results)[:BA],
@@ -940,7 +949,16 @@ def _device_dispatch(lt: LoweredTable, batch: PackedBatch, jit_cache: dict) -> _
 
         fn = jax.jit(run)
         jit_cache[key] = fn
-    out = fn(**stacked)
+        compilestats.stats().record_miss()
+        # jit defers trace+compile to the first call: time it there so the
+        # compile histogram sees the real XLA cost (dispatch of the compiled
+        # program stays async and costs microseconds by comparison)
+        out = compilestats.timed_first_call(
+            f"B{B_pad}xBA{BA_pad}", fn, stacked, trace_key=key
+        )
+    else:
+        compilestats.stats().record_hit()
+        out = fn(**stacked)
     try:
         out.copy_to_host_async()  # start the (single) fetch immediately
     except (AttributeError, RuntimeError):

@@ -18,7 +18,11 @@ from __future__ import annotations
 import os
 import pathlib
 
-_enabled = False
+# False until enable() runs; afterwards the cache directory string (ours or
+# an embedding application's own) — enable()/status() report it either way
+_enabled: "str | bool" = False
+_external = False  # directory was configured by the embedding app, not us
+_entries_at_enable: "int | None" = None
 
 
 def _candidate_dirs():
@@ -37,11 +41,13 @@ def _candidate_dirs():
 def enable() -> str | None:
     """Idempotently point jax at a persistent compilation cache directory.
 
-    Returns the directory used, or None if configuration failed (old jax,
-    read-only filesystem everywhere). Safe to call before or after jax
-    backends initialize — the cache config is read at compile time.
+    Returns the directory in use — ours, or an embedding application's own
+    preconfigured one — or None if configuration failed (old jax, read-only
+    filesystem everywhere). Repeat calls return the same directory. Safe to
+    call before or after jax backends initialize — the cache config is read
+    at compile time.
     """
-    global _enabled
+    global _enabled, _external, _entries_at_enable
     if _enabled:
         return _enabled if isinstance(_enabled, str) else None
     try:
@@ -49,11 +55,15 @@ def enable() -> str | None:
     except Exception:  # pragma: no cover - jax is a hard dep in practice
         return None
     # respect an embedding application's own cache configuration: only
-    # install ours when nothing is configured yet
+    # install ours when nothing is configured yet (but still report theirs,
+    # so repeat calls and status() see the directory actually in use)
     try:
-        if getattr(jax.config, "jax_compilation_cache_dir", None):
-            _enabled = True
-            return None
+        existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if existing:
+            _enabled = str(existing)
+            _external = True
+            _entries_at_enable = entry_count()
+            return _enabled
     except Exception:
         pass
     for cand in _candidate_dirs():
@@ -69,6 +79,8 @@ def enable() -> str | None:
         except Exception:
             return None
         _enabled = str(cand)
+        _external = False
+        _entries_at_enable = entry_count()
         # cache every entry: the default thresholds skip "fast" compiles,
         # but on this serving path even a 2 s compile is worth persisting.
         # These knobs don't exist on older jax — the cache dir alone must
@@ -80,3 +92,47 @@ def enable() -> str | None:
             pass
         return _enabled
     return None
+
+
+def directory() -> str | None:
+    """The persistent cache directory in use, or None when not enabled."""
+    return _enabled if isinstance(_enabled, str) else None
+
+
+def entry_count() -> int | None:
+    """Files currently in the cache directory (None when disabled or
+    unreadable). Cheap relative to any compile, and the before/after delta
+    is what classifies a compile as fresh vs persistent-loaded."""
+    d = directory()
+    if not d:
+        return None
+    try:
+        return sum(1 for p in pathlib.Path(d).iterdir() if p.is_file())
+    except OSError:
+        return None
+
+
+def status() -> dict:
+    """Cache evidence for the bootstrap log line, ``/_cerbos/debug/flight``,
+    and operators asking "did the restart actually skip the compile?":
+    the directory, whether it held entries when we enabled it (a warm
+    restart), and how many compiles this process loaded from it."""
+    entries = entry_count()
+    persistent_loads = 0
+    try:
+        from .compilestats import stats as _compile_stats
+
+        persistent_loads = _compile_stats().snapshot()["persistent_loads"]
+    except Exception:  # pragma: no cover - circular-import belt and braces
+        pass
+    return {
+        "enabled": bool(_enabled),
+        "dir": directory(),
+        "external": _external,
+        "entries": entries,
+        "entries_at_enable": _entries_at_enable,
+        # hit evidence: pre-existing entries mean this process can load
+        # instead of compile; persistent_loads counts the times it did
+        "warm_at_enable": bool(_entries_at_enable),
+        "persistent_loads": persistent_loads,
+    }

@@ -1,0 +1,125 @@
+"""Operator-gated on-demand device profiling.
+
+``GET /_cerbos/debug/profile?seconds=N`` captures a ``jax.profiler.trace``
+for N seconds of whatever the serving path is doing and returns the
+artifact directory — the tool for "the batch stage histogram says device
+time doubled, WHAT is the device doing". Gated off by default
+(``engine.tpu.profiler.enabled``): a trace capture perturbs the device and
+writes files, so it must be an explicit operator decision.
+
+Artifacts land under a bounded directory: each capture gets its own
+timestamped subdirectory and the oldest captures beyond ``maxArtifacts``
+are pruned, so a flapping operator cannot fill the disk.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Optional
+
+_log = logging.getLogger("cerbos_tpu.profiler")
+
+
+class ProfilerDisabled(RuntimeError):
+    """Profiling is not enabled in the configuration."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight (one at a time: overlapping device
+    traces corrupt each other)."""
+
+
+class ProfilerUnavailable(RuntimeError):
+    """The jax profiler cannot run in this process (no jax, old jax)."""
+
+
+_lock = threading.Lock()
+_enabled = False
+_dir = ""
+_max_artifacts = 4
+_max_seconds = 30.0
+_active = False
+_seq = 0
+
+
+def configure(
+    enabled: bool = False,
+    dir: str = "",
+    max_artifacts: int = 4,
+    max_seconds: float = 30.0,
+) -> None:
+    global _enabled, _dir, _max_artifacts, _max_seconds
+    with _lock:
+        _enabled = bool(enabled)
+        _dir = str(dir or "")
+        _max_artifacts = max(1, int(max_artifacts))
+        _max_seconds = float(max_seconds)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def base_dir() -> str:
+    return _dir or os.path.join(tempfile.gettempdir(), "cerbos_tpu_profiles")
+
+
+def _prune(base: str, keep: int) -> None:
+    try:
+        entries = sorted(
+            (e for e in os.scandir(base) if e.is_dir()), key=lambda e: e.name
+        )
+    except OSError:
+        return
+    for e in entries[:-keep] if keep < len(entries) else []:
+        shutil.rmtree(e.path, ignore_errors=True)
+
+
+def _run_trace(path: str, seconds: float) -> None:
+    """Separated for testability: the actual jax capture."""
+    try:
+        import jax
+        from jax import profiler as jprof
+    except Exception as e:  # pragma: no cover - jax is a hard dep in practice
+        raise ProfilerUnavailable(f"jax profiler unavailable: {e}") from e
+    if not hasattr(jprof, "trace"):
+        raise ProfilerUnavailable("this jax has no profiler.trace")
+    with jprof.trace(path):
+        time.sleep(seconds)
+
+
+def capture(seconds: float) -> dict:
+    """Blocking capture; returns ``{path, seconds}`` for the response body.
+
+    Raises ProfilerDisabled / ProfilerBusy / ProfilerUnavailable /
+    ValueError (bad duration) — the HTTP handler maps each to a status.
+    """
+    global _active, _seq
+    if not _enabled:
+        raise ProfilerDisabled("profiling disabled (engine.tpu.profiler.enabled)")
+    seconds = float(seconds)
+    if seconds <= 0:
+        raise ValueError("seconds must be > 0")
+    seconds = min(seconds, _max_seconds)
+    with _lock:
+        if _active:
+            raise ProfilerBusy("a profile capture is already running")
+        _active = True
+    try:
+        base = base_dir()
+        os.makedirs(base, exist_ok=True)
+        _seq += 1
+        name = time.strftime("%Y%m%dT%H%M%S") + f"-p{os.getpid()}-{_seq:03d}"
+        path = os.path.join(base, name)
+        _log.info("profile capture: %.1fs -> %s", seconds, path)
+        _run_trace(path, seconds)
+        _prune(base, _max_artifacts)
+        return {"path": path, "seconds": seconds}
+    finally:
+        with _lock:
+            _active = False

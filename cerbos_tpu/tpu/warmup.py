@@ -1,0 +1,165 @@
+"""Bootstrap warmup: pre-compile the dominant device layouts.
+
+A fresh replica's first device batch in each shape bucket pays the full XLA
+compile (seconds to tens of seconds when the persistent cache is cold) — a
+latency cliff the reference's ~1 s cold start never shows. The warmup
+driver runs synthetic batches through the evaluator's normal ``check()``
+path before readiness opens the gates, so the compile happens on nobody's
+request. Configured under ``engine.tpu.warmup``:
+
+- ``batchSizes``: batch sizes to pre-compile, one per pow2 shape bucket the
+  traffic mix is expected to hit (sizes below ``minDeviceBatch`` are
+  clamped up — the oracle path compiles nothing);
+- ``synthetic``: optional explicit corpus, a list of
+  ``{kind, actions, roles}`` entries. When empty, the corpus is DERIVED
+  from the loaded rule table (its resource kinds, actions, and roles) so
+  the warmed layouts match the policies actually being served;
+- ``maxKinds``, ``timeoutSeconds``, ``background``.
+
+The driver talks to :mod:`..engine.readiness`: one ``layout_compiled()``
+per finished batch size, ``mark_ready()`` at the end — also on failure or
+timeout, because a replica that never becomes ready is a worse outcome
+than one that cold-compiles a straggler layout under traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+from ..engine import types as T
+
+_log = logging.getLogger("cerbos_tpu.warmup")
+
+_FALLBACK_SPEC = {"kind": "warmup", "actions": ["view"], "roles": ["user"]}
+
+
+def derive_corpus(rule_table: Any, max_kinds: int = 8) -> list[dict]:
+    """Synthesize ``{kind, actions, roles}`` specs from the rule table so
+    warmup batches exercise real candidate rows (unknown kinds pack to
+    empty candidate sets and compile nothing useful)."""
+    by_kind: dict[str, dict[str, set]] = {}
+    try:
+        rows = rule_table.idx.get_all_rows()
+    except Exception:
+        rows = []
+    for row in rows:
+        kind = getattr(row, "resource", "") or ""
+        if not kind or "*" in kind:
+            continue
+        spec = by_kind.setdefault(kind, {"actions": set(), "roles": set()})
+        if row.action and "*" not in row.action:
+            spec["actions"].add(row.action)
+        if row.allow_actions:
+            spec["actions"].update(a for a in row.allow_actions if "*" not in a)
+        role = getattr(row, "role", "") or ""
+        if role and role != "*":
+            spec["roles"].add(role)
+    out = []
+    for kind in sorted(by_kind)[: max(1, int(max_kinds))]:
+        spec = by_kind[kind]
+        out.append(
+            {
+                "kind": kind,
+                "actions": sorted(spec["actions"])[:4] or ["view"],
+                "roles": sorted(spec["roles"])[:4] or ["user"],
+            }
+        )
+    return out or [dict(_FALLBACK_SPEC)]
+
+
+def synthetic_inputs(specs: list[dict], n: int) -> list[T.CheckInput]:
+    """``n`` CheckInputs cycling over the corpus specs. Attribute payloads
+    stay empty: layout keys depend on shapes and referenced columns, not on
+    attribute values, and empty attrs keep packing cheap."""
+    inputs = []
+    for i in range(n):
+        spec = specs[i % len(specs)]
+        actions = list(spec.get("actions") or ["view"])[:4]
+        roles = list(spec.get("roles") or ["user"])[:4]
+        inputs.append(
+            T.CheckInput(
+                request_id=f"warmup-{i}",
+                principal=T.Principal(id=f"warmup-principal-{i % 7}", roles=roles),
+                resource=T.Resource(kind=str(spec.get("kind", "warmup")), id=f"warmup-res-{i}"),
+                actions=actions,
+            )
+        )
+    return inputs
+
+
+class WarmupDriver:
+    """Pre-compiles one device layout per configured batch size."""
+
+    def __init__(
+        self,
+        evaluator: Any,
+        batch_sizes: Optional[list[int]] = None,
+        corpus: Optional[list[dict]] = None,
+        max_kinds: int = 8,
+        timeout_s: float = 120.0,
+        readiness: Any = None,
+    ):
+        self.evaluator = evaluator
+        min_batch = max(1, int(getattr(evaluator, "min_device_batch", 16)))
+        sizes = sorted({max(int(s), min_batch) for s in (batch_sizes or [16, 64]) if int(s) > 0})
+        self.batch_sizes = sizes or [min_batch]
+        self.corpus = [dict(s) for s in corpus] if corpus else None
+        self.max_kinds = int(max_kinds)
+        self.timeout_s = float(timeout_s)
+        self.readiness = readiness
+        self.expected = len(self.batch_sizes)
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> dict:
+        """Synchronously warm every batch size, then mark ready."""
+        specs = self.corpus or derive_corpus(self.evaluator.rule_table, self.max_kinds)
+        deadline = time.monotonic() + self.timeout_s
+        summary: dict = {"layouts": 0, "inputs": 0, "errors": []}
+        t_start = time.monotonic()
+        error: Optional[str] = None
+        for size in self.batch_sizes:
+            if time.monotonic() > deadline:
+                error = f"warmup timeout after {self.timeout_s:.0f}s ({summary['layouts']}/{self.expected} layouts)"
+                _log.warning("%s — opening readiness anyway", error)
+                break
+            try:
+                t0 = time.monotonic()
+                self.evaluator.check(synthetic_inputs(specs, size))
+                _log.info(
+                    "warmup: batch size %d compiled in %.2fs (%d/%d layouts)",
+                    size, time.monotonic() - t0, summary["layouts"] + 1, self.expected,
+                )
+            except Exception as e:  # noqa: BLE001 - warmup must not kill boot
+                summary["errors"].append(f"size {size}: {e}")
+                _log.warning("warmup batch size %d failed: %s", size, e)
+                continue
+            summary["layouts"] += 1
+            summary["inputs"] += size
+            if self.readiness is not None:
+                self.readiness.layout_compiled()
+        summary["seconds"] = round(time.monotonic() - t_start, 3)
+        if error is None and summary["errors"]:
+            error = "; ".join(summary["errors"])
+        if self.readiness is not None:
+            self.readiness.mark_ready(error=error)
+        return summary
+
+    def start(self) -> threading.Thread:
+        """Run warmup on a daemon thread so the listeners bind immediately;
+        readiness keeps traffic out until the thread reports in."""
+
+        def _bg():
+            try:
+                self.run()
+            except Exception as e:  # noqa: BLE001 - never wedge readiness shut
+                _log.warning("warmup driver crashed: %s — opening readiness anyway", e)
+                if self.readiness is not None:
+                    self.readiness.mark_ready(error=str(e))
+
+        t = threading.Thread(target=_bg, name="cerbos-tpu-warmup", daemon=True)
+        self._thread = t
+        t.start()
+        return t

@@ -51,6 +51,9 @@ class TestHelmTemplate:
         assert len(ann["checksum/config"]) == 64
         # probes stay plain HTTP without TLS
         assert "scheme" not in c["livenessProbe"]["httpGet"]
+        # readiness is warmup-gated and split from liveness
+        assert c["livenessProbe"]["httpGet"]["path"] == "/_cerbos/health"
+        assert c["readinessProbe"]["httpGet"]["path"] == "/_cerbos/ready"
         # the rendered config carries the streaming knobs end to end
         conf = yaml.safe_load(
             docs[("ConfigMap", "pdp-cerbos-tpu-config")]["data"]["config.yaml"]
@@ -126,6 +129,20 @@ class TestChartStatic:
             assert tpu["breaker"][knob] == want["breaker"][knob], knob
         for knob in ("enabled", "capacity"):
             assert tpu["flightRecorder"][knob] == want["flightRecorder"][knob], knob
+        for knob in ("enabled", "batchSizes", "background", "timeoutSeconds"):
+            assert tpu["warmup"][knob] == want["warmup"][knob], knob
+        for knob in ("enabled", "maxArtifacts", "maxSeconds"):
+            assert tpu["profiler"][knob] == want["profiler"][knob], knob
+
+    def test_readiness_probe_split_from_liveness(self):
+        # a cold replica must not take traffic until warmup has compiled the
+        # expected device layouts; liveness stays on the plain health endpoint
+        with open(
+            os.path.join(CHART_DIR, "templates", "deployment.yaml"), encoding="utf-8"
+        ) as f:
+            tpl = f.read()
+        assert "/_cerbos/ready" in tpl
+        assert "/_cerbos/health" in tpl
 
     def test_prometheus_scrape_annotations(self):
         with open(os.path.join(CHART_DIR, "values.yaml"), encoding="utf-8") as f:
@@ -157,6 +174,12 @@ class TestChartStatic:
             "cerbos_tpu_batch_occupancy",
             "cerbos_tpu_breaker_state",
             "cerbos_tpu_breaker_transitions_total",
+            "cerbos_tpu_xla_compile_seconds_bucket",
+            "cerbos_tpu_xla_compiles_total",
+            "cerbos_tpu_recompile_storms_total",
+            "cerbos_tpu_xla_layout_cardinality",
+            "cerbos_tpu_device_memory_bytes_in_use",
+            "cerbos_tpu_readiness_state",
         ):
             assert needle in joined, needle
 

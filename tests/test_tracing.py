@@ -172,6 +172,16 @@ class TestMetricsLint:
                 "cerbos_tpu_batch_stage_seconds",
                 "cerbos_tpu_breaker_state",
                 "cerbos_tpu_breaker_transitions_total",
+                # compile-economy family (docs/OBSERVABILITY.md)
+                "cerbos_tpu_xla_compiles_total",
+                "cerbos_tpu_xla_compile_seconds",
+                "cerbos_tpu_jit_cache_hits_total",
+                "cerbos_tpu_jit_cache_misses_total",
+                "cerbos_tpu_xla_layout_cardinality",
+                "cerbos_tpu_recompile_storms_total",
+                "cerbos_tpu_readiness_state",
+                "cerbos_tpu_warmup_expected_layouts",
+                "cerbos_tpu_warmup_compiled_layouts",
             ):
                 assert name in inst, name
             known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.Histogram, obs.HistogramVec)
