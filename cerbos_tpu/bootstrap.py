@@ -106,7 +106,23 @@ def initialize(
     config: Config,
     use_tpu: Optional[bool] = None,
     prebuilt: Optional[Prebuilt] = None,
+    role: str = "standalone",
+    ipc_socket: Optional[str] = None,
+    worker_label: str = "",
 ) -> Core:
+    """``role`` selects the process topology this Core participates in:
+
+    - ``standalone`` (default) — the single-process PDP: device evaluator,
+      batcher, warmup, everything in this process.
+    - ``frontend`` — one of N HTTP/gRPC front-end processes: no device, no
+      warmup; checks ride the ticket queue at ``ipc_socket`` to the shared
+      batcher process via ``engine/ipc.RemoteBatcherClient``, readiness
+      mirrors the batcher's, and the COW-shared rule table backs the local
+      CPU-oracle fallback when the batcher is down or refuses.
+
+    The batcher process itself uses :func:`build_batcher_ipc` on top of a
+    standalone Core.
+    """
     audit_log = new_audit_log(config.section("audit"))
     store = new_store(config.section("storage"))
 
@@ -147,7 +163,37 @@ def initialize(
     dispatch_evaluator = None
     batcher = None
     health = None
-    if tpu_enabled:
+    if role == "frontend":
+        from .engine.ipc import RemoteBatcherClient, default_socket_path
+
+        shared_conf = tpu_conf.get("sharedBatcher", {}) or {}
+        client = RemoteBatcherClient(
+            ipc_socket or default_socket_path(str(shared_conf.get("socketPath", "") or "")),
+            manager.rule_table,
+            schema_mgr=schema_mgr,
+            params=eval_params,
+            request_timeout_s=float(
+                shared_conf.get("requestTimeoutMs", tpu_conf.get("requestTimeoutMs", 30000))
+            )
+            / 1000.0,
+            worker_label=worker_label or "fe",
+            status_poll_s=float(shared_conf.get("statusPollMs", 500)) / 1000.0,
+        )
+        dispatch_evaluator = client
+        # Core.batcher doubles as "the thing check() awaits on" for the
+        # server's dispatch decision and for close(); the client fits both
+        batcher = client
+
+        _client_prev = manager.on_swap
+
+        def _client_swap(rt) -> None:
+            # policy reload: keep the local oracle fallback on the new table
+            client.refresh_table(rt)
+            if _client_prev is not None:
+                _client_prev(rt)
+
+        manager.on_swap = _client_swap
+    elif tpu_enabled:
         if prebuilt is not None and prebuilt.tpu_evaluator is not None:
             # adopt the pre-lowered evaluator (COW-shared across forked
             # workers); only the per-process schema manager needs rewiring
@@ -200,9 +246,17 @@ def initialize(
     from .engine import readiness as _readiness
 
     rstate = _readiness.state()
-    rstate.bind_health((lambda: health.state) if health is not None else None)
+    if role == "frontend":
+        # readiness is the SHARED batcher's readiness: 503 until its warmup
+        # pre-compiles finish, degraded-but-live when it dies (the local
+        # oracle keeps serving) — never a 0/N outage
+        rstate.bind_remote(dispatch_evaluator.remote_status)
+    else:
+        rstate.bind_health((lambda: health.state) if health is not None else None)
     warm_conf = tpu_conf.get("warmup", {}) or {}
-    if tpu_enabled and tpu_evaluator is not None and bool(warm_conf.get("enabled", False)):
+    if role == "frontend":
+        pass
+    elif tpu_enabled and tpu_evaluator is not None and bool(warm_conf.get("enabled", False)):
         from .tpu.warmup import WarmupDriver
 
         driver = WarmupDriver(
@@ -303,3 +357,35 @@ def initialize(
         tpu_evaluator=tpu_evaluator,
         batcher=batcher,
     )
+
+
+def build_batcher_ipc(core: Core, socket_path: str):
+    """Attach the ticket-queue server to a standalone Core, turning this
+    process into the pool's shared batcher. The Core must have been built
+    with request batching on (``engine.tpu.requestBatching``); front ends
+    connect to ``socket_path`` and their tickets join the same drain loop,
+    breaker, and quarantine as local traffic would."""
+    import os as _os
+
+    from .engine import readiness as _readiness
+    from .engine.faults import parse_fault_spec
+    from .engine.ipc import BatcherIpcServer
+
+    if core.batcher is None:
+        raise RuntimeError(
+            "shared-batcher process requires engine.tpu.enabled and "
+            "engine.tpu.requestBatching"
+        )
+    tpu_conf = core.config.section("engine").get("tpu", {})
+    shared_conf = tpu_conf.get("sharedBatcher", {}) or {}
+    fault_spec = _os.environ.get("CERBOS_TPU_FAULTS", "") or str(tpu_conf.get("faults", "") or "")
+    faults = parse_fault_spec(fault_spec) if fault_spec else {}
+    server = BatcherIpcServer(
+        socket_path,
+        core.batcher,
+        readiness=_readiness.state().snapshot,
+        max_outstanding=int(shared_conf.get("maxOutstanding", 4096)),
+        faults=faults,
+    )
+    server.start()
+    return server

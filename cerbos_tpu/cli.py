@@ -29,7 +29,7 @@ def _parse_duration_s(v) -> int:
     return int(total)
 
 
-def _build_server(core, config, http_addr=None, grpc_addr=None, reuse_port=False):
+def _build_server(core, config, http_addr=None, grpc_addr=None, reuse_port=False, worker_label=""):
     """One construction site for the full server wiring (admin, authzen,
     playground, TLS, CORS) shared by single-process serve and worker pools."""
     from .server.server import Server, ServerConfig
@@ -64,6 +64,7 @@ def _build_server(core, config, http_addr=None, grpc_addr=None, reuse_port=False
             # inline dispatch is only safe without the cross-request batcher
             # (which needs concurrent requests in flight to fill batches)
             direct_dispatch=core.batcher is None,
+            worker_label=worker_label,
         ),
         admin_service=_admin(core, server_conf),
         extra_services=extra,
@@ -89,6 +90,41 @@ def cmd_server(args: argparse.Namespace) -> int:
         mx = metrics_exporter()
         if mx is not None:
             mx.add_source(core.service.metrics.snapshot)
+
+    n_frontends = int(getattr(args, "frontends", 0) or server_conf.get("frontends", 0) or 0)
+    if n_frontends > 0:
+        # multi-process front door: N GIL-light request processes feeding ONE
+        # shared batcher/evaluator process over the unix ticket queue. This is
+        # the topology that closes the served-RPS gap (docs/PERF.md round 7);
+        # --workers multiplies full PDPs instead and fragments device batches.
+        from .server.workers import run_frontdoor_pool
+
+        def announce_fd(http_addr: str, grpc_addr: str) -> None:
+            http_port = http_addr.rpartition(":")[2]
+            grpc_port = grpc_addr.rpartition(":")[2]
+            print(
+                f"cerbos-tpu serving: http={http_port} grpc={grpc_port} "
+                f"frontends={n_frontends} batcher=1",
+                flush=True,
+            )
+
+        def post_fork_fd() -> None:
+            init_otlp_from_env()
+            init_otlp_metrics_from_env()
+
+        def pre_exit_fd() -> None:
+            close_exporter()
+            close_metrics_exporter()
+
+        return run_frontdoor_pool(
+            config,
+            n_frontends,
+            _build_server,
+            announce=announce_fd,
+            post_fork=post_fork_fd,
+            post_init=wire_metrics,
+            pre_exit=pre_exit_fd,
+        )
 
     n_workers = int(getattr(args, "workers", 0) or server_conf.get("workers", 1) or 1)
     if n_workers > 1:
@@ -317,6 +353,13 @@ def main(argv: list[str] | None = None) -> int:
         type=int,
         default=0,
         help="serving worker processes (SO_REUSEPORT pool; default: server.workers config or 1)",
+    )
+    p_server.add_argument(
+        "--frontends",
+        type=int,
+        default=0,
+        help="front-end processes feeding one shared device batcher over a unix "
+        "ticket queue (default: server.frontends config or 0 = disabled)",
     )
     p_server.set_defaults(fn=cmd_server)
 

@@ -315,6 +315,45 @@ class BatchingEvaluator:
                 span.set_attribute("outcome", "timeout")
                 return self._serve_oracle(pending.inputs, params, "timeout")
 
+    def check_async(
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
+        ctx: Optional[SpanContext] = None,
+    ) -> Future:
+        """Non-blocking enqueue for callers that hold many tickets at once
+        (the IPC server fronting N worker processes cannot burn a thread per
+        ticket). Same admission ladder as ``check()``, but refusals settle
+        the returned future with the exception instead of serving the oracle
+        here — the front-end process owns its own COW-shared oracle and the
+        batcher process keeps its cycles for device work. The future resolves
+        to ``list[CheckOutput]`` or raises ``DeadlineExceeded``/``_BatchFailed``.
+        """
+        fut: Future = Future()
+        if deadline is not None and time.monotonic() >= deadline:
+            self._count_deadline_drop()
+            _settle(fut, error=DeadlineExceeded("request deadline expired before evaluation"))
+            return fut
+        if self._quarantine and self._has_quarantined(inputs):
+            _settle(fut, error=_BatchFailed(None, "quarantine"))
+            return fut
+        health = self.health
+        if health is not None and not health.allow_device():
+            token = health.should_probe()
+            if token is not None:
+                self._spawn_probe(token, list(inputs)[:16], params)
+            _settle(fut, error=_BatchFailed(None, "breaker_open"))
+            return fut
+        if self._stop or self._dead is not None or not self._thread.is_alive():
+            _settle(fut, error=_BatchFailed(self._dead, "batcher_dead"))
+            return fut
+        pending = _Pending(list(inputs), params, fut, deadline=deadline, ctx=ctx)
+        with self._wakeup:
+            self._queue.append(pending)
+            self._wakeup.notify()
+        return fut
+
     def _count_deadline_drop(self) -> None:
         self.stats["deadline_drops"] += 1
         self.m_deadline_drops.inc()

@@ -66,3 +66,42 @@ class Engine:
         if self.on_decision is not None:
             self.on_decision(list(inputs), outputs)
         return outputs
+
+    @property
+    def supports_async(self) -> bool:
+        """True when the dispatch evaluator can settle checks on an asyncio
+        loop (the RemoteBatcherClient in front-end mode). The HTTP server
+        uses this to skip the per-request thread-pool hop entirely."""
+        return self.tpu_evaluator is not None and hasattr(self.tpu_evaluator, "check_await")
+
+    async def check_await(
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
+    ) -> list[T.CheckOutput]:
+        """Event-loop-native check: awaits the evaluator's reply future with
+        no executor hop. Small batches below the device threshold still take
+        the serial oracle inline — at threshold sizes that is cheaper than a
+        loop hand-off."""
+        from ..observability import start_span
+
+        params = params or self.eval_params
+        with start_span("engine.Check", batch_size=len(inputs)) as span:
+            if (
+                self.tpu_evaluator is not None
+                and len(inputs) >= self.tpu_batch_threshold
+                and hasattr(self.tpu_evaluator, "check_await")
+            ):
+                span.set_attribute("path", "device")
+                outputs = await self.tpu_evaluator.check_await(
+                    list(inputs), params, deadline=deadline
+                )
+            else:
+                from ..ruletable import check_input
+
+                span.set_attribute("path", "serial")
+                outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
+        if self.on_decision is not None:
+            self.on_decision(list(inputs), outputs)
+        return outputs

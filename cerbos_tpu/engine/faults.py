@@ -20,6 +20,10 @@ silently disable a chaos run):
 - ``poison_attr:KEY`` — any batch containing an input whose resource attr
   has KEY raises ``DeviceFault`` (submit and check, so off-path bisection
   reproduces the failure).
+- ``ipc_wedge_after:N`` — consumed by ``engine/ipc.BatcherIpcServer``, not
+  this wrapper: after N CHECK tickets the ticket queue swallows every
+  subsequent one without replying, simulating a wedged ring so front ends
+  exercise their timeout → oracle fallback.
 - ``seed:N`` — PRNG seed for the probabilistic knobs (default 1337).
 
 The wrapper delegates every other attribute (``rule_table``,
@@ -40,7 +44,7 @@ class DeviceFault(RuntimeError):
 
 
 _FLOAT_KNOBS = {"submit_raise", "collect_raise", "check_raise", "wedge_sleep_s"}
-_INT_KNOBS = {"submit_delay_ms", "collect_delay_ms", "wedge_after", "seed"}
+_INT_KNOBS = {"submit_delay_ms", "collect_delay_ms", "wedge_after", "ipc_wedge_after", "seed"}
 _STR_KNOBS = {"poison_attr"}
 
 

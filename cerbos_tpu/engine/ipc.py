@@ -1,0 +1,853 @@
+"""Process-crossing ticket queue: N front-end processes → one shared batcher.
+
+The GIL caps a single PDP process far below what the device batcher can
+evaluate (docs/PERF.md "Served-path latency": 586 RPS served vs 64k+ dec/s in
+batch form). An SO_REUSEPORT pool of full PDPs doesn't close the gap either:
+each forked worker drives its OWN evaluator, fragmenting batches and
+multiplying XLA compiles per process. The fix is topological — many HTTP/gRPC
+front-end processes parse and validate traffic, ONE batcher process owns the
+device — and this module is the seam between them: a per-worker ticket
+queue over a unix domain socket carrying compact check tickets in and packed
+effect/meta rows out.
+
+Transport: SOCK_STREAM unix socket, one connection per front-end process,
+length-prefixed frames (the portable equivalent of an shm ring — the kernel
+socket buffer IS the ring, with blocking-read wakeups for free). Payloads are
+``marshal``-encoded plain containers: C-speed (de)serialization with no
+schema-compile step and no security caveat — both ends are same-host
+processes forked by one supervisor. All padding/stacking of decoded tickets
+stays on the batcher side via the evaluator's pooled ``_pad_stack`` staging
+buffers, so the marshalling cost the device cares about never leaves the
+device-owning process.
+
+Fault semantics mirror docs/ROBUSTNESS.md, distributed:
+
+- the batcher's fast-path refusals (breaker open, quarantine hit, dead drain
+  loop, full queue) come back as compact ERR frames and the FRONT END serves
+  its own COW-shared CPU oracle — the batcher process spends no cycles on
+  degraded traffic;
+- a dead batcher process settles every in-flight ticket with a connection
+  error immediately (no timeout wait); front ends degrade to their oracle and
+  a background loop reconnects when the supervisor respawns the batcher;
+- per-request deadlines travel as RELATIVE remaining seconds (monotonic
+  clocks are not comparable across processes) and re-anchor on arrival.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import marshal
+import os
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Callable, Optional, Sequence
+
+from ..observability import current_span_context, parse_traceparent
+from ..ruletable import check_input
+from . import types as T
+from .batcher import DeadlineExceeded, _BatchFailed
+
+_log = logging.getLogger("cerbos_tpu.engine.ipc")
+
+# -- frame protocol ----------------------------------------------------------
+
+_HDR = struct.Struct("<IBQ")  # payload length, frame type, request id
+
+T_HELLO = 1
+T_CHECK = 2
+T_RESULT = 3
+T_ERR = 4
+T_STATUS = 5
+T_STATUS_R = 6
+T_FLIGHT = 7
+T_FLIGHT_R = 8
+T_METRICS = 9
+T_METRICS_R = 10
+
+_MAX_FRAME = 64 * 1024 * 1024  # a corrupt length must not allocate the moon
+
+
+class IpcError(Exception):
+    """Transport-level failure (framing, codec, connection)."""
+
+
+class IpcDisconnected(IpcError):
+    """The peer went away; in-flight tickets must settle immediately."""
+
+
+def _send_frame(sock: socket.socket, mtype: int, req_id: int, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(len(payload), mtype, req_id) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise IpcDisconnected("peer closed the ticket queue")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[int, int, bytes]:
+    length, mtype, req_id = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if length > _MAX_FRAME:
+        raise IpcError(f"oversized frame ({length} bytes)")
+    return mtype, req_id, _recv_exact(sock, length) if length else b""
+
+
+# -- ticket codec ------------------------------------------------------------
+#
+# CheckInput/CheckOutput → plain tuples marshal can swallow. Attribute values
+# were already normalized (structpb double semantics) at the front end's
+# ingestion, so decode reconstructs the dataclasses via __new__ and skips
+# __post_init__ — re-normalizing on the batcher would double that work.
+
+
+def encode_inputs(inputs: Sequence[T.CheckInput]) -> list:
+    rows = []
+    for i in inputs:
+        p, r = i.principal, i.resource
+        rows.append(
+            (
+                i.request_id,
+                (p.id, list(p.roles or ()), p.attr, p.policy_version, p.scope),
+                (r.kind, r.id, r.attr, r.policy_version, r.scope),
+                list(i.actions or ()),
+                i.aux_data.jwt if i.aux_data is not None else None,
+            )
+        )
+    return rows
+
+
+def decode_inputs(rows: list) -> list[T.CheckInput]:
+    out = []
+    for request_id, prow, rrow, actions, jwt in rows:
+        p = T.Principal.__new__(T.Principal)
+        p.id, p.roles, p.attr, p.policy_version, p.scope = prow
+        r = T.Resource.__new__(T.Resource)
+        r.kind, r.id, r.attr, r.policy_version, r.scope = rrow
+        aux = None
+        if jwt is not None:
+            aux = T.AuxData.__new__(T.AuxData)
+            aux.jwt = jwt
+        inp = T.CheckInput.__new__(T.CheckInput)
+        inp.request_id, inp.principal, inp.resource = request_id, p, r
+        inp.actions, inp.aux_data = actions, aux
+        out.append(inp)
+    return out
+
+
+def encode_outputs(outputs: Sequence[T.CheckOutput]) -> list:
+    rows = []
+    for o in outputs:
+        rows.append(
+            (
+                o.request_id,
+                o.resource_id,
+                [(a, ae.effect, ae.policy, ae.scope) for a, ae in o.actions.items()],
+                list(o.effective_derived_roles),
+                [(v.path, v.message, v.source) for v in o.validation_errors],
+                [(e.src, e.action, e.val, e.error) for e in o.outputs],
+                o.effective_policies,
+            )
+        )
+    return rows
+
+
+def decode_outputs(rows: list) -> list[T.CheckOutput]:
+    out = []
+    for request_id, resource_id, actions, edr, verrs, oents, epols in rows:
+        out.append(
+            T.CheckOutput(
+                request_id=request_id,
+                resource_id=resource_id,
+                actions={
+                    a: T.ActionEffect(effect=e, policy=pol, scope=sc)
+                    for a, e, pol, sc in actions
+                },
+                effective_derived_roles=list(edr),
+                validation_errors=[
+                    T.ValidationError(path=p, message=m, source=s) for p, m, s in verrs
+                ],
+                outputs=[
+                    T.OutputEntry(src=src, action=act, val=val, error=err)
+                    for src, act, val, err in oents
+                ],
+                effective_policies=epols,
+            )
+        )
+    return out
+
+
+# -- batcher-side server -----------------------------------------------------
+
+
+class _ConnWriter:
+    """Per-connection outbound queue + writer thread: reply encoding and
+    socket writes never run on the batcher's drain loop (future callbacks
+    fire there) or block the reader."""
+
+    def __init__(self, sock: socket.socket, name: str):
+        self._sock = sock
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
+        self._thread.start()
+
+    def send(self, mtype: int, req_id: int, encode: Callable[[], bytes]) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._queue.append((mtype, req_id, encode))
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue and self._closed:
+                    return
+                mtype, req_id, encode = self._queue.popleft()
+            try:
+                _send_frame(self._sock, mtype, req_id, encode())
+            except Exception:  # noqa: BLE001  (dead peer: drop replies, reader cleans up)
+                self.close()
+                return
+
+
+class BatcherIpcServer:
+    """The device-owning process's end of the ticket queue.
+
+    Listens on a unix socket; each front-end process holds one connection.
+    CHECK tickets decode into the shared ``BatchingEvaluator.check_async``
+    queue (the same drain loop, breaker, quarantine, and deadline machinery
+    as the single-process path); control frames serve the batcher's
+    readiness snapshot, flight-recorder dump, and metrics text so the
+    front ends can re-export them (docs/OBSERVABILITY.md).
+    """
+
+    def __init__(
+        self,
+        socket_path: str,
+        batcher: Any,
+        readiness: Optional[Callable[[], dict]] = None,
+        max_outstanding: int = 4096,
+        faults: Optional[dict] = None,
+    ):
+        self.socket_path = socket_path
+        self.batcher = batcher
+        self.readiness = readiness
+        self.max_outstanding = max(1, int(max_outstanding))
+        self.faults = dict(faults or {})
+        self._listener: Optional[socket.socket] = None
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self._checks_seen = 0
+        self._stop = False
+        self.stats = {"connections": 0, "checks": 0, "rejected_full": 0, "wedged_drops": 0}
+        self._init_metrics()
+
+    def _init_metrics(self) -> None:
+        from ..observability import metrics
+
+        reg = metrics()
+        self.m_depth = reg.gauge(
+            "cerbos_tpu_ipc_ring_depth",
+            "check tickets accepted from front ends and not yet answered",
+            track_max=True,
+        )
+        self.m_full = reg.counter(
+            "cerbos_tpu_ipc_full_total",
+            "tickets refused because the shared batcher queue was full (front end served its oracle)",
+        )
+        self.m_enqueue = reg.histogram_vec(
+            "cerbos_tpu_ipc_enqueue_seconds",
+            "ticket decode + batcher enqueue latency on the batcher process, per front-end worker",
+            label="worker",
+            buckets=[0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05],
+        )
+        self.m_conns = reg.gauge(
+            "cerbos_tpu_ipc_connections", "front-end processes currently attached"
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(self.socket_path)
+        listener.listen(64)
+        self._listener = listener
+        threading.Thread(target=self._accept_loop, daemon=True, name="ipc-accept").start()
+
+    def close(self) -> None:
+        self._stop = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conns.append(conn)
+            self.stats["connections"] += 1
+            self.m_conns.set(len(self._conns))
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True, name="ipc-conn"
+            ).start()
+
+    # -- per-connection protocol --------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
+        writer = _ConnWriter(conn, "ipc-writer")
+        worker = "?"
+        try:
+            while True:
+                mtype, req_id, payload = _recv_frame(conn)
+                if mtype == T_HELLO:
+                    hello = marshal.loads(payload)
+                    worker = str(hello.get("worker", "?"))
+                elif mtype == T_CHECK:
+                    self._handle_check(worker, req_id, payload, writer)
+                elif mtype == T_STATUS:
+                    snap = self._status_snapshot()
+                    writer.send(T_STATUS_R, req_id, lambda s=snap: marshal.dumps(s))
+                elif mtype == T_FLIGHT:
+                    dump = self._flight_snapshot()
+                    writer.send(T_FLIGHT_R, req_id, lambda d=dump: marshal.dumps(d))
+                elif mtype == T_METRICS:
+                    from ..observability import metrics
+
+                    text = metrics().render()
+                    writer.send(T_METRICS_R, req_id, lambda t=text: t.encode())
+        except (IpcError, OSError, EOFError, ValueError, TypeError):
+            pass
+        finally:
+            writer.close()
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            self.m_conns.set(len(self._conns))
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _wedged(self) -> bool:
+        wedge_after = self.faults.get("ipc_wedge_after")
+        if wedge_after is None:
+            return False
+        return self._checks_seen > int(wedge_after)
+
+    def _handle_check(self, worker: str, req_id: int, payload: bytes, writer: _ConnWriter) -> None:
+        t0 = time.perf_counter()
+        self._checks_seen += 1
+        self.stats["checks"] += 1
+        if self._wedged():
+            # simulated wedged ring (engine/faults.py ipc_wedge_after): the
+            # ticket is swallowed; the front end times out onto its oracle
+            self.stats["wedged_drops"] += 1
+            return
+        try:
+            deadline_rel, traceparent, rows = marshal.loads(payload)
+            inputs = decode_inputs(rows)
+        except Exception:  # noqa: BLE001
+            writer.send(T_ERR, req_id, lambda: marshal.dumps("codec"))
+            return
+        with self._lock:
+            if self._outstanding >= self.max_outstanding:
+                full = True
+            else:
+                full = False
+                self._outstanding += 1
+        if full:
+            self.stats["rejected_full"] += 1
+            self.m_full.inc()
+            writer.send(T_ERR, req_id, lambda: marshal.dumps("ipc_full"))
+            return
+        self.m_depth.set(self._outstanding)
+        deadline = time.monotonic() + deadline_rel if deadline_rel is not None else None
+        ctx = parse_traceparent(traceparent) if traceparent else None
+        fut = self.batcher.check_async(inputs, deadline=deadline, ctx=ctx)
+        self.m_enqueue.observe(worker, time.perf_counter() - t0)
+
+        def settle(f: Future) -> None:
+            with self._lock:
+                self._outstanding -= 1
+            self.m_depth.set(self._outstanding)
+            try:
+                outs = f.result()
+            except DeadlineExceeded:
+                writer.send(T_ERR, req_id, lambda: marshal.dumps("deadline"))
+            except _BatchFailed as e:
+                writer.send(T_ERR, req_id, lambda r=e.reason: marshal.dumps(r))
+            except BaseException as e:  # noqa: BLE001
+                writer.send(
+                    T_ERR, req_id, lambda r=f"batch_error:{type(e).__name__}": marshal.dumps(r)
+                )
+            else:
+                # encode runs on the writer thread, not here (the callback
+                # fires on the batcher drain loop, which must stay hot)
+                writer.send(T_RESULT, req_id, lambda o=outs: marshal.dumps(encode_outputs(o)))
+
+        fut.add_done_callback(settle)
+
+    def _status_snapshot(self) -> dict:
+        snap: dict = {"pid": os.getpid()}
+        if self.readiness is not None:
+            try:
+                snap.update(self.readiness())
+            except Exception:  # noqa: BLE001
+                snap.setdefault("status", "ready")
+        else:
+            snap["status"] = "ready"
+        health = getattr(self.batcher, "health", None)
+        if health is not None:
+            snap["breaker"] = health.state
+        stats = getattr(self.batcher, "stats", None)
+        if isinstance(stats, dict):
+            snap["batcher_stats"] = dict(stats)
+        snap["ipc"] = dict(self.stats)
+        return snap
+
+    def _flight_snapshot(self) -> dict:
+        from .flight import recorder
+
+        out = {"flight": recorder().dump(), "pid": os.getpid()}
+        try:
+            from ..tpu import jitcache
+
+            out["jitcache"] = jitcache.status()
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+
+# -- front-end client --------------------------------------------------------
+
+
+class RemoteBatcherClient:
+    """``Engine.check()``-compatible evaluator that forwards to the shared
+    batcher process, with the PR 3 degradation ladder preserved end to end:
+    deadline propagation (as relative remaining time), ERR fast paths and
+    timeouts falling back to this process's COW-shared CPU oracle, and a
+    background reconnect loop so a respawned batcher picks traffic back up
+    without restarting the front end.
+
+    Also exposes ``check_await`` — the asyncio-native path the HTTP front
+    end uses to await tickets directly on the event loop, with no
+    thread-pool hop per request (the single biggest per-call overhead the
+    multi-process front door removes on small hosts).
+    """
+
+    supports_deadline = True
+
+    def __init__(
+        self,
+        socket_path: str,
+        rule_table: Any,
+        schema_mgr: Any = None,
+        params: Optional[T.EvalParams] = None,
+        request_timeout_s: float = 30.0,
+        worker_label: str = "fe",
+        status_poll_s: float = 0.5,
+        connect_retry_s: float = 0.25,
+    ):
+        self.socket_path = socket_path
+        self.rule_table = rule_table
+        self.schema_mgr = schema_mgr
+        self.params = params or T.EvalParams()
+        self.request_timeout = request_timeout_s
+        self.worker_label = worker_label
+        self.status_poll_s = status_poll_s
+        self.connect_retry_s = connect_retry_s
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._next_id = 0
+        self._connected = threading.Event()
+        self._ever_ready = False
+        self._last_status: Optional[dict] = None
+        self._stop = False
+        self.stats = {"oracle_fallbacks": 0, "reconnects": 0, "checks": 0}
+        self._init_metrics()
+        self._conn_thread = threading.Thread(
+            target=self._connection_loop, daemon=True, name="ipc-client"
+        )
+        self._conn_thread.start()
+        self._status_thread = threading.Thread(
+            target=self._status_loop, daemon=True, name="ipc-client-status"
+        )
+        self._status_thread.start()
+
+    def _init_metrics(self) -> None:
+        from ..observability import metrics
+
+        reg = metrics()
+        self.m_rtt = reg.histogram(
+            "cerbos_tpu_ipc_client_rtt_seconds",
+            "front-end round trip through the shared batcher (encode to decode)",
+            buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0],
+        )
+        self.m_reconnects = reg.counter(
+            "cerbos_tpu_ipc_client_reconnects_total",
+            "times the front end (re)attached to the shared batcher",
+        )
+        # same family the in-process batcher exports, so existing fallback
+        # dashboards keep working against front-end processes
+        self.m_fallbacks = reg.counter_vec(
+            "cerbos_tpu_batcher_oracle_fallbacks_total",
+            "requests served from the CPU oracle instead of the device path, by reason",
+            label="reason",
+        )
+
+    # -- connection management ----------------------------------------------
+
+    def _connection_loop(self) -> None:
+        while not self._stop:
+            try:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.connect(self.socket_path)
+            except OSError:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                time.sleep(self.connect_retry_s)
+                continue
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
+            try:
+                _send_frame(
+                    sock, T_HELLO, 0, marshal.dumps({"worker": self.worker_label, "pid": os.getpid()})
+                )
+            except OSError:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                time.sleep(self.connect_retry_s)
+                continue
+            self._sock = sock
+            self._connected.set()
+            self.stats["reconnects"] += 1
+            self.m_reconnects.inc()
+            _log.info("attached to shared batcher at %s", self.socket_path)
+            try:
+                self._read_loop(sock)
+            except (IpcError, OSError):
+                pass
+            finally:
+                self._connected.clear()
+                self._sock = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._fail_all_pending(IpcDisconnected("shared batcher connection lost"))
+                if not self._stop:
+                    _log.warning(
+                        "shared batcher connection lost; serving from the CPU oracle "
+                        "until it returns"
+                    )
+            time.sleep(self.connect_retry_s)
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        while True:
+            mtype, req_id, payload = _recv_frame(sock)
+            with self._plock:
+                fut = self._pending.pop(req_id, None)
+            if fut is None:
+                continue  # abandoned (timed-out) ticket: drop the late reply
+            try:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_result((mtype, payload))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _fail_all_pending(self, err: Exception) -> None:
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            try:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(err)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _status_loop(self) -> None:
+        while not self._stop:
+            if self._connected.is_set():
+                try:
+                    mtype, payload = self._request(T_STATUS, b"", timeout=2.0)
+                    if mtype == T_STATUS_R:
+                        snap = marshal.loads(payload)
+                        self._last_status = snap
+                        if snap.get("status") in ("ready", "degraded"):
+                            self._ever_ready = True
+                except (IpcError, OSError, FutureTimeoutError, TimeoutError, ValueError):
+                    pass
+            time.sleep(self.status_poll_s)
+
+    # -- raw request/response -----------------------------------------------
+
+    def _register(self) -> tuple[int, Future]:
+        with self._plock:
+            self._next_id += 1
+            req_id = self._next_id
+            fut: Future = Future()
+            self._pending[req_id] = fut
+        return req_id, fut
+
+    def _unregister(self, req_id: int) -> None:
+        with self._plock:
+            self._pending.pop(req_id, None)
+
+    def _send(self, mtype: int, req_id: int, payload: bytes) -> None:
+        sock = self._sock
+        if sock is None:
+            raise IpcDisconnected("not attached to the shared batcher")
+        try:
+            with self._send_lock:
+                _send_frame(sock, mtype, req_id, payload)
+        except OSError as e:
+            raise IpcDisconnected(str(e)) from e
+
+    def _request(self, mtype: int, payload: bytes, timeout: float) -> tuple[int, bytes]:
+        req_id, fut = self._register()
+        try:
+            self._send(mtype, req_id, payload)
+            return fut.result(timeout=timeout)
+        finally:
+            self._unregister(req_id)
+
+    # -- oracle fallback ----------------------------------------------------
+
+    def _serve_oracle(
+        self, inputs: Sequence[T.CheckInput], params: Optional[T.EvalParams], reason: str
+    ) -> list[T.CheckOutput]:
+        self.stats["oracle_fallbacks"] += 1
+        self.m_fallbacks.inc(reason)
+        p = params or self.params
+        return [check_input(self.rule_table, i, p, self.schema_mgr) for i in inputs]
+
+    # -- check surface ------------------------------------------------------
+
+    def _encode_check(
+        self, inputs: Sequence[T.CheckInput], deadline: Optional[float]
+    ) -> Optional[bytes]:
+        deadline_rel = None
+        if deadline is not None:
+            deadline_rel = max(0.0, deadline - time.monotonic())
+        ctx = current_span_context()
+        traceparent = ctx.to_traceparent() if ctx is not None else ""
+        try:
+            return marshal.dumps((deadline_rel, traceparent, encode_inputs(inputs)))
+        except Exception:  # noqa: BLE001  (unmarshalable attr value: oracle handles it)
+            return None
+
+    def _wait_budget(self, deadline: Optional[float]) -> float:
+        wait = self.request_timeout
+        if deadline is not None:
+            wait = min(wait, max(0.0, deadline - time.monotonic()))
+        return wait
+
+    def _settle_reply(
+        self,
+        mtype: int,
+        payload: bytes,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams],
+    ) -> list[T.CheckOutput]:
+        if mtype == T_RESULT:
+            return decode_outputs(marshal.loads(payload))
+        if mtype == T_ERR:
+            reason = marshal.loads(payload)
+            if reason == "deadline":
+                raise DeadlineExceeded("request deadline expired in the shared batcher")
+            return self._serve_oracle(inputs, params, str(reason))
+        return self._serve_oracle(inputs, params, "protocol")
+
+    def check(
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
+    ) -> list[T.CheckOutput]:
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded("request deadline expired before evaluation")
+        self.stats["checks"] += 1
+        if not self._connected.is_set():
+            return self._serve_oracle(inputs, params, "batcher_down")
+        payload = self._encode_check(inputs, deadline)
+        if payload is None:
+            return self._serve_oracle(inputs, params, "codec")
+        t0 = time.perf_counter()
+        req_id, fut = self._register()
+        try:
+            self._send(T_CHECK, req_id, payload)
+            mtype, data = fut.result(timeout=self._wait_budget(deadline))
+        except IpcDisconnected:
+            self._unregister(req_id)
+            return self._serve_oracle(inputs, params, "batcher_down")
+        except (TimeoutError, FutureTimeoutError):
+            self._unregister(req_id)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DeadlineExceeded("request deadline expired while queued") from None
+            return self._serve_oracle(inputs, params, "ipc_timeout")
+        self._unregister(req_id)
+        self.m_rtt.observe(time.perf_counter() - t0)
+        return self._settle_reply(mtype, data, inputs, params)
+
+    async def check_await(
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
+    ) -> list[T.CheckOutput]:
+        """Event-loop-native check: awaits the reply future with zero
+        thread-pool hops; only degraded-path oracle work leaves the loop."""
+        loop = asyncio.get_running_loop()
+
+        def oracle(reason: str):
+            return loop.run_in_executor(None, self._serve_oracle, list(inputs), params, reason)
+
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded("request deadline expired before evaluation")
+        self.stats["checks"] += 1
+        if not self._connected.is_set():
+            return await oracle("batcher_down")
+        payload = self._encode_check(inputs, deadline)
+        if payload is None:
+            return await oracle("codec")
+        t0 = time.perf_counter()
+        req_id, fut = self._register()
+        try:
+            self._send(T_CHECK, req_id, payload)
+            mtype, data = await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=self._wait_budget(deadline)
+            )
+        except IpcDisconnected:
+            self._unregister(req_id)
+            return await oracle("batcher_down")
+        except asyncio.TimeoutError:
+            self._unregister(req_id)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DeadlineExceeded("request deadline expired while queued") from None
+            return await oracle("ipc_timeout")
+        self._unregister(req_id)
+        self.m_rtt.observe(time.perf_counter() - t0)
+        if mtype == T_RESULT:
+            return decode_outputs(marshal.loads(data))
+        if mtype == T_ERR:
+            reason = marshal.loads(data)
+            if reason == "deadline":
+                raise DeadlineExceeded("request deadline expired in the shared batcher")
+            return await oracle(str(reason))
+        return await oracle("protocol")
+
+    # -- pool observability surfaces ----------------------------------------
+
+    def remote_status(self) -> dict:
+        """Front-end readiness provider (engine/readiness.bind_remote):
+
+        - ``warming`` until the shared batcher has reported SERVING once
+          (its PR 5 warmup pre-compiles gate the whole pool's readiness);
+        - the batcher's own status (``ready``/``degraded``) while attached;
+        - ``degraded`` — live, oracle-serving — when the batcher is down or
+          re-warming after a respawn: a once-ready pool never 503s again.
+        """
+        last = self._last_status
+        if self._connected.is_set() and last is not None:
+            st = str(last.get("status", "ready"))
+            if st in ("ready", "degraded"):
+                return {**last, "status": st, "attached": True}
+            if not self._ever_ready:
+                return {**last, "status": "warming", "attached": True}
+            return {**last, "status": "degraded", "attached": True}
+        if not self._ever_ready:
+            return {"status": "warming", "attached": False}
+        return {"status": "degraded", "attached": False}
+
+    def fetch_flight(self, timeout: float = 5.0) -> dict:
+        """The PR 4 debug surface under the new topology: the flight
+        recorder lives in the batcher process; front ends fetch its dump."""
+        mtype, payload = self._request(T_FLIGHT, b"", timeout=timeout)
+        if mtype != T_FLIGHT_R:
+            raise IpcError("unexpected reply to flight request")
+        return marshal.loads(payload)
+
+    def fetch_metrics_text(self, timeout: float = 5.0) -> str:
+        mtype, payload = self._request(T_METRICS, b"", timeout=timeout)
+        if mtype != T_METRICS_R:
+            raise IpcError("unexpected reply to metrics request")
+        return payload.decode()
+
+    def refresh_table(self, rule_table: Any) -> None:
+        """Policy-reload hook: keep the local oracle on the latest table."""
+        self.rule_table = rule_table
+
+    def close(self) -> None:
+        self._stop = True
+        self._connected.clear()
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._fail_all_pending(IpcDisconnected("client closed"))
+
+
+def default_socket_path(config_val: str = "") -> str:
+    """Socket path resolution: config wins; otherwise a per-pool temp path
+    keyed by the supervisor pid (two pools on one host must not collide)."""
+    if config_val:
+        return config_val
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), f"cerbos-tpu-batcher-{os.getpid()}.sock")

@@ -57,6 +57,7 @@ class ReadinessState:
         self._warmup_error: Optional[str] = None
         self._warmed_at: Optional[float] = None
         self._health: Optional[Callable[[], str]] = None
+        self._remote: Optional[Callable[[], dict]] = None
         self.m_state.set(_STATUS_CODE["ready"])
 
     # -- transitions (driven by bootstrap / the warmup driver) -------------
@@ -93,9 +94,28 @@ class ReadinessState:
         breaker state string (``closed`` / ``open`` / ``half_open``)."""
         self._health = provider
 
+    def bind_remote(self, provider: Optional[Callable[[], dict]]) -> None:
+        """Front-end mode: this process has no device of its own — readiness
+        is the SHARED batcher process's readiness, fetched over the ticket
+        queue. ``provider`` returns a snapshot dict with at least
+        ``{"status": warming|ready|degraded}``; it overrides the local state
+        machine entirely (the local process never warms anything)."""
+        self._remote = provider
+
     # -- reads (servers, probes, tests) ------------------------------------
 
     def status(self) -> str:
+        remote = getattr(self, "_remote", None)
+        if remote is not None:
+            st = "degraded"
+            try:
+                st = str(remote().get("status", "degraded"))
+            except Exception:
+                pass
+            if st not in _STATUS_CODE:
+                st = "degraded"
+            self.m_state.set(_STATUS_CODE[st])
+            return st
         with self._lock:
             ready = self._ready
         st = "ready"
@@ -117,6 +137,19 @@ class ReadinessState:
         return self.status() != "warming"
 
     def snapshot(self) -> dict:
+        remote = getattr(self, "_remote", None)
+        if remote is not None:
+            snap: dict = {}
+            try:
+                snap = dict(remote())
+            except Exception:
+                pass
+            st = str(snap.get("status", "degraded"))
+            snap["status"] = st if st in _STATUS_CODE else "degraded"
+            snap.setdefault("attached", False)
+            snap["topology"] = "frontend"
+            self.m_state.set(_STATUS_CODE[snap["status"]])
+            return snap
         st = self.status()
         with self._lock:
             out = {

@@ -796,6 +796,52 @@ def metrics() -> MetricsRegistry:
     return _registry
 
 
+_SAMPLE_LINE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})?\s+(\S+)$")
+_FAMILY_COMMENT = re.compile(r"^# (TYPE|HELP) (\S+)")
+
+
+def relabel_metrics_text(text: str, label: str, value: str) -> str:
+    """Inject ``label="value"`` into every sample of a Prometheus text
+    exposition. Worker pools use this to stamp each process's scrape with
+    its identity: a scrape against the shared SO_REUSEPORT port lands on a
+    random sibling, and without the label its series would silently alias
+    the others' (docs/OBSERVABILITY.md, pooled scrape semantics)."""
+    esc = value.replace("\\", "\\\\").replace('"', '\\"')
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _SAMPLE_LINE.match(line)
+        if m is None:
+            out.append(line)
+            continue
+        name, labels, val = m.groups()
+        inner = labels[1:-1] if labels else ""
+        merged = f'{label}="{esc}"' + (f",{inner}" if inner else "")
+        out.append(f"{name}{{{merged}}} {val}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def merge_metrics_texts(primary: str, *others: str) -> str:
+    """Concatenate Prometheus text expositions, dropping ``# TYPE``/``# HELP``
+    lines for families the earlier texts already declared (duplicate family
+    metadata is invalid exposition). Samples are never dropped — callers must
+    have disambiguated them with :func:`relabel_metrics_text` first."""
+    seen: set[tuple[str, str]] = set()
+    out: list[str] = []
+    for text in (primary, *others):
+        for line in text.splitlines():
+            m = _FAMILY_COMMENT.match(line)
+            if m is not None:
+                key = (m.group(1), m.group(2))
+                if key in seen:
+                    continue
+                seen.add(key)
+            out.append(line)
+    return "\n".join(out) + ("\n" if out else "")
+
+
 _metrics_exporter: "OTLPMetricsExporter | None" = None
 
 

@@ -63,6 +63,10 @@ class ServerConfig:
     # the threaded sync server stays the default; multi-core deployments
     # wanting fewer threads per worker can flip server.grpcAsync
     grpc_async: bool = False
+    # worker-pool identity: stamped as a worker="..." label on every
+    # /_cerbos/metrics sample so a scrape that lands on a random
+    # SO_REUSEPORT sibling stays distinguishable (docs/OBSERVABILITY.md)
+    worker_label: str = ""
 
     def ssl_context(self):
         if not (self.tls_cert and self.tls_key):
@@ -637,7 +641,30 @@ class Server:
         """Flight-recorder dump: the last N device batches (trace ids, stage
         timings, occupancy, outcome) plus breaker/bisect/quarantine events.
         The persistent-XLA-cache status rides a response header so one curl
-        answers both "what just happened" and "is the compile cache live"."""
+        answers both "what just happened" and "is the compile cache live".
+
+        Front-end mode: the flight recorder (and breaker state) live in the
+        shared batcher process — fetch its dump over the ticket queue so the
+        debug surface keeps pointing at where device batches actually run.
+        A dead batcher falls back to the (empty) local ring with a note."""
+        ev = getattr(self.svc.engine, "tpu_evaluator", None)
+        if ev is not None and hasattr(ev, "fetch_flight"):
+            try:
+                remote = await asyncio.get_running_loop().run_in_executor(None, ev.fetch_flight)
+                body = dict(remote.get("flight") or {})
+                body["source"] = "batcher"
+                body["batcher_pid"] = remote.get("pid")
+                resp = web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+                if remote.get("jitcache") is not None:
+                    resp.headers["X-Cerbos-Jitcache"] = json.dumps(
+                        remote["jitcache"], default=str
+                    )
+                return resp
+            except Exception as e:  # noqa: BLE001
+                body = dict(flight_recorder().dump())
+                body["source"] = "frontend"
+                body["batcher_error"] = f"{type(e).__name__}: {e}"
+                return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
         resp = web.json_response(
             flight_recorder().dump(), dumps=lambda o: json.dumps(o, default=str)
         )
@@ -708,9 +735,29 @@ class Server:
             "# TYPE cerbos_dev_engine_check_batch_size_total counter",
             f"cerbos_dev_engine_check_batch_size_total {sum(m.batch_sizes)}",
         ]
+        from ..observability import merge_metrics_texts, relabel_metrics_text
         from ..observability import metrics as _obs_metrics
 
         body = "\n".join(lines) + "\n" + _obs_metrics().render()
+        label = self.config.worker_label
+        if label:
+            # pool mode: a scrape lands on whichever sibling the kernel picked;
+            # the worker label keeps per-process series distinguishable
+            body = relabel_metrics_text(body, "worker", label)
+            ev = getattr(self.svc.engine, "tpu_evaluator", None)
+            if ev is not None and hasattr(ev, "fetch_metrics_text"):
+                # front-end mode: append the shared batcher process's registry
+                # (batch sizes, occupancy, ipc queue depth) so one scrape sees
+                # the whole device path, not just this front end
+                try:
+                    remote = await asyncio.get_running_loop().run_in_executor(
+                        None, ev.fetch_metrics_text
+                    )
+                    body = merge_metrics_texts(
+                        body, relabel_metrics_text(remote, "worker", "batcher")
+                    )
+                except Exception:  # noqa: BLE001  (batcher down: local series only)
+                    pass
         return web.Response(text=body, content_type="text/plain")
 
     async def _h_check_resources(self, request: web.Request) -> web.Response:
@@ -728,7 +775,14 @@ class Server:
                 aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
             inputs, request_id, include_meta = convert.json_to_check_inputs(body, aux)
             trace_ctx = parse_traceparent(request.headers.get("traceparent"))
-            if self.config.direct_dispatch:
+            if getattr(self.svc.engine, "supports_async", False):
+                # front-end mode: the evaluator settles on this event loop
+                # (RemoteBatcherClient futures) — awaiting directly skips the
+                # per-request thread-pool hop entirely
+                outputs, call_id = await self.svc.check_resources_async(
+                    inputs, trace_ctx=trace_ctx
+                )
+            elif self.config.direct_dispatch:
                 outputs, call_id = self.svc.check_resources(inputs, trace_ctx=trace_ctx)
             else:
                 loop = asyncio.get_running_loop()

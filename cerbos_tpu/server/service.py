@@ -92,6 +92,22 @@ class CerbosService:
         deadline: Optional[float] = None,
         trace_ctx: Optional[SpanContext] = None,
     ) -> tuple[list[T.CheckOutput], str]:
+        self._validate_check(inputs)
+        call_id = uuid.uuid4().hex
+        t0 = time.perf_counter()
+        # trace_ctx is the caller's W3C traceparent (gRPC metadata / HTTP
+        # header); with parent=None this still roots a fresh local trace
+        with start_span(
+            "request.CheckResources", parent=trace_ctx, resources=len(inputs)
+        ) as span:
+            span.set_attribute("call_id", call_id)
+            outputs = self.engine.check(inputs, params=params, deadline=deadline)
+        self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
+        if self.audit_log is not None:
+            self.audit_log.write_decision(call_id, inputs, outputs)
+        return outputs, call_id
+
+    def _validate_check(self, inputs: list[T.CheckInput]) -> None:
         if len(inputs) > self.limits.max_resources_per_request:
             raise RequestLimitExceeded(
                 f"number of resources exceeds the limit of {self.limits.max_resources_per_request}"
@@ -103,15 +119,25 @@ class CerbosService:
                 )
             if not i.actions:
                 raise RequestLimitExceeded("at least one action must be specified")
+
+    async def check_resources_async(
+        self,
+        inputs: list[T.CheckInput],
+        params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
+        trace_ctx: Optional[SpanContext] = None,
+    ) -> tuple[list[T.CheckOutput], str]:
+        """``check_resources`` for evaluators that settle on the event loop
+        (front-end mode): the handler coroutine awaits the batcher ticket
+        directly — no thread-pool hop per request."""
+        self._validate_check(inputs)
         call_id = uuid.uuid4().hex
         t0 = time.perf_counter()
-        # trace_ctx is the caller's W3C traceparent (gRPC metadata / HTTP
-        # header); with parent=None this still roots a fresh local trace
         with start_span(
             "request.CheckResources", parent=trace_ctx, resources=len(inputs)
         ) as span:
             span.set_attribute("call_id", call_id)
-            outputs = self.engine.check(inputs, params=params, deadline=deadline)
+            outputs = await self.engine.check_await(inputs, params=params, deadline=deadline)
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
             self.audit_log.write_decision(call_id, inputs, outputs)

@@ -206,7 +206,7 @@ def run_server_pool(
         # them and pace the collector for the request path (util/gctune —
         # the serving-time analogue of the reference's GOGC handling)
         gctune.tune_for_serving()
-        server = build_server(core, config, http_addr, grpc_addr, True)
+        server = build_server(core, config, http_addr, grpc_addr, True, worker_label=f"w{idx}")
         try:
             if not stop["flag"]:
                 server.start()
@@ -221,4 +221,120 @@ def run_server_pool(
     if announce is not None:
         announce(http_addr, grpc_addr)
     pool = WorkerPool(n_workers, worker_main)
+    return pool.run()
+
+
+def run_frontdoor_pool(
+    config,
+    n_frontends: int,
+    build_server: Callable[..., object],
+    use_tpu: Optional[bool] = None,
+    announce=None,
+    post_fork: Optional[Callable[[], None]] = None,
+    post_init: Optional[Callable[[object], None]] = None,
+    pre_exit: Optional[Callable[[], None]] = None,
+) -> int:
+    """Boot the multi-process front door: N HTTP/gRPC front-end processes
+    feeding ONE shared batcher/evaluator process over the unix ticket queue
+    (`engine/ipc.py`).
+
+    The SO_REUSEPORT pool (`run_server_pool`) multiplies full PDPs — and
+    fragments device batches across N evaluators, N jit caches, N breakers.
+    This topology splits roles instead: worker slot 0 owns the device (the
+    only process that compiles or dispatches), slots 1..N are GIL-light
+    request parsers. The parent builds + lowers once and forks, so the rule
+    table and lowered tables are COW-shared three ways: the batcher
+    evaluates on them, and every front end keeps an oracle fallback over
+    the same pages for when the batcher is down, refusing (breaker open,
+    quarantine, queue full), or slow.
+
+    Supervision matches the pool: either role is restarted on death. A dead
+    batcher does NOT take the pool to 0/N — front ends flip to
+    degraded-but-live (oracle serving, `/_cerbos/ready` stays 200) until
+    the respawned batcher re-warms and re-attaches.
+    """
+    from ..bootstrap import build_batcher_ipc, initialize, prebuild
+    from ..engine.ipc import default_socket_path
+
+    server_conf = config.section("server")
+    http_addr = resolve_listen_addr(server_conf.get("httpListenAddr", "0.0.0.0:3592"))
+    grpc_addr = resolve_listen_addr(server_conf.get("grpcListenAddr", "0.0.0.0:3593"))
+
+    storage_conf = config.data.setdefault("storage", {})
+    if storage_conf.get("driver", "disk") == "disk":
+        storage_conf.setdefault("disk", {})["watchForChanges"] = True
+    # the batcher process is the device owner; its Core must carry the
+    # cross-request batcher for the ticket queue to feed
+    tpu_section = config.data.setdefault("engine", {}).setdefault("tpu", {})
+    tpu_section["requestBatching"] = True
+
+    shared_conf = tpu_section.get("sharedBatcher", {}) or {}
+    socket_path = default_socket_path(str(shared_conf.get("socketPath", "") or ""))
+
+    prebuilt = prebuild(config, use_tpu=use_tpu)
+
+    def batcher_main(respawn: bool) -> None:
+        stop = {"flag": False}
+
+        def on_term(signum, frame):
+            stop["flag"] = True
+
+        signal.signal(signal.SIGTERM, on_term)
+        if post_fork is not None:
+            post_fork()
+        core = initialize(config, use_tpu=use_tpu, prebuilt=None if respawn else prebuilt)
+        if post_init is not None:
+            post_init(core)
+        gctune.tune_for_serving()
+        ipc_server = build_batcher_ipc(core, socket_path)
+        try:
+            while not stop["flag"]:
+                time.sleep(0.2)
+        finally:
+            ipc_server.close()
+            core.close()
+            if pre_exit is not None:
+                pre_exit()
+
+    def frontend_main(idx: int, respawn: bool) -> None:
+        stop = {"flag": False}
+
+        def on_term(signum, frame):
+            stop["flag"] = True
+
+        signal.signal(signal.SIGTERM, on_term)
+        if post_fork is not None:
+            post_fork()
+        core = initialize(
+            config,
+            use_tpu=use_tpu,
+            prebuilt=None if respawn else prebuilt,
+            role="frontend",
+            ipc_socket=socket_path,
+            worker_label=f"fe{idx}",
+        )
+        if post_init is not None:
+            post_init(core)
+        gctune.tune_for_serving()
+        server = build_server(core, config, http_addr, grpc_addr, True, worker_label=f"fe{idx}")
+        try:
+            if not stop["flag"]:
+                server.start()
+            while not stop["flag"]:
+                time.sleep(0.2)
+        finally:
+            server.stop()
+            core.close()
+            if pre_exit is not None:
+                pre_exit()
+
+    def worker_main(idx: int, respawn: bool) -> None:
+        if idx == 0:
+            batcher_main(respawn)
+        else:
+            frontend_main(idx, respawn)
+
+    if announce is not None:
+        announce(http_addr, grpc_addr)
+    pool = WorkerPool(n_frontends + 1, worker_main)
     return pool.run()

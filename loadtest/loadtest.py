@@ -99,7 +99,9 @@ def _make_bodies(n_mods: int, n: int = 512, unique: bool = False) -> list[bytes]
     return bodies
 
 
-def spawn_server(policy_dir: str, workers: int, use_tpu: bool) -> tuple[subprocess.Popen, int, int]:
+def spawn_server(
+    policy_dir: str, workers: int, use_tpu: bool, frontends: int = 0
+) -> tuple[subprocess.Popen, int, int]:
     import base64
 
     import yaml
@@ -133,6 +135,9 @@ def spawn_server(policy_dir: str, workers: int, use_tpu: bool) -> tuple[subproce
         sys.executable, "-m", "cerbos_tpu.cli", "server",
         "--config", cfg_path, "--workers", str(workers),
     ]
+    if frontends:
+        # multi-process front door: N request processes + 1 shared batcher
+        cmd += ["--frontends", str(frontends)]
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env, cwd=REPO)
@@ -161,18 +166,21 @@ def spawn_server(policy_dir: str, workers: int, use_tpu: bool) -> tuple[subproce
     if not http_port:
         proc.terminate()
         raise RuntimeError("no serving announcement within 180 s")
-    # readiness poll
+    # readiness poll: /_cerbos/ready (not /health) so a warmup-gated pool —
+    # or a front-door pool waiting on its shared batcher — is actually warm
+    # before the timed window starts
     deadline = time.time() + 60
     ready = False
     while time.time() < deadline:
         try:
             s = socket.create_connection(("127.0.0.1", http_port), timeout=1)
-            s.sendall(b"GET /_cerbos/health HTTP/1.1\r\nHost: x\r\nConnection: keep-alive\r\n\r\n")
-            if b"200" in s.recv(4096):
+            s.sendall(b"GET /_cerbos/ready HTTP/1.1\r\nHost: x\r\nConnection: keep-alive\r\n\r\n")
+            if b" 200 " in s.recv(4096):
                 ready = True
                 s.close()
                 break
             s.close()
+            time.sleep(0.25)
         except OSError:
             time.sleep(0.25)
     if not ready:
@@ -222,10 +230,10 @@ def _read_http_response(sock: socket.socket, buf: bytearray) -> bytes:
         buf.extend(chunk)
 
 
-def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False) -> dict:
+def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0) -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
-    proc, http_port, grpc_port = spawn_server(tmp, workers, use_tpu)
+    proc, http_port, grpc_port = spawn_server(tmp, workers, use_tpu, frontends=frontends)
     # --cold: a large pool of per-request-unique bodies (unique attr values
     # and principal ids) so the server's value/shape/assembly memos miss;
     # once the run exhausts the pool, repeats re-warm — the pool is sized so
@@ -343,6 +351,15 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         "connections": connections,
         "workers": workers,
         "cold": cold,
+        # machine-readable worker topology (mirrors bench.py --served --json):
+        # frontends>0 means the multi-process front door (N request processes
+        # + 1 shared batcher over the unix ticket queue)
+        "topology": {
+            "mode": "frontdoor" if frontends else ("pool" if workers > 1 else "single"),
+            "workers": workers,
+            "frontends": frontends,
+            "shared_batcher": bool(frontends),
+        },
         "host_cores": len(os.sched_getaffinity(0)),
         "policies": n_mods * 9,  # 9 policy documents per name-mod
         "duration_s": round(elapsed, 1),
@@ -355,12 +372,31 @@ def main() -> None:
     ap.add_argument("--connections", type=int, default=8)
     ap.add_argument("--mods", type=int, default=100, help="policy name-mods (x9 policies each)")
     ap.add_argument("--workers", type=int, default=1, help="server worker processes")
+    ap.add_argument(
+        "--frontends",
+        type=int,
+        default=0,
+        help="front-end processes feeding one shared device batcher (0 = classic topology)",
+    )
     ap.add_argument("--grpc", action="store_true")
     ap.add_argument("--tpu", action="store_true", help="enable the TPU engine path")
     ap.add_argument("--cold", action="store_true", help="per-request-unique bodies (memo-cold)")
+    ap.add_argument(
+        "--json",
+        metavar="PATH",
+        default="",
+        help="also write the result artifact to PATH (CI-checkable, like bench.py --served --json)",
+    )
     args = ap.parse_args()
-    result = run(args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers, cold=args.cold)
+    result = run(
+        args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers,
+        cold=args.cold, frontends=args.frontends,
+    )
     print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
 
 
 if __name__ == "__main__":

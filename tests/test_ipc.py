@@ -1,0 +1,421 @@
+"""The multi-process front door's seam: the ticket queue (engine/ipc.py).
+
+In-process pairs of ``BatcherIpcServer`` (over a ``BatchingEvaluator`` backed
+by the CPU oracle) and ``RemoteBatcherClient`` on a temp unix socket prove the
+PR's acceptance criteria at the unit level: decision parity with the
+single-process path, deadline propagation across the process boundary,
+zero-loss settling when the batcher side dies mid-flight, backpressure and
+wedged-ring fallbacks, and the pool readiness ladder (warming until the shared
+batcher's first SERVING report, degraded-but-live after a disconnect).
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from cerbos_tpu.compile import compile_policy_set
+from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
+from cerbos_tpu.engine.batcher import BatchingEvaluator, DeadlineExceeded, _BatchFailed
+from cerbos_tpu.engine.health import DeviceHealth
+from cerbos_tpu.engine.ipc import (
+    BatcherIpcServer,
+    RemoteBatcherClient,
+    decode_inputs,
+    decode_outputs,
+    encode_inputs,
+    encode_outputs,
+)
+from cerbos_tpu.observability import merge_metrics_texts, relabel_metrics_text
+from cerbos_tpu.policy.parser import parse_policies
+from cerbos_tpu.ruletable import build_rule_table, check_input
+
+POLICY = """
+apiVersion: api.cerbos.dev/v1
+resourcePolicy:
+  resource: album
+  version: default
+  rules:
+    - actions: ["view"]
+      effect: EFFECT_ALLOW
+      roles: [user]
+      condition:
+        match:
+          expr: request.resource.attr.owner == request.principal.id || request.resource.attr.public == true
+    - actions: ["*"]
+      effect: EFFECT_ALLOW
+      roles: [admin]
+"""
+
+
+def table():
+    return build_rule_table(compile_policy_set(list(parse_policies(POLICY))))
+
+
+def inp(i: int, **attr) -> CheckInput:
+    return CheckInput(
+        principal=Principal(id=f"u{i}", roles=["user"]),
+        resource=Resource(
+            kind="album",
+            id=f"a{i}",
+            attr={"owner": f"u{i % 7}", "public": i % 3 == 0, **attr},
+        ),
+        actions=["view"],
+        request_id=f"rq{i}",
+    )
+
+
+def effects(outs):
+    return [{a: (e.effect, e.policy) for a, e in o.actions.items()} for o in outs]
+
+
+def oracle(rt, inputs, params=None):
+    return [check_input(rt, i, params or EvalParams()) for i in inputs]
+
+
+class OracleEvaluator:
+    """CPU-oracle-backed streaming evaluator (the test_chaos harness): the
+    ticket queue's behavior must not depend on jax being importable."""
+
+    def __init__(self, rt, submit_delay_s: float = 0.0):
+        self.rule_table = rt
+        self.schema_mgr = None
+        self.submit_delay_s = submit_delay_s
+        self.stats = {"device_inputs": 0}
+
+    def check(self, inputs, params=None):
+        return oracle(self.rule_table, inputs, params)
+
+    def submit(self, inputs, params=None):
+        if self.submit_delay_s:
+            time.sleep(self.submit_delay_s)
+        self.stats["device_inputs"] += len(inputs)
+        return self.check(inputs, params)
+
+    def collect(self, ticket):
+        return ticket
+
+
+def wait_for(cond, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def rt():
+    return table()
+
+
+def make_pair(
+    tmp_path,
+    rt,
+    submit_delay_s=0.0,
+    readiness=None,
+    max_outstanding=4096,
+    faults=None,
+    health=None,
+    request_timeout_s=30.0,
+):
+    batcher = BatchingEvaluator(
+        OracleEvaluator(rt, submit_delay_s=submit_delay_s), max_wait_ms=1.0, health=health
+    )
+    server = BatcherIpcServer(
+        str(tmp_path / "batcher.sock"),
+        batcher,
+        readiness=readiness,
+        max_outstanding=max_outstanding,
+        faults=faults,
+    )
+    server.start()
+    client = RemoteBatcherClient(
+        server.socket_path,
+        rt,
+        request_timeout_s=request_timeout_s,
+        worker_label="fe-test",
+        status_poll_s=0.05,
+        connect_retry_s=0.05,
+    )
+    assert wait_for(client._connected.is_set)
+    return batcher, server, client
+
+
+class TestCodec:
+    def test_inputs_roundtrip(self, rt):
+        inputs = [inp(i) for i in range(7)]
+        decoded = decode_inputs(encode_inputs(inputs))
+        assert effects(oracle(rt, decoded)) == effects(oracle(rt, inputs))
+        assert [d.request_id for d in decoded] == [i.request_id for i in inputs]
+        # attrs arrive pre-normalized: no __post_init__ re-run on decode
+        assert decoded[0].principal.id == "u0"
+        assert decoded[3].resource.attr["public"] is True
+
+    def test_outputs_roundtrip(self, rt):
+        outs = oracle(rt, [inp(i) for i in range(7)])
+        decoded = decode_outputs(encode_outputs(outs))
+        assert effects(decoded) == effects(outs)
+        assert [d.resource_id for d in decoded] == [o.resource_id for o in outs]
+
+
+class TestTicketQueue:
+    def test_decision_parity_with_single_process_path(self, tmp_path, rt):
+        """Acceptance pin: the multi-process path must produce bit-identical
+        decisions to the single-process batcher/oracle path."""
+        batcher, server, client = make_pair(tmp_path, rt)
+        try:
+            inputs = [inp(i) for i in range(64)]
+            remote = client.check(inputs)
+            assert effects(remote) == effects(batcher.check(inputs))
+            assert effects(remote) == effects(oracle(rt, inputs))
+            assert client.stats["oracle_fallbacks"] == 0
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+    def test_check_await_parity(self, tmp_path, rt):
+        batcher, server, client = make_pair(tmp_path, rt)
+        try:
+            inputs = [inp(i) for i in range(16)]
+
+            async def go():
+                return await client.check_await(inputs)
+
+            remote = asyncio.run(go())
+            assert effects(remote) == effects(oracle(rt, inputs))
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+    def test_expired_deadline_raises(self, tmp_path, rt):
+        batcher, server, client = make_pair(tmp_path, rt)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                client.check([inp(1)], deadline=time.monotonic() - 0.01)
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+    def test_deadline_crosses_process_boundary(self, tmp_path, rt):
+        """The deadline rides the ticket as relative remaining time and the
+        batcher drops expired work at drain time."""
+        batcher, server, client = make_pair(tmp_path, rt, submit_delay_s=0.3)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                # saturate the drain loop so the second ticket expires queued
+                t = threading.Thread(target=lambda: client.check([inp(0)]))
+                t.start()
+                try:
+                    client.check([inp(1)], deadline=time.monotonic() + 0.05)
+                finally:
+                    t.join()
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+    def test_batcher_down_serves_oracle_fast(self, tmp_path, rt):
+        client = RemoteBatcherClient(
+            str(tmp_path / "nobody-home.sock"),
+            rt,
+            status_poll_s=0.05,
+            connect_retry_s=0.05,
+        )
+        try:
+            t0 = time.perf_counter()
+            outs = client.check([inp(i) for i in range(8)])
+            # no connection: the fallback must not wait out any timeout
+            assert time.perf_counter() - t0 < 1.0
+            assert effects(outs) == effects(oracle(rt, [inp(i) for i in range(8)]))
+            assert client.stats["oracle_fallbacks"] == 1
+        finally:
+            client.close()
+
+    def test_midflight_death_loses_zero_requests(self, tmp_path, rt):
+        """Kill the batcher side with tickets in flight: every waiter must
+        settle promptly via the local oracle with correct decisions."""
+        batcher, server, client = make_pair(tmp_path, rt, submit_delay_s=0.5)
+        results = {}
+
+        def one(i):
+            results[i] = client.check([inp(i)])
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+        try:
+            for t in threads:
+                t.start()
+            assert wait_for(lambda: len(client._pending) > 0)
+            server.close()
+            batcher.close()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join(timeout=10.0)
+            assert all(not t.is_alive() for t in threads)
+            # settled by the disconnect, not by the 30s request timeout
+            assert time.perf_counter() - t0 < 10.0
+            assert len(results) == 12
+            for i, outs in results.items():
+                assert effects(outs) == effects(oracle(rt, [inp(i)]))
+        finally:
+            client.close()
+
+    def test_breaker_open_refusal_serves_frontend_oracle(self, tmp_path, rt):
+        health = DeviceHealth(failure_threshold=1)
+        health.record_failure()
+        assert health.state == "open"
+        batcher, server, client = make_pair(tmp_path, rt, health=health)
+        try:
+            outs = client.check([inp(i) for i in range(4)])
+            assert effects(outs) == effects(oracle(rt, [inp(i) for i in range(4)]))
+            assert client.stats["oracle_fallbacks"] == 1
+            # the refusal reason travels back over the queue
+            assert client.m_fallbacks.get("breaker_open") >= 1
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+    def test_wedged_ring_falls_back_via_timeout(self, tmp_path, rt):
+        batcher, server, client = make_pair(
+            tmp_path, rt, faults={"ipc_wedge_after": 1}, request_timeout_s=0.3
+        )
+        try:
+            assert effects(client.check([inp(0)])) == effects(oracle(rt, [inp(0)]))
+            t0 = time.perf_counter()
+            outs = client.check([inp(1)])
+            assert 0.2 < time.perf_counter() - t0 < 5.0
+            assert effects(outs) == effects(oracle(rt, [inp(1)]))
+            assert server.stats["wedged_drops"] >= 1
+            assert client.stats["oracle_fallbacks"] == 1
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+    def test_full_queue_backpressure(self, tmp_path, rt):
+        batcher, server, client = make_pair(tmp_path, rt, submit_delay_s=0.3, max_outstanding=1)
+        try:
+            t = threading.Thread(target=lambda: client.check([inp(0)]))
+            t.start()
+            assert wait_for(lambda: server._outstanding >= 1)
+            outs = client.check([inp(1)])
+            t.join()
+            assert effects(outs) == effects(oracle(rt, [inp(1)]))
+            assert server.stats["rejected_full"] >= 1
+            assert server.m_full.value >= 1
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+
+class TestPoolReadiness:
+    def test_warming_until_first_ready_then_degraded_on_disconnect(self, tmp_path, rt):
+        status = {"status": "warming"}
+        batcher, server, client = make_pair(tmp_path, rt, readiness=lambda: dict(status))
+        try:
+            assert wait_for(lambda: client._last_status is not None)
+            assert client.remote_status()["status"] == "warming"
+            # batcher warmup completes → the pool opens
+            status["status"] = "ready"
+            assert wait_for(lambda: client.remote_status()["status"] == "ready")
+            # batcher dies → degraded-but-live, never back to warming
+            server.close()
+            batcher.close()
+            assert wait_for(lambda: client.remote_status()["status"] == "degraded")
+            assert client.remote_status()["attached"] is False
+        finally:
+            client.close()
+
+    def test_never_attached_reports_warming(self, tmp_path, rt):
+        client = RemoteBatcherClient(
+            str(tmp_path / "nobody-home.sock"), rt, status_poll_s=0.05, connect_retry_s=0.05
+        )
+        try:
+            assert client.remote_status()["status"] == "warming"
+        finally:
+            client.close()
+
+
+class TestControlFrames:
+    def test_flight_and_metrics_frames(self, tmp_path, rt):
+        batcher, server, client = make_pair(tmp_path, rt, readiness=lambda: {"status": "ready"})
+        try:
+            client.check([inp(i) for i in range(8)])
+            dump = client.fetch_flight()
+            assert "flight" in dump and "pid" in dump
+            assert {"capacity", "batches", "events"} <= set(dump["flight"])
+            text = client.fetch_metrics_text()
+            assert "cerbos_tpu_ipc_ring_depth" in text
+            assert "cerbos_tpu_batcher_batches_total" in text
+        finally:
+            client.close()
+            server.close()
+            batcher.close()
+
+
+class TestCheckAsync:
+    """BatchingEvaluator.check_async refuses via the settled future so the
+    front-end process (not the batcher) serves the oracle."""
+
+    def test_settles_with_result(self, rt):
+        b = BatchingEvaluator(OracleEvaluator(rt), max_wait_ms=1.0)
+        try:
+            fut = b.check_async([inp(i) for i in range(4)])
+            outs = fut.result(timeout=5.0)
+            assert effects(outs) == effects(oracle(rt, [inp(i) for i in range(4)]))
+        finally:
+            b.close()
+
+    def test_expired_deadline_settles_exception(self, rt):
+        b = BatchingEvaluator(OracleEvaluator(rt), max_wait_ms=1.0)
+        try:
+            fut = b.check_async([inp(0)], deadline=time.monotonic() - 1.0)
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=1.0)
+        finally:
+            b.close()
+
+    def test_breaker_open_settles_batch_failed(self, rt):
+        health = DeviceHealth(failure_threshold=1)
+        health.record_failure()
+        b = BatchingEvaluator(OracleEvaluator(rt), max_wait_ms=1.0, health=health)
+        try:
+            fut = b.check_async([inp(0)])
+            with pytest.raises(_BatchFailed) as ei:
+                fut.result(timeout=1.0)
+            assert ei.value.reason == "breaker_open"
+        finally:
+            b.close()
+
+    def test_closed_batcher_settles_dead(self, rt):
+        b = BatchingEvaluator(OracleEvaluator(rt), max_wait_ms=1.0)
+        b.close()
+        fut = b.check_async([inp(0)])
+        with pytest.raises(_BatchFailed) as ei:
+            fut.result(timeout=1.0)
+        assert ei.value.reason == "batcher_dead"
+
+
+class TestMetricsRelabel:
+    def test_relabel_injects_worker_label(self):
+        text = '# TYPE a counter\na 1\nb{x="1"} 2\n'
+        out = relabel_metrics_text(text, "worker", "fe1")
+        assert 'a{worker="fe1"} 1' in out
+        assert 'b{worker="fe1",x="1"} 2' in out
+        assert "# TYPE a counter" in out
+
+    def test_merge_dedupes_family_comments(self):
+        a = "# TYPE m counter\n# HELP m help\nm{worker=\"fe1\"} 1\n"
+        b = "# TYPE m counter\n# HELP m help\nm{worker=\"batcher\"} 2\n"
+        merged = merge_metrics_texts(a, b)
+        assert merged.count("# TYPE m counter") == 1
+        assert merged.count("# HELP m help") == 1
+        assert 'm{worker="fe1"} 1' in merged
+        assert 'm{worker="batcher"} 2' in merged

@@ -9,7 +9,9 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -147,3 +149,160 @@ def test_pool_shuts_down_cleanly(pool):
     proc, port = pool
     proc.terminate()
     assert proc.wait(timeout=20) == 0
+
+
+# -- multi-process front door: N front ends + 1 shared batcher ---------------
+
+
+def _get(port: int, path: str, timeout: float = 5.0):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _batcher_pid(port: int) -> int:
+    """The batcher process self-identifies through the routed flight dump."""
+    status, body = _get(port, "/_cerbos/debug/flight")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc.get("source") == "batcher", doc
+    return int(doc["batcher_pid"])
+
+
+@pytest.fixture(scope="module")
+def frontdoor(tmp_path_factory):
+    """Real CLI boot of the PR 6 topology: 2 HTTP front-end processes feeding
+    one shared batcher process over the unix ticket queue (numpy device
+    backend so the subprocess boots fast and jax-free)."""
+    policy_dir = tmp_path_factory.mktemp("policies")
+    (policy_dir / "album.yaml").write_text(POLICY)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "cerbos_tpu.cli", "server",
+            "--frontends", "2",
+            "--set", f"storage.disk.directory={policy_dir}",
+            "--set", "server.httpListenAddr=127.0.0.1:0",
+            "--set", "server.grpcListenAddr=127.0.0.1:0",
+            "--set", "engine.tpu.backend=numpy",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    http_port = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("cerbos-tpu serving:"):
+            for tok in line.split():
+                if tok.startswith("http="):
+                    http_port = int(tok.split("=")[1])
+            break
+    assert http_port, "front door never announced its ports"
+    deadline = time.time() + 60
+    last_err = None
+    while time.time() < deadline:
+        try:
+            _check(http_port)
+            break
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            time.sleep(0.25)
+    else:
+        proc.terminate()
+        raise AssertionError(f"front door never became ready: {last_err}")
+    yield proc, http_port
+    if proc.poll() is None:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
+def test_frontdoor_serves_decisions(frontdoor):
+    proc, port = frontdoor
+    for _ in range(10):
+        resp = _check(port)
+        actions = resp["results"][0]["actions"]
+        assert actions["view"] == "EFFECT_ALLOW"
+        assert actions["delete"] == "EFFECT_DENY"
+
+
+def test_frontdoor_topology(frontdoor):
+    proc, port = frontdoor
+    # 2 front ends + 1 batcher
+    assert len(_worker_pids(proc.pid)) == 3
+    assert _batcher_pid(port) in _worker_pids(proc.pid)
+
+
+def test_frontdoor_ready_and_worker_labeled_metrics(frontdoor):
+    proc, port = frontdoor
+    status, body = _get(port, "/_cerbos/ready")
+    assert status == 200
+    assert json.loads(body)["status"] in ("ready", "degraded")
+    # one scrape sees this front end's series AND the batcher process's
+    # (ipc queue depth et al), each stamped with its worker identity
+    _check(port)
+    status, body = _get(port, "/_cerbos/metrics")
+    assert status == 200
+    text = body.decode()
+    assert 'worker="fe' in text
+    assert 'worker="batcher"' in text
+    assert "cerbos_tpu_ipc_ring_depth" in text
+
+
+def test_frontdoor_batcher_sigkill_midload_loses_zero_requests(frontdoor):
+    """The PR's chaos acceptance: SIGKILL the batcher process under live
+    traffic — every request settles (front ends fall back to their
+    COW-shared oracle), readiness stays live, the supervisor respawns the
+    batcher, and the ticket queue re-attaches."""
+    proc, port = frontdoor
+    victim = _batcher_pid(port)
+    results = {"ok": 0, "bad": []}
+    stop_at = time.time() + 6.0
+
+    def hammer():
+        while time.time() < stop_at:
+            try:
+                resp = _check(port, timeout=10.0)
+                if resp["results"][0]["actions"]["view"] == "EFFECT_ALLOW":
+                    results["ok"] += 1
+                else:
+                    results["bad"].append(resp)
+            except Exception as e:  # noqa: BLE001
+                results["bad"].append(repr(e))
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    os.kill(victim, signal.SIGKILL)
+    # while the batcher is down/respawning, front ends stay live (degraded
+    # serves from the oracle) — readiness must NOT flip back to 503
+    status, body = _get(port, "/_cerbos/ready")
+    assert status == 200
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not results["bad"], f"lost/failed requests: {results['bad'][:5]}"
+    assert results["ok"] > 0
+    # the supervisor replaced the batcher and the queue re-attached
+    deadline = time.time() + 30
+    new_pid = None
+    while time.time() < deadline:
+        try:
+            new_pid = _batcher_pid(port)
+            if new_pid != victim:
+                break
+        except AssertionError:
+            pass
+        time.sleep(0.5)
+    assert new_pid is not None and new_pid != victim, "batcher was not respawned"
+    status, body = _get(port, "/_cerbos/ready")
+    assert status == 200
