@@ -206,24 +206,52 @@ def index_only_main(smoke: bool) -> int:
     return 0 if parity_ok else 1
 
 
+def _merged_percentile(buckets: list, counts: list, count: int, p: float) -> float:
+    """Histogram.percentile over shard-merged bucket counts."""
+    if count == 0:
+        return 0.0
+    rank = p * count
+    cum = 0
+    lo = 0.0
+    for i, b in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            frac = (rank - prev) / counts[i] if counts[i] else 0.0
+            return lo + (b - lo) * frac
+        lo = b
+    return buckets[-1] if buckets else 0.0
+
+
 def _stage_percentiles() -> dict:
     """Per-stage p50/p99 from the batcher's cerbos_tpu_batch_stage_seconds
-    HistogramVec, for the machine-readable perf artifact."""
+    HistogramVec, for the machine-readable perf artifact. Children are keyed
+    (stage, shard) since the sharded pool; shards merge into one per-stage
+    summary here (the per-shard split lives in the topology block)."""
     from cerbos_tpu.observability import metrics
 
     vec = metrics().instruments().get("cerbos_tpu_batch_stage_seconds")
     if vec is None:
         return {}
-    stages = {}
     with vec._lock:
         children = dict(vec._children)
-    for stage, hist in sorted(children.items()):
-        _, total, count = hist.snapshot()
+    merged: dict = {}
+    for key, hist in children.items():
+        stage = key[0] if isinstance(key, tuple) else str(key)
+        counts, total, count = hist.snapshot()
+        m = merged.setdefault(
+            stage, {"counts": [0] * len(counts), "sum": 0.0, "count": 0, "buckets": hist.buckets}
+        )
+        m["counts"] = [a + b for a, b in zip(m["counts"], counts)]
+        m["sum"] += total
+        m["count"] += count
+    stages = {}
+    for stage, m in sorted(merged.items()):
         stages[stage] = {
-            "p50_s": round(hist.percentile(0.50), 6),
-            "p99_s": round(hist.percentile(0.99), 6),
-            "mean_s": round(total / count, 6) if count else 0.0,
-            "count": count,
+            "p50_s": round(_merged_percentile(m["buckets"], m["counts"], m["count"], 0.50), 6),
+            "p99_s": round(_merged_percentile(m["buckets"], m["counts"], m["count"], 0.99), 6),
+            "mean_s": round(m["sum"] / m["count"], 6) if m["count"] else 0.0,
+            "count": m["count"],
         }
     return stages
 
@@ -243,7 +271,7 @@ def _compile_economy() -> dict:
     }
 
 
-def served_main(smoke: bool, json_path: str = "") -> int:
+def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str = "least_loaded") -> int:
     """--served: throughput through the real serving path (BatchingEvaluator).
 
     The direct-evaluator numbers above measure the device backend in
@@ -253,6 +281,11 @@ def served_main(smoke: bool, json_path: str = "") -> int:
     batches and streams them through submit/collect with several batches in
     flight. Reports decisions/sec plus the batcher's own pipeline stats —
     ``inflight_peak`` ≥ 2 is the signature that streaming engaged.
+
+    ``--shards N`` fronts N sharded batcher lanes (one device-pinned
+    evaluator clone each, see engine/shards.py) instead of the single
+    batcher, and adds a ``topology`` block to the artifact: per-shard
+    decisions/s, occupancy, and routing-imbalance.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -272,10 +305,25 @@ def served_main(smoke: bool, json_path: str = "") -> int:
     rt = build_rule_table(compile_policy_set(policies))
     params = EvalParams()
     ev = TpuEvaluator(rt, use_jax=jax_ok)
-    health = DeviceHealth()
-    batcher = BatchingEvaluator(
-        ev, max_batch=1024, max_wait_ms=2.0, min_batch_to_wait=8, max_inflight=3, health=health
-    )
+    sharded_pool = None
+    if shards and shards != 1:
+        from cerbos_tpu.engine.shards import build_shard_pool
+
+        sharded_pool = build_shard_pool(
+            ev,
+            n_shards=0 if shards < 0 else shards,
+            routing=routing,
+            max_batch=1024,
+            max_wait_ms=2.0,
+        )
+        health = None
+        batcher = sharded_pool
+        print(f"sharded pool: {len(sharded_pool.shards)} lanes, routing={routing}", flush=True)
+    else:
+        health = DeviceHealth()
+        batcher = BatchingEvaluator(
+            ev, max_batch=1024, max_wait_ms=2.0, min_batch_to_wait=8, max_inflight=3, health=health
+        )
 
     req_size = 4  # inputs per client request (the classic template's shape)
     n_clients = 16 if smoke else 64
@@ -302,6 +350,14 @@ def served_main(smoke: bool, json_path: str = "") -> int:
     )
     assert allow > 0, "served workload produced no allows — corpus is broken"
     rate = decisions_per_round * n_rounds / wall
+    if sharded_pool is not None:
+        trips = sum(s["breaker_trips"] for s in sharded_pool.shard_stats())
+        occupancy = max(lane.m_occupancy.value for lane in sharded_pool.shards)
+        padding_waste = sum(lane.m_padding_waste.value for lane in sharded_pool.shards)
+    else:
+        trips = health.stats["trips"]
+        occupancy = batcher.m_occupancy.value
+        padding_waste = batcher.m_padding_waste.value
     record = {
         "metric": "served_decisions_per_sec",
         "value": round(rate, 1),
@@ -311,20 +367,35 @@ def served_main(smoke: bool, json_path: str = "") -> int:
         "request_size": req_size,
         "vs_baseline": round(rate / REFERENCE_DECISIONS_PER_SEC, 2),
         "batcher": dict(batcher.stats),
-        "breaker_trips": health.stats["trips"],
+        "breaker_trips": trips,
         "oracle_fallbacks": batcher.stats["oracle_fallbacks"],
         "deadline_drops": batcher.stats["deadline_drops"],
         # per-stage latency attribution + device-layout economics from the
         # observability layer (the same series /_cerbos/metrics exposes)
         "stages": _stage_percentiles(),
-        "occupancy": batcher.m_occupancy.value,
-        "padding_waste_rows": batcher.m_padding_waste.value,
+        "occupancy": occupancy,
+        "padding_waste_rows": padding_waste,
         "compile": _compile_economy(),
         "probe": tpu_probe.summarize(evidence),
     }
+    if sharded_pool is not None:
+        # per-shard share of the measured rate: routed requests carry equal
+        # decision counts on average, so the split follows the routing counts
+        total_routed = sum(sharded_pool.routed) or 1
+        per_shard = []
+        for s in sharded_pool.shard_stats():
+            s["dec_per_sec_est"] = round(rate * s["routed"] / total_routed, 1)
+            per_shard.append(s)
+        imb = sharded_pool.routing_imbalance()
+        record["topology"] = {
+            "shards": len(sharded_pool.shards),
+            "routing": sharded_pool.routing,
+            "routing_imbalance": round(imb, 3) if imb != float("inf") else "inf",
+            "per_shard": per_shard,
+        }
     print(
         "robustness: breaker_trips=%d oracle_fallbacks=%d deadline_drops=%d"
-        % (health.stats["trips"], batcher.stats["oracle_fallbacks"], batcher.stats["deadline_drops"]),
+        % (trips, batcher.stats["oracle_fallbacks"], batcher.stats["deadline_drops"]),
         flush=True,
     )
     print(json.dumps(record))
@@ -356,11 +427,21 @@ def main() -> None:
         help="with --served: also write the JSON record to PATH "
         "(machine-readable perf artifact, e.g. BENCH_SERVED.json)",
     )
+    parser.add_argument(
+        "--shards", type=int, default=0, metavar="N",
+        help="with --served: front N sharded batcher lanes (one device-pinned "
+        "evaluator clone each) instead of the single batcher; -1 = one per "
+        "visible device; 0/1 = single-batcher path",
+    )
+    parser.add_argument(
+        "--routing", default="least_loaded", choices=["least_loaded", "round_robin"],
+        help="with --served --shards: request routing policy across lanes",
+    )
     args = parser.parse_args()
     if args.index_only:
         sys.exit(index_only_main(smoke=args.smoke))
     if args.served:
-        sys.exit(served_main(smoke=args.smoke, json_path=args.json))
+        sys.exit(served_main(smoke=args.smoke, json_path=args.json, shards=args.shards, routing=args.routing))
 
     evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
     probe = tpu_probe.probe_ladder(attempts=1)

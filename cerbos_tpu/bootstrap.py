@@ -210,34 +210,79 @@ def initialize(
         fault_spec = _os.environ.get("CERBOS_TPU_FAULTS", "") or str(
             tpu_conf.get("faults", "") or ""
         )
-        if fault_spec:
-            from .engine.faults import FaultInjector
+        mesh_conf = tpu_conf.get("mesh", {}) or {}
+        shards_knob = mesh_conf.get("shards", 0)
+        n_shards = 0
+        if str(shards_knob).strip().lower() == "auto":
+            n_shards = -1  # one shard per visible device
+        elif shards_knob:
+            n_shards = int(shards_knob)
+        sharded = (
+            tpu_conf.get("requestBatching", True)
+            and (n_shards == -1 or n_shards > 1)
+            and hasattr(tpu_evaluator, "shard_clone")
+        )
+        if sharded:
+            # sharded serving pool: one batcher lane per device shard, each
+            # with its own breaker/quarantine/flight lane; faults (optionally
+            # shard-scoped via the shard:N knob) wrap inside the lane
+            from .engine.shards import build_shard_pool
 
-            dispatch_evaluator = FaultInjector(tpu_evaluator, fault_spec)
-        if tpu_conf.get("requestBatching", True):
-            from .engine.batcher import BatchingEvaluator, DeviceHealth
-
-            breaker_conf = tpu_conf.get("breaker", {}) or {}
-            health = DeviceHealth(
-                failure_threshold=int(breaker_conf.get("failureThreshold", 5)),
-                timeout_rate_threshold=float(breaker_conf.get("timeoutRateThreshold", 0.5)),
-                timeout_window_s=float(breaker_conf.get("timeoutWindowSeconds", 30)),
-                timeout_min_samples=int(breaker_conf.get("timeoutMinSamples", 10)),
-                probe_backoff_base_s=float(breaker_conf.get("probeBackoffBaseMs", 500)) / 1000.0,
-                probe_backoff_cap_s=float(breaker_conf.get("probeBackoffCapMs", 30000)) / 1000.0,
-                probe_timeout_s=float(breaker_conf.get("probeTimeoutMs", 5000)) / 1000.0,
-                enabled=bool(breaker_conf.get("enabled", True)),
-            )
-            batcher = BatchingEvaluator(
-                dispatch_evaluator,
+            batcher = build_shard_pool(
+                tpu_evaluator,
+                n_shards=0 if n_shards == -1 else n_shards,
+                per_shard_inflight=int(mesh_conf.get("perShardInflight", 0)),
+                routing=str(mesh_conf.get("routing", "least_loaded")),
                 max_batch=int(tpu_conf.get("maxBatch", 4096)),
                 max_wait_ms=float(tpu_conf.get("batchWindowMs", 2.0)),
                 request_timeout_s=float(tpu_conf.get("requestTimeoutMs", 30000)) / 1000.0,
-                max_inflight=int(tpu_conf.get("inflightDepth", 3)),
-                health=health,
+                inflight_depth=int(tpu_conf.get("inflightDepth", 3)),
                 quarantine_max=int(tpu_conf.get("quarantineMax", 128)),
+                breaker_conf=tpu_conf.get("breaker", {}) or {},
+                fault_spec=fault_spec,
             )
             dispatch_evaluator = batcher
+
+            _shards_prev = manager.on_swap
+
+            def _shards_swap(rt, _pool=batcher) -> None:
+                # the base evaluator's refresh hook re-lowers the SHARED
+                # table first; then the clones only need their table pointer
+                # + derived caches refreshed
+                if _shards_prev is not None:
+                    _shards_prev(rt)
+                _pool.refresh_shards(rt)
+
+            manager.on_swap = _shards_swap
+        else:
+            if fault_spec:
+                from .engine.faults import FaultInjector
+
+                dispatch_evaluator = FaultInjector(tpu_evaluator, fault_spec)
+            if tpu_conf.get("requestBatching", True):
+                from .engine.batcher import BatchingEvaluator, DeviceHealth
+
+                breaker_conf = tpu_conf.get("breaker", {}) or {}
+                health = DeviceHealth(
+                    failure_threshold=int(breaker_conf.get("failureThreshold", 5)),
+                    timeout_rate_threshold=float(breaker_conf.get("timeoutRateThreshold", 0.5)),
+                    timeout_window_s=float(breaker_conf.get("timeoutWindowSeconds", 30)),
+                    timeout_min_samples=int(breaker_conf.get("timeoutMinSamples", 10)),
+                    probe_backoff_base_s=float(breaker_conf.get("probeBackoffBaseMs", 500)) / 1000.0,
+                    probe_backoff_cap_s=float(breaker_conf.get("probeBackoffCapMs", 30000)) / 1000.0,
+                    probe_timeout_s=float(breaker_conf.get("probeTimeoutMs", 5000)) / 1000.0,
+                    enabled=bool(breaker_conf.get("enabled", True)),
+                )
+                batcher = BatchingEvaluator(
+                    dispatch_evaluator,
+                    max_batch=int(tpu_conf.get("maxBatch", 4096)),
+                    max_wait_ms=float(tpu_conf.get("batchWindowMs", 2.0)),
+                    request_timeout_s=float(tpu_conf.get("requestTimeoutMs", 30000)) / 1000.0,
+                    max_inflight=int(tpu_conf.get("inflightDepth", 3)),
+                    health=health,
+                    quarantine_max=int(tpu_conf.get("quarantineMax", 128)),
+                )
+                dispatch_evaluator = batcher
 
     # readiness (split from liveness) + the compile-economy warmup driver:
     # /_cerbos/ready and the gRPC health service withhold traffic until the
@@ -251,6 +296,10 @@ def initialize(
         # pre-compiles finish, degraded-but-live when it dies (the local
         # oracle keeps serving) — never a 0/N outage
         rstate.bind_remote(dispatch_evaluator.remote_status)
+    elif batcher is not None and hasattr(batcher, "health_state"):
+        # sharded pool: degraded only when EVERY lane's breaker refuses —
+        # one sick shard is a capacity event, not an availability event
+        rstate.bind_health(batcher.health_state)
     else:
         rstate.bind_health((lambda: health.state) if health is not None else None)
     warm_conf = tpu_conf.get("warmup", {}) or {}
@@ -259,6 +308,12 @@ def initialize(
     elif tpu_enabled and tpu_evaluator is not None and bool(warm_conf.get("enabled", False)):
         from .tpu.warmup import WarmupDriver
 
+        # sharded pool: every lane's clone owns its own jit cache, so warm
+        # each shard before readiness opens (unwrap any FaultInjector — the
+        # chaos wrapper must not fail warmup)
+        warm_evs = None
+        if batcher is not None and hasattr(batcher, "shards"):
+            warm_evs = [getattr(l.evaluator, "_ev", l.evaluator) for l in batcher.shards]
         driver = WarmupDriver(
             tpu_evaluator,
             batch_sizes=[int(s) for s in (warm_conf.get("batchSizes") or [16, 64])],
@@ -266,6 +321,7 @@ def initialize(
             max_kinds=int(warm_conf.get("maxKinds", 8)),
             timeout_s=float(warm_conf.get("timeoutSeconds", 120)),
             readiness=rstate,
+            evaluators=warm_evs,
         )
         rstate.begin_warmup(expected=driver.expected)
         if bool(warm_conf.get("background", True)):

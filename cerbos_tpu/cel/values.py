@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import math
+import re as _re
 from typing import Any
 
 from .errors import CelError, no_such_overload
@@ -53,6 +54,12 @@ class Timestamp(_dt.datetime):
         txt = s.strip()
         if txt.endswith(("z", "Z")):
             txt = txt[:-1] + "+00:00"
+        # Python 3.10's fromisoformat only accepts exactly 3 or 6 fractional
+        # digits; RFC3339 allows any precision ("...T23:59:59.5Z").
+        m = _re.match(r"^(.*T\d{2}:\d{2}:\d{2})\.(\d+)(.*)$", txt)
+        if m:
+            frac = (m.group(2) + "000000")[:6]
+            txt = f"{m.group(1)}.{frac}{m.group(3)}"
         try:
             # RFC3339 with fractional seconds of any precision
             dt = _dt.datetime.fromisoformat(txt)

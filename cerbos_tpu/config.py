@@ -55,6 +55,17 @@ DEFAULTS: dict[str, Any] = {
             },
             "quarantineMax": 128,
             "faults": "",
+            # sharded serving pool: drive the full device mesh from the
+            # batcher. shards=0 keeps the single-evaluator path; shards=N
+            # (or "auto" = one per visible device) builds N batcher lanes,
+            # each with its own device-pinned evaluator clone, breaker,
+            # quarantine set, and flight-recorder lane. perShardInflight=0
+            # inherits inflightDepth; routing: least_loaded | round_robin
+            "mesh": {
+                "shards": 0,
+                "perShardInflight": 0,
+                "routing": "least_loaded",
+            },
             # bounded ring of recent device-batch records + fault events,
             # served at /_cerbos/debug/flight and dumped on SIGQUIT
             "flightRecorder": {"enabled": True, "capacity": 256},

@@ -97,6 +97,21 @@ class _Inflight:
     layout_key: Optional[str] = None
 
 
+class _ShardStageView:
+    """Binds the shard dimension of the (stage, shard)-labeled stage-latency
+    HistogramVec so hot-path call sites keep the one-argument
+    ``observe(stage, v)`` shape."""
+
+    __slots__ = ("vec", "shard")
+
+    def __init__(self, vec: Any, shard: str):
+        self.vec = vec
+        self.shard = shard
+
+    def observe(self, stage: str, v: float) -> None:
+        self.vec.observe((stage, self.shard), v)
+
+
 def _settle(fut: Future, result: Any = None, error: Optional[BaseException] = None) -> None:
     """Resolve a future without ever raising out of the batcher thread — the
     waiter may have timed out and abandoned it."""
@@ -148,8 +163,15 @@ class BatchingEvaluator:
         health: Optional[DeviceHealth] = None,
         quarantine_max: int = 128,
         bisect_budget: int = 64,
+        shard_id: Optional[int] = None,
     ):
         self.evaluator = evaluator
+        # shard identity: which lane of the sharded pool this batcher drives.
+        # None means "the only batcher" (single-evaluator serving); metrics
+        # and flight records are still labeled shard="0" so dashboards see
+        # one schema either way.
+        self.shard_id = shard_id
+        self._shard_label = str(shard_id) if shard_id is not None else "0"
         self.max_batch = max_batch
         self.request_timeout = request_timeout_s
         self.max_wait = max_wait_ms / 1000.0
@@ -177,7 +199,8 @@ class BatchingEvaluator:
             "quarantined": 0,
         }
         self._init_metrics()
-        self._thread = threading.Thread(target=self._loop, daemon=True, name="check-batcher")
+        tname = "check-batcher" if shard_id is None else f"check-batcher-s{shard_id}"
+        self._thread = threading.Thread(target=self._loop, daemon=True, name=tname)
         self._thread.start()
 
     def _init_metrics(self) -> None:
@@ -194,11 +217,12 @@ class BatchingEvaluator:
             "request wait from enqueue to device submit",
             buckets=[0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0],
         )
-        self.m_inflight = reg.gauge(
+        self.m_inflight = reg.gauge_vec(
             "cerbos_tpu_batcher_inflight",
-            "device batches currently in flight",
+            "device batches currently in flight, by shard",
+            label="shard",
             track_max=True,
-        )
+        ).labels(self._shard_label)
         self.m_oracle_fallbacks = reg.counter_vec(
             "cerbos_tpu_batcher_oracle_fallbacks_total",
             "requests served from the CPU oracle instead of the device path, by reason",
@@ -220,20 +244,23 @@ class BatchingEvaluator:
         )
         # device-economics: how full the padded device layouts actually are,
         # and the per-stage latency attribution the traces aggregate over
-        self.m_occupancy = reg.gauge(
+        self.m_occupancy = reg.gauge_vec(
             "cerbos_tpu_batch_occupancy",
-            "real rows / padded rows of the last device batch (1.0 = no padding waste)",
-        )
-        self.m_padding_waste = reg.counter(
+            "real rows / padded rows of the last device batch (1.0 = no padding waste), by shard",
+            label="shard",
+        ).labels(self._shard_label)
+        self.m_padding_waste = reg.counter_vec(
             "cerbos_tpu_batch_padding_waste_rows_total",
-            "padded device rows that carried no real input",
+            "padded device rows that carried no real input, by shard",
+            label="shard",
         )
-        self.m_stage_seconds = reg.histogram_vec(
+        self._m_stage_vec = reg.histogram_vec(
             "cerbos_tpu_batch_stage_seconds",
-            "device-batch pipeline stage latency (pack/submit/device/collect/settle)",
-            label="stage",
+            "device-batch pipeline stage latency (pack/submit/device/collect/settle), by shard",
+            label=("stage", "shard"),
             buckets=[0.0001, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0],
         )
+        self.m_stage_seconds = _ShardStageView(self._m_stage_vec, self._shard_label)
 
     # -- oracle fallback ----------------------------------------------------
 
@@ -357,6 +384,27 @@ class BatchingEvaluator:
     def _count_deadline_drop(self) -> None:
         self.stats["deadline_drops"] += 1
         self.m_deadline_drops.inc()
+
+    # -- shard-pool routing surface -----------------------------------------
+
+    def load(self) -> int:
+        """Requests queued + in flight on this lane — the least-loaded
+        routing signal for the sharded pool. Reads are racy by design (a
+        routing decision needs a hint, not a barrier)."""
+        return len(self._queue) + int(self.m_inflight.value)
+
+    def routable(self, inputs: Optional[Sequence[T.CheckInput]] = None) -> bool:
+        """Can this lane take device traffic right now? False while its
+        breaker refuses, its drain loop is gone, or (when ``inputs`` are
+        given) this lane has quarantined one of them — the pool then prefers
+        a sibling shard over this lane's oracle fallback."""
+        if self._stop or self._dead is not None or not self._thread.is_alive():
+            return False
+        if self.health is not None and not self.health.allow_device():
+            return False
+        if inputs is not None and self._quarantine and self._has_quarantined(inputs):
+            return False
+        return True
 
     def _queue_nonempty(self) -> bool:
         with self._lock:
@@ -515,7 +563,7 @@ class BatchingEvaluator:
             if padded_rows:
                 waste = int(round(padded_rows * (1.0 - float(occupancy))))
                 if waste > 0:
-                    self.m_padding_waste.inc(waste)
+                    self.m_padding_waste.inc(self._shard_label, waste)
             inflight.append(flight)
             depth = len(inflight)
             self.m_inflight.set(depth)
@@ -584,6 +632,7 @@ class BatchingEvaluator:
             occupancy=flight.occupancy,
             layout_key=flight.layout_key,
             breaker_state=health.state if health is not None else None,
+            shard=self.shard_id,
         )
 
     def _batch_failed(
@@ -612,6 +661,7 @@ class BatchingEvaluator:
             batch_id=flight.batch_id,
             inputs=len(all_inputs),
             error=repr(e),
+            shard=self.shard_id,
         )
         for p in group:
             _settle(p.future, error=_BatchFailed(e))
@@ -688,6 +738,7 @@ class BatchingEvaluator:
             principal=inp.principal.id,
             resource_kind=inp.resource.kind,
             resource_id=inp.resource.id,
+            shard=self.shard_id,
         )
         _log.error(
             "quarantined poison input: it crashes device batches and will be "

@@ -24,6 +24,10 @@ silently disable a chaos run):
   this wrapper: after N CHECK tickets the ticket queue swallows every
   subsequent one without replying, simulating a wedged ring so front ends
   exercise their timeout → oracle fallback.
+- ``shard:N`` — consumed by ``engine/shards.build_shard_pool``, not this
+  wrapper: scope the whole spec to shard lane N of the sharded serving
+  pool (the shard-kill chaos drill: one sick chip, N-1 healthy siblings).
+  Without it, every lane gets the injector.
 - ``seed:N`` — PRNG seed for the probabilistic knobs (default 1337).
 
 The wrapper delegates every other attribute (``rule_table``,
@@ -44,7 +48,7 @@ class DeviceFault(RuntimeError):
 
 
 _FLOAT_KNOBS = {"submit_raise", "collect_raise", "check_raise", "wedge_sleep_s"}
-_INT_KNOBS = {"submit_delay_ms", "collect_delay_ms", "wedge_after", "ipc_wedge_after", "seed"}
+_INT_KNOBS = {"submit_delay_ms", "collect_delay_ms", "wedge_after", "ipc_wedge_after", "seed", "shard"}
 _STR_KNOBS = {"poison_attr"}
 
 

@@ -54,6 +54,7 @@ class FlightRecorder:
         occupancy: Optional[float] = None,
         layout_key: Optional[str] = None,
         breaker_state: Optional[str] = None,
+        shard: Optional[int] = None,
     ) -> None:
         if not self.enabled:
             return
@@ -68,9 +69,17 @@ class FlightRecorder:
             "occupancy": round(occupancy, 4) if occupancy is not None else None,
             "layout_key": layout_key,
             "breaker_state": breaker_state,
+            # which lane of the sharded pool carried this batch; None when a
+            # single evaluator serves (pre-shard records keep their shape)
+            "shard": shard,
         }
         with self._lock:
             self._records.append(rec)
+
+    def lane(self, shard: int) -> list[dict]:
+        """The recent batch records for one shard lane, oldest first."""
+        with self._lock:
+            return [r for r in self._records if r.get("shard") == shard]
 
     def record_event(self, kind: str, **fields: Any) -> None:
         """Discrete device-path events: breaker transitions, bisect results,

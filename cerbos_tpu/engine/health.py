@@ -56,8 +56,14 @@ class DeviceHealth:
         probe_timeout_s: float = 5.0,
         enabled: bool = True,
         clock: Callable[[], float] = time.monotonic,
+        shard_id: Optional[int] = None,
     ):
         self.enabled = enabled
+        # which lane of the sharded pool this breaker guards; None = the
+        # only breaker. Metrics carry shard="0" either way so dashboards
+        # see one schema.
+        self.shard_id = shard_id
+        self._shard_label = str(shard_id) if shard_id is not None else "0"
         self.failure_threshold = max(1, int(failure_threshold))
         self.timeout_rate_threshold = float(timeout_rate_threshold)
         self.timeout_window_s = float(timeout_window_s)
@@ -85,18 +91,20 @@ class DeviceHealth:
         from ..observability import metrics
 
         reg = metrics()
-        self.m_state = reg.gauge(
+        self.m_state = reg.gauge_vec(
             "cerbos_tpu_breaker_state",
-            "device-path breaker state (0=closed, 1=open, 2=half-open)",
-        )
-        self.m_trips = reg.counter(
+            "device-path breaker state (0=closed, 1=open, 2=half-open), by shard",
+            label="shard",
+        ).labels(self._shard_label)
+        self.m_trips = reg.counter_vec(
             "cerbos_tpu_breaker_trips_total",
-            "times the device-path breaker tripped open",
+            "times the device-path breaker tripped open, by shard",
+            label="shard",
         )
         self.m_transitions = reg.counter_vec(
             "cerbos_tpu_breaker_transitions_total",
-            "breaker state transitions, labeled from_to (e.g. closed_open)",
-            label="transition",
+            "breaker state transitions, labeled from_to (e.g. closed_open), by shard",
+            label=("transition", "shard"),
         )
         self.m_state.set(_STATE_CODE[self._state])
 
@@ -108,9 +116,9 @@ class DeviceHealth:
             return
         self._state = new_state
         self.m_state.set(_STATE_CODE[new_state])
-        self.m_transitions.inc(f"{old}_{new_state}")
+        self.m_transitions.inc((f"{old}_{new_state}", self._shard_label))
         flight_recorder().record_event(
-            "breaker_transition", frm=old, to=new_state, cause=cause
+            "breaker_transition", frm=old, to=new_state, cause=cause, shard=self.shard_id
         )
 
     # -- state queries ------------------------------------------------------
@@ -128,6 +136,17 @@ class DeviceHealth:
         with self._lock:
             self._tick_locked()
             return self._state == STATE_CLOSED
+
+    def probe_due(self) -> bool:
+        """Non-consuming peek: OPEN with the probe backoff elapsed. The
+        sharded router uses this to trickle one donor request onto a sick
+        lane (oracle-served there) so its own ``should_probe`` machinery
+        gets inputs to probe with — without claiming the probe token."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            self._tick_locked()
+            return self._state == STATE_OPEN and self._clock() >= self._next_probe_at
 
     def should_probe(self) -> Optional[int]:
         """When the breaker is OPEN and the backoff has elapsed, transition
@@ -212,7 +231,7 @@ class DeviceHealth:
             self._trip_streak, self.probe_backoff_base_s, self.probe_backoff_cap_s
         )
         self.stats["trips"] += 1
-        self.m_trips.inc()
+        self.m_trips.inc(self._shard_label)
         _log.error(
             "device-path breaker tripped open; serving from the CPU oracle",
             extra={"fields": {"cause": cause, "streak": self._trip_streak}},

@@ -585,11 +585,28 @@ class Histogram:
         return {f"{self.name}_sum": total, f"{self.name}_count": float(count)}
 
 
+def _label_expr(label, key) -> str:
+    """Render a label expression for a vec child. ``label`` is a name or a
+    tuple of names (multi-dimension vecs, e.g. ``("stage", "shard")``);
+    ``key`` is the matching value or tuple of values."""
+    if isinstance(label, tuple):
+        vals = key if isinstance(key, tuple) else (key,)
+        return ",".join(f'{ln}="{lv}"' for ln, lv in zip(label, vals))
+    return f'{label}="{key}"'
+
+
+def _series_suffix(key) -> str:
+    if isinstance(key, tuple):
+        return "_".join(str(k) for k in key)
+    return str(key)
+
+
 class CounterVec:
-    """Counter with one label dimension; each label value gets a child
-    series rendered as ``name{label="value"} n``. ``value`` sums all
-    children so callers that read the unlabeled total (back-compat with
-    the plain Counter this may replace) keep working."""
+    """Counter with one or more label dimensions; each label value (or value
+    tuple, when ``label`` is a tuple of names) gets a child series rendered
+    as ``name{label="value"} n``. ``value`` sums all children so callers
+    that read the unlabeled total (back-compat with the plain Counter this
+    may replace) keep working."""
 
     __slots__ = ("name", "help", "label", "_children", "_lock")
 
@@ -615,24 +632,101 @@ class CounterVec:
 
     def render(self) -> list[str]:
         with self._lock:
-            children = sorted(self._children.items())
+            children = sorted(self._children.items(), key=lambda kv: str(kv[0]))
         out = [f"# TYPE {self.name} counter"]
         if not children:
             out.append(f"{self.name} 0")
         for label_value, v in children:
-            out.append(f'{self.name}{{{self.label}="{label_value}"}} {_fmt(v)}')
+            out.append(f'{self.name}{{{_label_expr(self.label, label_value)}}} {_fmt(v)}')
         return out
 
     def series(self) -> dict[str, float]:
         with self._lock:
             children = dict(self._children)
-        return {f"{self.name}_{lv}" if lv else self.name: v for lv, v in children.items()}
+        return {
+            f"{self.name}_{_series_suffix(lv)}" if lv else self.name: v
+            for lv, v in children.items()
+        }
+
+
+class GaugeVec:
+    """Gauge with one label dimension; each label value gets a child Gauge
+    rendered as ``name{label="value"} v``. ``labels()`` hands the caller the
+    child Gauge itself, so hot paths bind once and then use the plain Gauge
+    surface (``set``/``inc``/``value``/``peak``). Used for the per-shard
+    occupancy/inflight/breaker-state series."""
+
+    __slots__ = ("name", "help", "label", "track_max", "_children", "_lock")
+
+    def __init__(self, name: str, help: str = "", label: str = "shard", track_max: bool = False):
+        self.name = name
+        self.help = help
+        self.label = label
+        self.track_max = track_max
+        self._children: dict[str, Gauge] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: str) -> Gauge:
+        with self._lock:
+            child = self._children.get(value)
+            if child is None:
+                child = Gauge(self.name, self.help, track_max=self.track_max)
+                self._children[value] = child
+            return child
+
+    def set(self, value: str, v: float) -> None:
+        self.labels(value).set(v)
+
+    def get(self, value: str) -> float:
+        with self._lock:
+            child = self._children.get(value)
+        return child.value if child is not None else 0.0
+
+    @property
+    def value(self) -> float:
+        """Sum over children — a read-alias for callers holding the name
+        from before a Gauge→GaugeVec upgrade."""
+        with self._lock:
+            children = list(self._children.values())
+        return sum(c.value for c in children)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            children = sorted(self._children.items(), key=lambda kv: str(kv[0]))
+        out = [f"# TYPE {self.name} gauge"]
+        peaks: list[str] = []
+        for label_value, child in children:
+            with child._lock:
+                v, peak = child._value, child._peak
+            expr = _label_expr(self.label, label_value)
+            out.append(f"{self.name}{{{expr}}} {_fmt(v)}")
+            if self.track_max:
+                peaks.append(f"{self.name}_peak{{{expr}}} {_fmt(peak)}")
+        if peaks:
+            out.append(f"# TYPE {self.name}_peak gauge")
+            out.extend(peaks)
+        return out
+
+    def series(self) -> dict[str, float]:
+        with self._lock:
+            children = sorted(self._children.items(), key=lambda kv: str(kv[0]))
+        out: dict[str, float] = {}
+        for label_value, child in children:
+            with child._lock:
+                v, peak = child._value, child._peak
+            suffix = _series_suffix(label_value)
+            out[f"{self.name}_{suffix}" if suffix else self.name] = v
+            if self.track_max:
+                out[f"{self.name}_peak_{suffix}" if suffix else f"{self.name}_peak"] = peak
+        return out
 
 
 class HistogramVec:
-    """Histogram with one label dimension; each label value gets a child
-    Histogram rendered as ``name_bucket{label="value",le="..."}``. Used for
-    the per-stage device-path latency series so Grafana can do
+    """Histogram with one or more label dimensions; each label value (or
+    value tuple, when ``label`` is a tuple of names like
+    ``("stage", "shard")``) gets a child Histogram rendered as
+    ``name_bucket{label="value",le="..."}``. Used for the per-stage
+    device-path latency series so Grafana can do
     ``histogram_quantile(..., sum by (le, stage))`` over one instrument."""
 
     __slots__ = ("name", "help", "label", "buckets", "_children", "_lock")
@@ -664,20 +758,21 @@ class HistogramVec:
 
     def render(self) -> list[str]:
         with self._lock:
-            children = sorted(self._children.items())
+            children = sorted(self._children.items(), key=lambda kv: str(kv[0]))
         out = [f"# TYPE {self.name} histogram"]
         for label_value, child in children:
-            out.extend(child.render(label=f'{self.label}="{label_value}"'))
+            out.extend(child.render(label=_label_expr(self.label, label_value)))
         return out
 
     def series(self) -> dict[str, float]:
         with self._lock:
-            children = sorted(self._children.items())
+            children = sorted(self._children.items(), key=lambda kv: str(kv[0]))
         out: dict[str, float] = {}
         for label_value, child in children:
             _, total, count = child.snapshot()
-            out[f"{self.name}_{label_value}_sum"] = total
-            out[f"{self.name}_{label_value}_count"] = float(count)
+            suffix = _series_suffix(label_value)
+            out[f"{self.name}_{suffix}_sum"] = total
+            out[f"{self.name}_{suffix}_count"] = float(count)
         return out
 
 
@@ -743,9 +838,40 @@ class MetricsRegistry:
             return m
 
     def gauge(self, name: str, help: str = "", track_max: bool = False) -> Gauge:
+        # GaugeVec is an allowed read-alias: its .value sums all children,
+        # so code holding the unlabeled total keeps working after an upgrade
         return self._get_or_create(
-            name, lambda: Gauge(name, help, track_max=track_max), want=(Gauge,), help=help
+            name,
+            lambda: Gauge(name, help, track_max=track_max),
+            want=(Gauge, GaugeVec),
+            help=help,
         )
+
+    def gauge_vec(
+        self, name: str, help: str = "", label: str = "shard", track_max: bool = False
+    ) -> GaugeVec:
+        with self._lock:
+            m = self._metrics.get(name)
+            if isinstance(m, Gauge):
+                # a plain Gauge was registered under this name first (e.g. a
+                # reader touched it before the owner): upgrade in place,
+                # preserving the current value under the empty label
+                vec = GaugeVec(name, help or m.help, label=label, track_max=track_max)
+                if m.value or m.peak:
+                    child = vec.labels("")
+                    child.set(m.value)
+                self._metrics[name] = vec
+                return vec
+            if m is None:
+                m = GaugeVec(name, help, label=label, track_max=track_max)
+                self._metrics[name] = m
+            elif not isinstance(m, GaugeVec):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}, not GaugeVec"
+                )
+            elif help and not m.help:
+                m.help = help
+            return m
 
     def histogram(self, name: str, help: str = "", buckets: Optional[list[float]] = None) -> Histogram:
         return self._get_or_create(

@@ -24,6 +24,35 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def make_mesh_for(devices, axis: str = "data") -> Mesh:
+    """A 1-D mesh over an explicit device list — a shard's slice of the
+    full mesh when the pool runs fewer shards than devices."""
+    return Mesh(np.array(list(devices)), (axis,))
+
+
+def shard_devices(n_shards: Optional[int] = None) -> list[list[Any]]:
+    """Partition the visible devices into pool-shard placements.
+
+    Returns one device list per shard: ``n_shards`` up to the device count
+    gives contiguous slices (8 devices / 2 shards → two 4-device mesh
+    slices; 8/8 → eight single-device shards, the data-parallel serving
+    layout). ``None`` or 0 means one shard per device. Asking for more
+    shards than devices clamps — a shard must own at least one real chip,
+    oversubscription buys nothing."""
+    devices = jax.devices()
+    n_dev = len(devices)
+    n = n_dev if not n_shards else min(int(n_shards), n_dev)
+    n = max(1, n)
+    base, extra = divmod(n_dev, n)
+    out: list[list[Any]] = []
+    start = 0
+    for i in range(n):
+        size = base + (1 if i < extra else 0)
+        out.append(devices[start : start + size])
+        start += size
+    return out
+
+
 def make_mesh_2d(rows: int, cols: int, axes: tuple[str, str] = ("replica", "data")) -> Mesh:
     """Multi-axis mesh: the batch axis shards over BOTH axes (the flattened
     device grid), exercising 2-D device layouts the way a tp×dp topology

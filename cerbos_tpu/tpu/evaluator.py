@@ -1032,14 +1032,23 @@ class TpuEvaluator:
         pipeline_chunk: int = 4096,
         streaming_threshold: int = 1024,
         inflight_depth: int = 3,
+        device=None,
+        shard_id: Optional[int] = None,
+        _lowered: Optional[LoweredTable] = None,
     ):
         self.rule_table = rule_table
         self.schema_mgr = schema_mgr
-        self.lowered = lower_table(rule_table, globals_)
+        # lowering is the expensive part of construction; shard clones pass
+        # the shared LoweredTable in so a pool of N evaluators lowers ONCE
+        self.lowered = _lowered if _lowered is not None else lower_table(rule_table, globals_)
         self.packer = Packer(self.lowered, max_roles=max_roles, max_candidates=max_candidates, max_depth=max_depth)
         self.use_jax = use_jax
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # pin this evaluator's dispatches to one jax device (a shard of the
+        # pool); None = jax's default device (single-evaluator serving)
+        self.device = device
+        self.shard_id = shard_id
         self.pipeline_chunk = pipeline_chunk
         # batch size at which check() switches to the chunked double-buffered
         # pipeline; 0 disables. Small enough that cross-request batches from
@@ -1063,6 +1072,12 @@ class TpuEvaluator:
     def refresh(self) -> None:
         """Re-lower after a policy reload (storage event hook)."""
         self.lowered.refresh()
+        self.invalidate()
+
+    def invalidate(self) -> None:
+        """Drop every per-instance cache derived from the lowered table.
+        ``refresh()`` re-lowers and then calls this; shard clones sharing the
+        lowered table call only this after the owner re-lowered."""
         self.packer.invalidate()
         self._jit_cache.clear()
         self._dr_table_cache.clear()
@@ -1071,6 +1086,54 @@ class TpuEvaluator:
         self._assemble_memo.clear()
         self._dr_cids_cache.clear()
         self._dr_cids_canon.clear()
+
+    def shard_clone(self, devices, shard_id: int) -> "TpuEvaluator":
+        """A pool-shard evaluator over the SAME lowered rule table.
+
+        The clone shares the read-only artifacts (rule table, lowered
+        tables, schema manager) but owns everything mutated on the serving
+        path — packer, jit cache, memo caches, stats — so each shard's
+        worker thread runs lock-free against its siblings. ``devices`` is
+        the shard's placement from ``parallel.mesh.shard_devices``: one
+        device pins via ``jax.default_device``, several become a per-shard
+        data-parallel mesh slice."""
+        device = None
+        mesh = None
+        if devices is not None:
+            devs = list(devices)
+            if len(devs) == 1:
+                device = devs[0]
+            elif len(devs) > 1:
+                from ..parallel.mesh import make_mesh_for
+
+                mesh = make_mesh_for(devs)
+        clone = TpuEvaluator(
+            self.rule_table,
+            schema_mgr=self.schema_mgr,
+            max_roles=self.packer.K,
+            max_candidates=self.packer.J,
+            max_depth=self.packer.D,
+            use_jax=self.use_jax,
+            min_device_batch=self.min_device_batch,
+            mesh=mesh,
+            pipeline_chunk=self.pipeline_chunk,
+            streaming_threshold=self.streaming_threshold,
+            inflight_depth=self.inflight_depth,
+            device=device,
+            shard_id=shard_id,
+            _lowered=self.lowered,
+        )
+        return clone
+
+    def _device_scope(self):
+        """Context manager pinning jax dispatch to this shard's device."""
+        if self.device is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self.device)
 
     def check(self, inputs: list[T.CheckInput], params: Optional[T.EvalParams] = None) -> list[T.CheckOutput]:
         params = params or T.EvalParams()
@@ -1088,9 +1151,10 @@ class TpuEvaluator:
         ):
             return self._check_pipelined(inputs, params)
         batch = self.packer.pack(inputs, params)
-        final, role_results, win_j, sat_arr, col_map = _device_eval(
-            self.lowered, batch, use_jax=self.use_jax, jit_cache=self._jit_cache, mesh=self.mesh
-        )
+        with self._device_scope():
+            final, role_results, win_j, sat_arr, col_map = _device_eval(
+                self.lowered, batch, use_jax=self.use_jax, jit_cache=self._jit_cache, mesh=self.mesh
+            )
         return self._assemble_batch(batch, final, role_results, win_j, sat_arr, col_map, params)
 
     def submit(self, inputs: list[T.CheckInput], params: Optional[T.EvalParams] = None) -> "CheckTicket":
@@ -1120,7 +1184,7 @@ class TpuEvaluator:
         # instead of compiling a monolithic one
         chunks = self._chunk_inputs(inputs)
         t.parts = []
-        with start_span("batch.pack", inputs=len(inputs), chunks=len(chunks)):
+        with start_span("batch.pack", inputs=len(inputs), chunks=len(chunks)), self._device_scope():
             for ch in chunks:
                 p0 = time.perf_counter()
                 batch = self.packer.pack(ch, params)
@@ -1184,7 +1248,8 @@ class TpuEvaluator:
         inflight: list[tuple[PackedBatch, _DeviceHandle]] = []
         for ci, ch in enumerate(chunks):
             batch = self.packer.pack(ch, params)
-            h = _device_dispatch(self.lowered, batch, self._jit_cache)
+            with self._device_scope():
+                h = _device_dispatch(self.lowered, batch, self._jit_cache)
             inflight.append((batch, h))
             if len(inflight) >= self.inflight_depth:
                 b, hh = inflight.pop(0)

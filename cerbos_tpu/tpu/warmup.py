@@ -101,16 +101,21 @@ class WarmupDriver:
         max_kinds: int = 8,
         timeout_s: float = 120.0,
         readiness: Any = None,
+        evaluators: Optional[list[Any]] = None,
     ):
-        self.evaluator = evaluator
-        min_batch = max(1, int(getattr(evaluator, "min_device_batch", 16)))
+        # ``evaluators`` warms a sharded pool: every lane's clone owns its
+        # own jit cache, so readiness must wait for sizes × shards compiles
+        # (the persistent XLA cache makes shards 2..N cheap on real metal)
+        self.evaluators = list(evaluators) if evaluators else [evaluator]
+        self.evaluator = evaluator if evaluator is not None else self.evaluators[0]
+        min_batch = max(1, int(getattr(self.evaluator, "min_device_batch", 16)))
         sizes = sorted({max(int(s), min_batch) for s in (batch_sizes or [16, 64]) if int(s) > 0})
         self.batch_sizes = sizes or [min_batch]
         self.corpus = [dict(s) for s in corpus] if corpus else None
         self.max_kinds = int(max_kinds)
         self.timeout_s = float(timeout_s)
         self.readiness = readiness
-        self.expected = len(self.batch_sizes)
+        self.expected = len(self.batch_sizes) * len(self.evaluators)
         self._thread: Optional[threading.Thread] = None
 
     def run(self) -> dict:
@@ -120,26 +125,33 @@ class WarmupDriver:
         summary: dict = {"layouts": 0, "inputs": 0, "errors": []}
         t_start = time.monotonic()
         error: Optional[str] = None
-        for size in self.batch_sizes:
-            if time.monotonic() > deadline:
-                error = f"warmup timeout after {self.timeout_s:.0f}s ({summary['layouts']}/{self.expected} layouts)"
-                _log.warning("%s — opening readiness anyway", error)
+        timed_out = False
+        for ei, ev in enumerate(self.evaluators):
+            if timed_out:
                 break
-            try:
-                t0 = time.monotonic()
-                self.evaluator.check(synthetic_inputs(specs, size))
-                _log.info(
-                    "warmup: batch size %d compiled in %.2fs (%d/%d layouts)",
-                    size, time.monotonic() - t0, summary["layouts"] + 1, self.expected,
-                )
-            except Exception as e:  # noqa: BLE001 - warmup must not kill boot
-                summary["errors"].append(f"size {size}: {e}")
-                _log.warning("warmup batch size %d failed: %s", size, e)
-                continue
-            summary["layouts"] += 1
-            summary["inputs"] += size
-            if self.readiness is not None:
-                self.readiness.layout_compiled()
+            shard = getattr(ev, "shard_id", None)
+            tag = f" shard {shard}" if shard is not None else ""
+            for size in self.batch_sizes:
+                if time.monotonic() > deadline:
+                    error = f"warmup timeout after {self.timeout_s:.0f}s ({summary['layouts']}/{self.expected} layouts)"
+                    _log.warning("%s — opening readiness anyway", error)
+                    timed_out = True
+                    break
+                try:
+                    t0 = time.monotonic()
+                    ev.check(synthetic_inputs(specs, size))
+                    _log.info(
+                        "warmup: batch size %d%s compiled in %.2fs (%d/%d layouts)",
+                        size, tag, time.monotonic() - t0, summary["layouts"] + 1, self.expected,
+                    )
+                except Exception as e:  # noqa: BLE001 - warmup must not kill boot
+                    summary["errors"].append(f"size {size}{tag}: {e}")
+                    _log.warning("warmup batch size %d%s failed: %s", size, tag, e)
+                    continue
+                summary["layouts"] += 1
+                summary["inputs"] += size
+                if self.readiness is not None:
+                    self.readiness.layout_compiled()
         summary["seconds"] = round(time.monotonic() - t_start, 3)
         if error is None and summary["errors"]:
             error = "; ".join(summary["errors"])

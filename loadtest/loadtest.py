@@ -100,12 +100,17 @@ def _make_bodies(n_mods: int, n: int = 512, unique: bool = False) -> list[bytes]
 
 
 def spawn_server(
-    policy_dir: str, workers: int, use_tpu: bool, frontends: int = 0
+    policy_dir: str, workers: int, use_tpu: bool, frontends: int = 0, shards: int = 0
 ) -> tuple[subprocess.Popen, int, int]:
     import base64
 
     import yaml
 
+    tpu_cfg: dict = {"enabled": bool(use_tpu)}
+    if shards:
+        # sharded serving pool (engine/shards.py): N batcher lanes, one
+        # device-pinned evaluator clone each; -1 = one per visible device
+        tpu_cfg["mesh"] = {"shards": "auto" if shards < 0 else int(shards)}
     cfg_path = os.path.join(policy_dir, ".cerbos.yaml")
     with open(cfg_path, "w") as f:
         yaml.safe_dump(
@@ -116,7 +121,7 @@ def spawn_server(
                     "maxWorkers": int(os.environ.get("CERBOS_TPU_LOADTEST_MAX_WORKERS", "16")),
                 },
                 "storage": {"driver": "disk", "disk": {"directory": policy_dir}},
-                "engine": {"tpu": {"enabled": bool(use_tpu)}},
+                "engine": {"tpu": tpu_cfg},
                 "auxData": {
                     "jwt": {
                         "keySets": [
@@ -230,10 +235,10 @@ def _read_http_response(sock: socket.socket, buf: bytearray) -> bytes:
         buf.extend(chunk)
 
 
-def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0) -> dict:
+def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0) -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
-    proc, http_port, grpc_port = spawn_server(tmp, workers, use_tpu, frontends=frontends)
+    proc, http_port, grpc_port = spawn_server(tmp, workers, use_tpu, frontends=frontends, shards=shards)
     # --cold: a large pool of per-request-unique bodies (unique attr values
     # and principal ids) so the server's value/shape/assembly memos miss;
     # once the run exhausts the pool, repeats re-warm — the pool is sized so
@@ -359,6 +364,9 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
             "workers": workers,
             "frontends": frontends,
             "shared_batcher": bool(frontends),
+            # sharded serving pool inside the PDP (engine.tpu.mesh.shards):
+            # 0 = single batcher; -1 requested "auto" (one per device)
+            "shards": shards,
         },
         "host_cores": len(os.sched_getaffinity(0)),
         "policies": n_mods * 9,  # 9 policy documents per name-mod
@@ -380,6 +388,13 @@ def main() -> None:
     )
     ap.add_argument("--grpc", action="store_true")
     ap.add_argument("--tpu", action="store_true", help="enable the TPU engine path")
+    ap.add_argument(
+        "--shards",
+        type=int,
+        default=0,
+        help="engine.tpu.mesh.shards for the server under test "
+        "(-1 = auto, one lane per visible device; needs --tpu)",
+    )
     ap.add_argument("--cold", action="store_true", help="per-request-unique bodies (memo-cold)")
     ap.add_argument(
         "--json",
@@ -390,7 +405,7 @@ def main() -> None:
     args = ap.parse_args()
     result = run(
         args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers,
-        cold=args.cold, frontends=args.frontends,
+        cold=args.cold, frontends=args.frontends, shards=args.shards,
     )
     print(json.dumps(result))
     if args.json:

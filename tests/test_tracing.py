@@ -184,11 +184,35 @@ class TestMetricsLint:
                 "cerbos_tpu_warmup_compiled_layouts",
             ):
                 assert name in inst, name
-            known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.Histogram, obs.HistogramVec)
+            known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.GaugeVec, obs.Histogram, obs.HistogramVec)
             for name, m in inst.items():
                 assert re.fullmatch(r"cerbos_tpu_[a-z0-9_]+", name), name
                 assert isinstance(m, known), (name, type(m))
                 assert m.help, f"metric {name!r} has no help text"
+            # sharded serving (docs/OBSERVABILITY.md "Per-shard row"): these
+            # families carry a shard label so one sick chip is visible as
+            # ONE sick series, not a poisoned aggregate
+            sharded = {
+                "cerbos_tpu_batcher_inflight": obs.GaugeVec,
+                "cerbos_tpu_batch_occupancy": obs.GaugeVec,
+                "cerbos_tpu_breaker_state": obs.GaugeVec,
+                "cerbos_tpu_batch_padding_waste_rows_total": obs.CounterVec,
+                "cerbos_tpu_breaker_trips_total": obs.CounterVec,
+            }
+            for name, typ in sharded.items():
+                m = inst.get(name)
+                assert isinstance(m, typ), (name, type(m))
+                label = m.label if isinstance(m.label, str) else None
+                assert label == "shard", (name, m.label)
+            # multi-dimension vecs keep shard as the LAST label dimension
+            for name in ("cerbos_tpu_batch_stage_seconds", "cerbos_tpu_breaker_transitions_total"):
+                m = inst.get(name)
+                assert isinstance(m.label, tuple) and m.label[-1] == "shard", (name, m.label)
+            # rendered exposition carries the label on every child series
+            text = obs.metrics().render()
+            for line in text.splitlines():
+                if line.startswith("cerbos_tpu_breaker_state{"):
+                    assert 'shard="' in line, line
         finally:
             core.close()
 
@@ -279,7 +303,10 @@ class TestBreakerTransitions:
             clock=lambda: clock[0],
         )
         vec = h.m_transitions  # global counter_vec: compare deltas, not totals
-        edges = ("closed_open", "open_half_open", "half_open_open", "half_open_closed")
+        # children keyed (transition, shard); an unsharded breaker is shard "0"
+        edges = tuple(
+            (t, "0") for t in ("closed_open", "open_half_open", "half_open_open", "half_open_closed")
+        )
         base = {e: vec.get(e) for e in edges}
         ev_base = len(
             [e for e in flight.recorder().dump()["events"] if e["kind"] == "breaker_transition"]
@@ -289,24 +316,24 @@ class TestBreakerTransitions:
         assert h.state == "closed"  # below threshold: no transition yet
         h.record_failure()
         assert h.state == "open"
-        assert vec.get("closed_open") == base["closed_open"] + 1
+        assert vec.get(("closed_open", "0")) == base[("closed_open", "0")] + 1
         assert h.m_state.value == 1.0
 
         clock[0] += 1000.0
         token = h.should_probe()
         assert token is not None
-        assert vec.get("open_half_open") == base["open_half_open"] + 1
+        assert vec.get(("open_half_open", "0")) == base[("open_half_open", "0")] + 1
         assert h.m_state.value == 2.0
 
         h.probe_failed(token)
-        assert vec.get("half_open_open") == base["half_open_open"] + 1
+        assert vec.get(("half_open_open", "0")) == base[("half_open_open", "0")] + 1
 
         clock[0] += 1000.0
         token = h.should_probe()
         assert token is not None
         h.probe_succeeded(token)
         assert h.state == "closed"
-        assert vec.get("half_open_closed") == base["half_open_closed"] + 1
+        assert vec.get(("half_open_closed", "0")) == base[("half_open_closed", "0")] + 1
         assert h.m_state.value == 0.0
 
         # 5 edges total: trip, half-open, re-open, half-open, re-close
