@@ -287,9 +287,11 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
     batcher, and adds a ``topology`` block to the artifact: per-shard
     decisions/s, occupancy, and routing-imbalance.
     """
+    import os
     from concurrent.futures import ThreadPoolExecutor
 
     from cerbos_tpu.engine.batcher import BatchingEvaluator, DeviceHealth
+    from cerbos_tpu.engine.sentinel import from_config as sentinel_from_config
 
     evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
     jax_ok = _merge_probe(evidence, tpu_probe.probe_ladder(attempts=1), "served")
@@ -305,6 +307,9 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
     rt = build_rule_table(compile_policy_set(policies))
     params = EvalParams()
     ev = TpuEvaluator(rt, use_jax=jax_ok)
+    # chaos drills ride the same grammar as the server (engine/faults.py);
+    # flip_effect:P,shard:N under --shards is the parity-sentinel drill
+    fault_spec = os.environ.get("CERBOS_TPU_FAULTS", "")
     sharded_pool = None
     if shards and shards != 1:
         from cerbos_tpu.engine.shards import build_shard_pool
@@ -315,15 +320,29 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
             routing=routing,
             max_batch=1024,
             max_wait_ms=2.0,
+            fault_spec=fault_spec,
         )
         health = None
         batcher = sharded_pool
         print(f"sharded pool: {len(sharded_pool.shards)} lanes, routing={routing}", flush=True)
     else:
+        dispatch = ev
+        if fault_spec:
+            from cerbos_tpu.engine.faults import FaultInjector
+
+            dispatch = FaultInjector(ev, fault_spec)
         health = DeviceHealth()
         batcher = BatchingEvaluator(
-            ev, max_batch=1024, max_wait_ms=2.0, min_batch_to_wait=8, max_inflight=3, health=health
+            dispatch, max_batch=1024, max_wait_ms=2.0, min_batch_to_wait=8, max_inflight=3, health=health
         )
+    # parity sentinel over the bench's own lanes: the served artifact's
+    # correctness block. Rate/corpus overridable for the chaos drill.
+    sentinel = sentinel_from_config(
+        {
+            "sampleRate": float(os.environ.get("CERBOS_TPU_PARITY_RATE", "0.01")),
+            "corpusDir": os.environ.get("CERBOS_TPU_PARITY_CORPUS", ""),
+        }
+    ).attach(batcher)
 
     req_size = 4  # inputs per client request (the classic template's shape)
     n_clients = 16 if smoke else 64
@@ -343,7 +362,11 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
         wall = time.perf_counter() - t0
     finally:
         pool.shutdown(wait=True)
+        sentinel.drain(timeout=30.0)  # let queued shadow replays finish
+        parity = sentinel.snapshot()
+        sentinel.close()
         batcher.close()
+    parity["overhead_pct"] = round(100.0 * parity["replay_seconds"] / wall, 3) if wall else 0.0
 
     allow = sum(
         1 for ro in outs for o in ro for e in o.actions.values() if e.effect == "EFFECT_ALLOW"
@@ -377,6 +400,9 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
         "padding_waste_rows": padding_waste,
         "compile": _compile_economy(),
         "probe": tpu_probe.summarize(evidence),
+        # online shadow-oracle parity over this run's own batches
+        # (engine/sentinel.py): divergences must be 0 with faults off
+        "parity": parity,
     }
     if sharded_pool is not None:
         # per-shard share of the measured rate: routed requests carry equal
@@ -396,6 +422,17 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
     print(
         "robustness: breaker_trips=%d oracle_fallbacks=%d deadline_drops=%d"
         % (trips, batcher.stats["oracle_fallbacks"], batcher.stats["deadline_drops"]),
+        flush=True,
+    )
+    print(
+        "parity: checks=%d divergences=%d storms=%d lag_p99=%.4fs overhead=%.3f%%"
+        % (
+            parity["checks"],
+            parity["divergences"],
+            parity["storms"],
+            parity["lag_p99_s"],
+            parity["overhead_pct"],
+        ),
         flush=True,
     )
     print(json.dumps(record))

@@ -101,9 +101,18 @@ def _output_json(o: T.CheckOutput) -> dict:
     )
 
 
-def _entry_from_decision(call_id: str, inputs: list[T.CheckInput], outputs: list[T.CheckOutput]) -> dict:
+def _entry_from_decision(
+    call_id: str,
+    inputs: list[T.CheckInput],
+    outputs: list[T.CheckOutput],
+    trace_id: str = "",
+    shard: Optional[int] = None,
+) -> dict:
     """Ref: auditv1.DecisionLogEntry (checkResources + auditTrail shape as
-    compared by engine_test.go's wantDecisionLogs)."""
+    compared by engine_test.go's wantDecisionLogs). ``traceId`` and ``shard``
+    correlate the decision entry with the request's trace and the device
+    lane that evaluated it — the join key between audit, /_cerbos/debug
+    traces, and the flight recorder."""
     effective: dict[str, dict] = {}
     for o in outputs:
         for key, attrs in o.effective_policies.items():
@@ -113,6 +122,8 @@ def _entry_from_decision(call_id: str, inputs: list[T.CheckInput], outputs: list
             "callId": call_id,
             "timestamp": _now_iso(),
             "kind": "decision",
+            "traceId": trace_id,
+            "shard": shard,
             "checkResources": {
                 "inputs": [_input_json(i) for i in inputs],
                 "outputs": [_output_json(o) for o in outputs],
@@ -137,12 +148,27 @@ class AuditLog:
         self.access_logs_enabled = access_logs_enabled
         self.decision_logs_enabled = decision_logs_enabled
         self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=4096)
+        self._init_metrics()
         self._worker = threading.Thread(target=self._drain, daemon=True, name="audit-writer")
         self._worker.start()
+
+    def _init_metrics(self) -> None:
+        from ..observability import metrics
+
+        reg = metrics()
+        self.m_depth = reg.gauge(
+            "cerbos_tpu_audit_queue_depth",
+            "audit entries buffered for the async writer; sustained growth means the backend is slower than the decision rate",
+        )
+        self.m_dropped = reg.counter(
+            "cerbos_tpu_audit_dropped_total",
+            "audit entries dropped because the async queue was full (the hot path never blocks on audit)",
+        )
 
     def _drain(self) -> None:
         while True:
             entry = self._queue.get()
+            self.m_depth.set(float(self._queue.qsize()))
             if entry is None:
                 return
             try:
@@ -156,20 +182,28 @@ class AuditLog:
     def _submit(self, entry: dict) -> None:
         try:
             self._queue.put_nowait(entry)
+            self.m_depth.set(float(self._queue.qsize()))
         except queue.Full:
-            pass  # drop rather than block the request path
+            self.m_dropped.inc()  # drop rather than block the request path
 
     def write_access(self, call_id: str, method: str, peer: str = "") -> None:
         if not self.access_logs_enabled or self.backend is None:
             return
         self._submit({"callId": call_id, "timestamp": _now_iso(), "kind": "access", "method": method, "peer": peer})
 
-    def write_decision(self, call_id: str, inputs: list[T.CheckInput], outputs: list[T.CheckOutput]) -> None:
+    def write_decision(
+        self,
+        call_id: str,
+        inputs: list[T.CheckInput],
+        outputs: list[T.CheckOutput],
+        trace_id: str = "",
+        shard: Optional[int] = None,
+    ) -> None:
         if not self.decision_logs_enabled or self.backend is None:
             return
         if not self.decision_filter.keep(inputs, outputs):
             return
-        self._submit(_entry_from_decision(call_id, inputs, outputs))
+        self._submit(_entry_from_decision(call_id, inputs, outputs, trace_id=trace_id, shard=shard))
 
     def write_plan(self, call_id: str, plan_input: Any, plan_output: Any) -> None:
         """Plan decision entry mirroring DecisionLogEntry.PlanResources

@@ -36,8 +36,11 @@ class Core:
     audit_log: Any
     tpu_evaluator: Any = None
     batcher: Any = None
+    sentinel: Any = None
 
     def close(self) -> None:
+        if self.sentinel is not None:
+            self.sentinel.close()
         if self.batcher is not None:
             self.batcher.close()
         if self.audit_log is not None:
@@ -302,6 +305,21 @@ def initialize(
         rstate.bind_health(batcher.health_state)
     else:
         rstate.bind_health((lambda: health.state) if health is not None else None)
+
+    # parity sentinel: online shadow-oracle sampling of completed device
+    # batches. It attaches wherever real batcher lanes live — standalone,
+    # the shared-batcher process of the --frontends topology, and every
+    # lane of the sharded pool. Front ends carry no device, so nothing to
+    # sample there.
+    sentinel = None
+    if role != "frontend" and batcher is not None:
+        from .engine import sentinel as _sentinel
+
+        s = _sentinel.from_config(tpu_conf.get("paritySentinel", {}) or {})
+        if s.enabled:
+            sentinel = s.attach(batcher)
+    rstate.bind_parity(sentinel.storm_shards if sentinel is not None else None)
+
     warm_conf = tpu_conf.get("warmup", {}) or {}
     if role == "frontend":
         pass
@@ -412,6 +430,7 @@ def initialize(
         audit_log=audit_log,
         tpu_evaluator=tpu_evaluator,
         batcher=batcher,
+        sentinel=sentinel,
     )
 
 

@@ -195,6 +195,120 @@ def _decisions_browser(client, tail: int, follow: bool, interval: float) -> int:
         return 0
 
 
+def _effects_only(rows: list[dict]) -> list[dict]:
+    """Project effect rows down to resource_id + action→effect (the API
+    response carries effects; policy/scope provenance needs the local oracle)."""
+    return [
+        {
+            "resourceId": r.get("resourceId", ""),
+            "actions": {a: {"effect": (e or {}).get("effect", "")} for a, e in (r.get("actions") or {}).items()},
+        }
+        for r in rows
+    ]
+
+
+def _replay_local(records, policies_path: str):
+    """Replay corpus inputs on a freshly built local CPU oracle — the
+    bit-exact reference, independent of any running server."""
+    import glob
+    import os
+
+    from .compile import compile_policy_set
+    from .engine import types as T
+    from .engine.sentinel import effect_rows, input_from_json
+    from .policy.parser import parse_policies
+    from .ruletable import build_rule_table, check_input
+
+    paths = []
+    if os.path.isdir(policies_path):
+        for pat in ("*.yaml", "*.yml"):
+            paths.extend(sorted(glob.glob(os.path.join(policies_path, "**", pat), recursive=True)))
+    else:
+        paths = [policies_path]
+    policies = []
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            policies.extend(parse_policies(f.read()))
+    if not policies:
+        raise SystemExit(f"error: no policies found at {policies_path}")
+    rt = build_rule_table(compile_policy_set(policies))
+    params = T.EvalParams()
+    for _path, rec in records:
+        inputs = [input_from_json(j) for j in rec.get("inputs", [])]
+        yield rec, effect_rows([check_input(rt, i, params, None) for i in inputs])
+
+
+def _replay_server(records, client):
+    """Replay corpus inputs through a running PDP's /api/check/resources —
+    one request per input (each corpus input carries its own principal)."""
+    for _path, rec in records:
+        rows = []
+        for j in rec.get("inputs", []):
+            body = {
+                "requestId": j.get("requestId", ""),
+                "principal": j.get("principal") or {},
+                "resources": [{"resource": j.get("resource") or {}, "actions": j.get("actions") or []}],
+            }
+            resp = client.call("POST", "/api/check/resources", body=body)
+            results = resp.get("results") or [{}]
+            r = results[0]
+            rows.append(
+                {
+                    "resourceId": (r.get("resource") or {}).get("id", ""),
+                    "actions": {a: {"effect": eff} for a, eff in (r.get("actions") or {}).items()},
+                }
+            )
+        yield rec, rows
+
+
+def _replay_divergences(args, client) -> int:
+    """Offline repro of captured parity divergences: re-evaluate each corpus
+    record's raw inputs (local oracle with --policies, else through the
+    server API) and report whether the recorded oracle effects reproduce and
+    whether the recorded device effects still diverge."""
+    from .engine.sentinel import DivergenceCorpus, compare_rows
+
+    records = DivergenceCorpus.load(args.dir)
+    if not records:
+        print(f"no divergence records in {args.dir}")
+        return 0
+    if args.policies:
+        replays = _replay_local(records, args.policies)
+        exact = True
+    else:
+        replays = _replay_server(records, client)
+        exact = False  # API replies carry effects, not policy/scope provenance
+    total = reproduced = still_divergent = 0
+    for rec, fresh in replays:
+        total += 1
+        recorded_oracle = rec.get("oracle_effects") or []
+        recorded_device = rec.get("device_effects") or []
+        if not exact:
+            recorded_oracle = _effects_only(recorded_oracle)
+            recorded_device = _effects_only(recorded_device)
+            fresh = _effects_only(fresh)
+        oracle_ok = not compare_rows(fresh, recorded_oracle)
+        device_diff = compare_rows(fresh, recorded_device)
+        reproduced += oracle_ok
+        still_divergent += bool(device_diff)
+        mark = "ok " if oracle_ok else "DRIFT"
+        print(
+            f"{mark} shard={rec.get('shard')} batch={rec.get('batch_id')} "
+            f"inputs={len(rec.get('inputs', []))} "
+            f"device_still_diverges={'yes' if device_diff else 'no'} "
+            f"traces={','.join(rec.get('trace_ids') or []) or '-'}"
+        )
+    mode = "bit-exact (local oracle)" if exact else "effects-only (server API)"
+    print(
+        f"\nreplayed {total} divergence record(s) [{mode}]: "
+        f"{reproduced} reproduce the recorded oracle effects, "
+        f"{still_divergent} still diverge from the recorded device effects"
+    )
+    # drift between replay and the recorded oracle means the policies changed
+    # since capture — the repro is stale, flag it to the operator
+    return 0 if reproduced == total else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="cerbos-tpuctl", description="Admin client for cerbos-tpu PDPs")
     parser.add_argument("--server", default="127.0.0.1:3592")
@@ -236,7 +350,26 @@ def main(argv: list[str] | None = None) -> int:
     p_dec.add_argument("--follow", action="store_true", help="poll for new entries")
     p_dec.add_argument("--interval", type=float, default=2.0)
 
+    p_replay = sub.add_parser(
+        "replay-divergences",
+        help="replay the parity sentinel's divergence corpus (offline repro of device/oracle mismatches)",
+    )
+    p_replay.add_argument(
+        "--dir", required=True, help="divergence corpus directory (engine.tpu.paritySentinel.corpusDir)"
+    )
+    p_replay.add_argument(
+        "--policies",
+        default="",
+        help="policy YAML file or directory: replay on a local CPU oracle (bit-exact) instead of the server API",
+    )
+
     args = parser.parse_args(argv)
+    if args.command == "replay-divergences":
+        # local-oracle replay needs no server at all; the API fallback uses
+        # the plain HTTP client (check endpoint, not the admin surface)
+        return _replay_divergences(
+            args, Client(args.server, args.username, args.password) if not args.policies else None
+        )
     if args.grpc:
         client: Client | GrpcClient = GrpcClient(args.server, args.username, args.password)
     else:

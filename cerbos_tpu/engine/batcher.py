@@ -178,6 +178,10 @@ class BatchingEvaluator:
         self.min_batch_to_wait = min_batch_to_wait
         self.max_inflight = max(1, int(max_inflight))
         self.health = health
+        # parity sentinel (engine/sentinel.py), attached post-construction;
+        # when set, completed device batches are offered for shadow-oracle
+        # sampling from the drain thread
+        self.sentinel: Optional[Any] = None
         self.quarantine_max = max(1, int(quarantine_max))
         self.bisect_budget = max(3, int(bisect_budget))
         self._queue: deque[_Pending] = deque()
@@ -286,6 +290,7 @@ class BatchingEvaluator:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
     ) -> list[T.CheckOutput]:
+        T.set_current_shard(self.shard_id if self.shard_id is not None else 0)
         if deadline is not None and time.monotonic() >= deadline:
             self._count_deadline_drop()
             raise DeadlineExceeded("request deadline expired before evaluation")
@@ -619,6 +624,11 @@ class BatchingEvaluator:
         flight.timings["settle"] = settle_s
         self.m_stage_seconds.observe("settle", settle_s)
         self._record_flight(flight, outcome="ok")
+        sentinel = self.sentinel
+        if sentinel is not None:
+            # after settle so the sentinel never adds to request latency;
+            # observe_batch is guaranteed non-raising and non-blocking
+            sentinel.observe_batch(self, flight, outputs)
 
     def _record_flight(self, flight: _Inflight, outcome: str) -> None:
         health = self.health

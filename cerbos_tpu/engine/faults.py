@@ -20,6 +20,12 @@ silently disable a chaos run):
 - ``poison_attr:KEY`` — any batch containing an input whose resource attr
   has KEY raises ``DeviceFault`` (submit and check, so off-path bisection
   reproduces the failure).
+- ``flip_effect:P`` — post-collect, flip each returned effect row
+  (ALLOW↔DENY) with probability P (0..1). Unlike the raising knobs this
+  is a *silent* corruption: the batch succeeds, the caller gets wrong
+  answers, and nothing errors — exactly the failure class the parity
+  sentinel exists to catch. Only the device path is corrupted; the CPU
+  oracle bypasses the injector, so sentinel replays see the true effects.
 - ``ipc_wedge_after:N`` — consumed by ``engine/ipc.BatcherIpcServer``, not
   this wrapper: after N CHECK tickets the ticket queue swallows every
   subsequent one without replying, simulating a wedged ring so front ends
@@ -47,7 +53,7 @@ class DeviceFault(RuntimeError):
     """An injected device-path failure."""
 
 
-_FLOAT_KNOBS = {"submit_raise", "collect_raise", "check_raise", "wedge_sleep_s"}
+_FLOAT_KNOBS = {"submit_raise", "collect_raise", "check_raise", "wedge_sleep_s", "flip_effect"}
 _INT_KNOBS = {"submit_delay_ms", "collect_delay_ms", "wedge_after", "ipc_wedge_after", "seed", "shard"}
 _STR_KNOBS = {"poison_attr"}
 
@@ -88,7 +94,7 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._calls = 0
         self.stats = getattr(evaluator, "stats", None)
-        self.injected = {"raises": 0, "delays": 0, "wedges": 0, "poisoned": 0}
+        self.injected = {"raises": 0, "delays": 0, "wedges": 0, "poisoned": 0, "flipped": 0}
 
     def __getattr__(self, name):
         return getattr(self._ev, name)
@@ -136,12 +142,50 @@ class FaultInjector:
                 self.injected["poisoned"] += 1
                 raise DeviceFault(f"injected poison input (resource attr {key!r})")
 
+    def _maybe_flip(self, outputs):
+        """Silent-corruption knob: flip sampled effect rows ALLOW↔DENY after
+        the device returned them. Mutates copies, not the originals — the
+        evaluator may cache or share output objects."""
+        p = self.spec.get("flip_effect")
+        if not p or not outputs:
+            return outputs
+        from . import types as T
+
+        flipped = []
+        for o in outputs:
+            if not self._roll(p):
+                flipped.append(o)
+                continue
+            actions = {
+                a: T.ActionEffect(
+                    effect=(
+                        T.EFFECT_DENY if e.effect == T.EFFECT_ALLOW else T.EFFECT_ALLOW
+                    ),
+                    policy=e.policy,
+                    scope=e.scope,
+                )
+                for a, e in o.actions.items()
+            }
+            self.injected["flipped"] += 1
+            flipped.append(
+                T.CheckOutput(
+                    request_id=o.request_id,
+                    resource_id=o.resource_id,
+                    actions=actions,
+                    effective_derived_roles=list(o.effective_derived_roles),
+                    validation_errors=list(o.validation_errors),
+                    outputs=list(o.outputs),
+                    effective_policies=dict(o.effective_policies),
+                )
+            )
+        return flipped
+
     # -- evaluator surface --------------------------------------------------
 
     def check(self, inputs, params=None):
         self._check_poison(inputs)
         self._maybe_raise("check_raise", "check")
-        return self._ev.check(inputs, params)
+        return self._maybe_flip(self._ev.check(inputs, params))
 
     def submit(self, inputs, params=None):
         self._maybe_wedge("submit")
@@ -154,4 +198,4 @@ class FaultInjector:
         self._maybe_wedge("collect")
         self._maybe_raise("collect_raise", "collect")
         self._maybe_delay("collect_delay_ms")
-        return self._ev.collect(ticket)
+        return self._maybe_flip(self._ev.collect(ticket))

@@ -199,6 +199,19 @@ class DeviceHealth:
             if n >= self.timeout_min_samples and timeouts / n >= self.timeout_rate_threshold:
                 self._trip_locked("timeout_rate")
 
+    def trip(self, cause: str) -> None:
+        """Externally-forced trip: the parity sentinel's storm policy calls
+        this when a lane keeps returning effects the CPU oracle disagrees
+        with — wrong answers are worse than slow ones, so the lane is routed
+        to the oracle just as if it were erroring. No-op while already OPEN
+        (the probe backoff in progress stays paced)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._state == STATE_OPEN:
+                return
+            self._trip_locked(cause)
+
     def probe_succeeded(self, token: int) -> None:
         with self._lock:
             if token != self._probe_token or self._state != STATE_HALF_OPEN:

@@ -546,6 +546,13 @@ class RemoteBatcherClient:
 
     def _connection_loop(self) -> None:
         while not self._stop:
+            # until the FIRST attach succeeds, retry fast: at boot the
+            # batcher's listen() and this loop race, and a front end that
+            # loses by a millisecond must not serve warming 503s for a
+            # full steady-state retry period after its HTTP listener opens
+            retry_s = self.connect_retry_s if self.stats["reconnects"] else min(
+                0.025, self.connect_retry_s
+            )
             try:
                 sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 sock.connect(self.socket_path)
@@ -554,7 +561,7 @@ class RemoteBatcherClient:
                     sock.close()
                 except OSError:
                     pass
-                time.sleep(self.connect_retry_s)
+                time.sleep(retry_s)
                 continue
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
             try:
@@ -566,7 +573,7 @@ class RemoteBatcherClient:
                     sock.close()
                 except OSError:
                     pass
-                time.sleep(self.connect_retry_s)
+                time.sleep(retry_s)
                 continue
             self._sock = sock
             self._connected.set()
@@ -617,6 +624,14 @@ class RemoteBatcherClient:
 
     def _status_loop(self) -> None:
         while not self._stop:
+            if not self._connected.is_set():
+                # block on the attach event rather than sleeping a full
+                # steady-state period: front-end readiness hinges on the
+                # first status frame, so a boot-order race between the
+                # batcher's listen() and this loop must not cost 500ms
+                self._connected.wait(timeout=self.status_poll_s)
+                if self._stop:
+                    return
             if self._connected.is_set():
                 try:
                     mtype, payload = self._request(T_STATUS, b"", timeout=2.0)
@@ -627,7 +642,8 @@ class RemoteBatcherClient:
                             self._ever_ready = True
                 except (IpcError, OSError, FutureTimeoutError, TimeoutError, ValueError):
                     pass
-            time.sleep(self.status_poll_s)
+            # fast cadence until the first frame lands, configured cadence after
+            time.sleep(self.status_poll_s if self._last_status is not None else 0.05)
 
     # -- raw request/response -----------------------------------------------
 

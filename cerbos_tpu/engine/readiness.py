@@ -58,6 +58,7 @@ class ReadinessState:
         self._warmed_at: Optional[float] = None
         self._health: Optional[Callable[[], str]] = None
         self._remote: Optional[Callable[[], dict]] = None
+        self._parity: Optional[Callable[[], list]] = None
         self.m_state.set(_STATUS_CODE["ready"])
 
     # -- transitions (driven by bootstrap / the warmup driver) -------------
@@ -94,6 +95,13 @@ class ReadinessState:
         breaker state string (``closed`` / ``open`` / ``half_open``)."""
         self._health = provider
 
+    def bind_parity(self, provider: Optional[Callable[[], list]]) -> None:
+        """Wire the parity sentinel's storm state in: any shard inside a
+        divergence storm reports ``degraded`` with reason ``parity`` (still
+        serving — the tripped lane rides the CPU oracle, which is correct by
+        definition). ``provider`` returns the storming shard ids."""
+        self._parity = provider
+
     def bind_remote(self, provider: Optional[Callable[[], dict]]) -> None:
         """Front-end mode: this process has no device of its own — readiness
         is the SHARED batcher process's readiness, fetched over the ticket
@@ -129,8 +137,19 @@ class ReadinessState:
                         st = "degraded"
                 except Exception:
                     pass
+            if st == "ready" and self._parity_shards():
+                st = "degraded"
         self.m_state.set(_STATUS_CODE[st])
         return st
+
+    def _parity_shards(self) -> list:
+        provider = getattr(self, "_parity", None)
+        if provider is None:
+            return []
+        try:
+            return list(provider())
+        except Exception:
+            return []
 
     def serving(self) -> bool:
         """Gate decision: warming withholds traffic; degraded is live."""
@@ -151,6 +170,7 @@ class ReadinessState:
             self.m_state.set(_STATUS_CODE[snap["status"]])
             return snap
         st = self.status()
+        parity_shards = self._parity_shards()
         with self._lock:
             out = {
                 "status": st,
@@ -159,6 +179,9 @@ class ReadinessState:
             }
             if self._warmup_error:
                 out["warmup_error"] = self._warmup_error
+        if parity_shards:
+            out["reason"] = "parity"
+            out["parity_shards"] = parity_shards
         return out
 
 

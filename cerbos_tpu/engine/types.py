@@ -8,10 +8,27 @@ become doubles at ingestion so CEL sees the same types as the reference.
 
 from __future__ import annotations
 
+import contextvars
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ..util import normalize_attr
+
+# which device lane evaluated the current request — set on the request
+# thread by the batcher/shard-pool entry points, read by the service layer
+# to stamp audit decision entries (the audit↔flight-recorder join key).
+# A ContextVar (not a plain thread-local) so async callers inherit it.
+_current_shard: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "cerbos_tpu_current_shard", default=None
+)
+
+
+def set_current_shard(shard: Optional[int]) -> None:
+    _current_shard.set(shard)
+
+
+def current_shard() -> Optional[int]:
+    return _current_shard.get()
 
 EFFECT_ALLOW = "EFFECT_ALLOW"
 EFFECT_DENY = "EFFECT_DENY"

@@ -646,12 +646,44 @@ class Server:
         Front-end mode: the flight recorder (and breaker state) live in the
         shared batcher process — fetch its dump over the ticket queue so the
         debug surface keeps pointing at where device batches actually run.
-        A dead batcher falls back to the (empty) local ring with a note."""
+        A dead batcher falls back to the (empty) local ring with a note.
+
+        ``?shard=N`` narrows the dump to one lane of the sharded pool
+        (batch records via their ``shard`` field — ``FlightRecorder.lane``
+        semantics, with single-batcher records counting as shard 0 — and
+        events carrying a matching ``shard``; shard-less events such as
+        config notes stay, they are global)."""
+        shard_q = request.query.get("shard")
+        shard_filter: Optional[int] = None
+        if shard_q is not None:
+            try:
+                shard_filter = int(shard_q)
+            except ValueError:
+                return web.json_response(
+                    {"error": f"invalid shard {shard_q!r} (want an integer)"}, status=400
+                )
+
+        def narrowed(body: dict) -> dict:
+            if shard_filter is None:
+                return body
+            norm = lambda v: 0 if v is None else v  # noqa: E731
+            body = dict(body)
+            body["batches"] = [
+                r for r in body.get("batches") or [] if norm(r.get("shard")) == shard_filter
+            ]
+            body["events"] = [
+                e
+                for e in body.get("events") or []
+                if "shard" not in e or norm(e.get("shard")) == shard_filter
+            ]
+            body["shard_filter"] = shard_filter
+            return body
+
         ev = getattr(self.svc.engine, "tpu_evaluator", None)
         if ev is not None and hasattr(ev, "fetch_flight"):
             try:
                 remote = await asyncio.get_running_loop().run_in_executor(None, ev.fetch_flight)
-                body = dict(remote.get("flight") or {})
+                body = narrowed(dict(remote.get("flight") or {}))
                 body["source"] = "batcher"
                 body["batcher_pid"] = remote.get("pid")
                 resp = web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
@@ -661,12 +693,12 @@ class Server:
                     )
                 return resp
             except Exception as e:  # noqa: BLE001
-                body = dict(flight_recorder().dump())
+                body = narrowed(dict(flight_recorder().dump()))
                 body["source"] = "frontend"
                 body["batcher_error"] = f"{type(e).__name__}: {e}"
                 return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
         resp = web.json_response(
-            flight_recorder().dump(), dumps=lambda o: json.dumps(o, default=str)
+            narrowed(flight_recorder().dump()), dumps=lambda o: json.dumps(o, default=str)
         )
         try:
             from ..tpu import jitcache

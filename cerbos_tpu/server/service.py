@@ -101,10 +101,16 @@ class CerbosService:
             "request.CheckResources", parent=trace_ctx, resources=len(inputs)
         ) as span:
             span.set_attribute("call_id", call_id)
+            # clear any shard affinity left by a previous request on this
+            # thread; the batcher re-stamps it if the device path is taken
+            T.set_current_shard(None)
             outputs = self.engine.check(inputs, params=params, deadline=deadline)
+            trace_id = span.context.trace_id
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
-            self.audit_log.write_decision(call_id, inputs, outputs)
+            self.audit_log.write_decision(
+                call_id, inputs, outputs, trace_id=trace_id, shard=T.current_shard()
+            )
         return outputs, call_id
 
     def _validate_check(self, inputs: list[T.CheckInput]) -> None:
@@ -137,10 +143,14 @@ class CerbosService:
             "request.CheckResources", parent=trace_ctx, resources=len(inputs)
         ) as span:
             span.set_attribute("call_id", call_id)
+            T.set_current_shard(None)
             outputs = await self.engine.check_await(inputs, params=params, deadline=deadline)
+            trace_id = span.context.trace_id
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
-            self.audit_log.write_decision(call_id, inputs, outputs)
+            self.audit_log.write_decision(
+                call_id, inputs, outputs, trace_id=trace_id, shard=T.current_shard()
+            )
         return outputs, call_id
 
     def plan_resources(self, input: Any, params: Optional[T.EvalParams] = None) -> tuple[Any, str]:

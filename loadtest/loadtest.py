@@ -235,6 +235,73 @@ def _read_http_response(sock: socket.socket, buf: bytearray) -> bytes:
         buf.extend(chunk)
 
 
+def _scrape_metrics(http_port: int) -> str:
+    """One-shot GET /_cerbos/metrics over a raw socket (the harness has no
+    HTTP client dependency); empty string when the server is unreachable."""
+    try:
+        s = socket.create_connection(("127.0.0.1", http_port), timeout=5)
+        s.sendall(b"GET /_cerbos/metrics HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+        data = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data.extend(chunk)
+        s.close()
+        return bytes(data).split(b"\r\n\r\n", 1)[-1].decode(errors="replace")
+    except OSError:
+        return ""
+
+
+def _parity_block(text: str, elapsed: float) -> dict:
+    """Fold the parity sentinel's /_cerbos/metrics series into the result
+    artifact: checks, divergences, lag p99 (from the histogram buckets),
+    and sentinel overhead as % of the run's wall clock."""
+    checks = divergences = storms = dropped = replay_s = lag_count = 0.0
+    buckets: list[tuple[float, float]] = []
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith("cerbos_tpu_parity_"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        if series.startswith("cerbos_tpu_parity_checks_total"):
+            checks += v
+        elif series.startswith("cerbos_tpu_parity_divergence_total"):
+            divergences += v
+        elif series.startswith("cerbos_tpu_parity_storms_total"):
+            storms += v
+        elif series.startswith("cerbos_tpu_parity_dropped_total"):
+            dropped += v
+        elif series.startswith("cerbos_tpu_parity_replay_seconds_total"):
+            replay_s += v
+        elif series.startswith("cerbos_tpu_parity_lag_seconds_count"):
+            lag_count = v
+        elif series.startswith("cerbos_tpu_parity_lag_seconds_bucket"):
+            at = series.find('le="')
+            if at >= 0:
+                le = series[at + 4 : series.index('"', at + 4)]
+                buckets.append((float("inf") if le == "+Inf" else float(le), v))
+    lag_p99 = 0.0
+    if lag_count:
+        target = 0.99 * lag_count
+        finite = sorted(b for b, _ in buckets if b != float("inf"))
+        for le, cum in sorted(buckets):
+            if cum >= target:
+                lag_p99 = le if le != float("inf") else (finite[-1] if finite else 0.0)
+                break
+    return {
+        "checks": int(checks),
+        "divergences": int(divergences),
+        "storms": int(storms),
+        "dropped": int(dropped),
+        "lag_p99_s": lag_p99,
+        "overhead_pct": round(100.0 * replay_s / elapsed, 3) if elapsed else 0.0,
+    }
+
+
 def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0) -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
@@ -332,6 +399,9 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     for w in threads:
         w.join(timeout=10)
     elapsed = time.perf_counter() - t_start
+    # scrape the parity sentinel's series BEFORE killing the server — the
+    # correctness half of the artifact lives in the server process
+    parity = _parity_block(_scrape_metrics(http_port), elapsed)
     proc.terminate()
     try:
         proc.wait(timeout=15)
@@ -371,6 +441,9 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         "host_cores": len(os.sched_getaffinity(0)),
         "policies": n_mods * 9,  # 9 policy documents per name-mod
         "duration_s": round(elapsed, 1),
+        # shadow-oracle parity over the server's own device batches
+        # (engine/sentinel.py), scraped from /_cerbos/metrics pre-shutdown
+        "parity": parity,
     }
 
 

@@ -164,6 +164,11 @@ class TestMetricsLint:
         covered in test_observability)."""
         core = _boot(tmp_path_factory, "lint-policies")
         try:
+            # the async audit path registers its queue metrics at
+            # construction; default config has audit off, so build one here
+            from cerbos_tpu.audit.log import AuditLog
+
+            AuditLog(backend=None).close()
             inst = obs.metrics().instruments()
             # the device-path instruments this PR adds must be registered
             for name in (
@@ -182,6 +187,19 @@ class TestMetricsLint:
                 "cerbos_tpu_readiness_state",
                 "cerbos_tpu_warmup_expected_layouts",
                 "cerbos_tpu_warmup_compiled_layouts",
+                # parity-sentinel family (engine/sentinel.py): bootstrap
+                # attaches the sentinel to every local batcher by default
+                "cerbos_tpu_parity_checks_total",
+                "cerbos_tpu_parity_divergence_total",
+                "cerbos_tpu_parity_lag_seconds",
+                "cerbos_tpu_parity_sample_rate",
+                "cerbos_tpu_parity_dropped_total",
+                "cerbos_tpu_parity_replay_seconds_total",
+                "cerbos_tpu_parity_storms_total",
+                "cerbos_tpu_parity_corpus_records",
+                # async audit-path family (audit/log.py)
+                "cerbos_tpu_audit_queue_depth",
+                "cerbos_tpu_audit_dropped_total",
             ):
                 assert name in inst, name
             known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.GaugeVec, obs.Histogram, obs.HistogramVec)
@@ -198,6 +216,9 @@ class TestMetricsLint:
                 "cerbos_tpu_breaker_state": obs.GaugeVec,
                 "cerbos_tpu_batch_padding_waste_rows_total": obs.CounterVec,
                 "cerbos_tpu_breaker_trips_total": obs.CounterVec,
+                "cerbos_tpu_parity_checks_total": obs.CounterVec,
+                "cerbos_tpu_parity_divergence_total": obs.CounterVec,
+                "cerbos_tpu_parity_storms_total": obs.CounterVec,
             }
             for name, typ in sharded.items():
                 m = inst.get(name)
