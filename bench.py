@@ -223,14 +223,14 @@ def _merged_percentile(buckets: list, counts: list, count: int, p: float) -> flo
     return buckets[-1] if buckets else 0.0
 
 
-def _stage_percentiles() -> dict:
-    """Per-stage p50/p99 from the batcher's cerbos_tpu_batch_stage_seconds
-    HistogramVec, for the machine-readable perf artifact. Children are keyed
-    (stage, shard) since the sharded pool; shards merge into one per-stage
-    summary here (the per-shard split lives in the topology block)."""
+def _stage_percentiles(metric: str = "cerbos_tpu_batch_stage_seconds") -> dict:
+    """Per-stage p50/p99 from a stage-keyed HistogramVec, for the
+    machine-readable perf artifact. Children are keyed (stage, shard) since
+    the sharded pool; shards merge into one per-stage summary here (the
+    per-shard split lives in the topology block)."""
     from cerbos_tpu.observability import metrics
 
-    vec = metrics().instruments().get("cerbos_tpu_batch_stage_seconds")
+    vec = metrics().instruments().get(metric)
     if vec is None:
         return {}
     with vec._lock:
@@ -254,6 +254,48 @@ def _stage_percentiles() -> dict:
             "count": m["count"],
         }
     return stages
+
+
+def _request_waterfall() -> dict:
+    """Per-request latency-budget waterfall summary: per-stage percentiles
+    from cerbos_tpu_request_stage_seconds plus the fraction of request wall
+    clock the named stages explain (the reconciliation figure)."""
+    from cerbos_tpu.observability import metrics
+
+    inst = metrics().instruments()
+    vec = inst.get("cerbos_tpu_request_stage_seconds")
+    total = inst.get("cerbos_tpu_request_total_seconds")
+    if vec is None or total is None:
+        return {}
+    with vec._lock:
+        children = list(vec._children.values())
+    stage_sum = sum(h.snapshot()[1] for h in children)
+    _, total_sum, count = total.snapshot()
+    return {
+        "requests": count,
+        "total_p50_s": round(total.percentile(0.50), 6),
+        "total_p99_s": round(total.percentile(0.99), 6),
+        "attributed_frac": round(stage_sum / total_sum, 4) if total_sum else 0.0,
+        "stages": _stage_percentiles("cerbos_tpu_request_stage_seconds"),
+    }
+
+
+def _goodput(wall: float) -> dict:
+    """Goodput vs throughput from cerbos_tpu_decisions_total{outcome}:
+    goodput = correctly served inside the budget (device or oracle)."""
+    from cerbos_tpu.engine.budget import OUTCOME_MET, OUTCOME_ORACLE, tracker
+
+    vec = tracker().m_decisions
+    with vec._lock:
+        outcomes = dict(vec._children)
+    throughput = sum(outcomes.values())
+    good = outcomes.get(OUTCOME_MET, 0.0) + outcomes.get(OUTCOME_ORACLE, 0.0)
+    return {
+        "outcomes": {k: int(v) for k, v in sorted(outcomes.items())},
+        "throughput_per_sec": round(throughput / wall, 1) if wall else 0.0,
+        "goodput_per_sec": round(good / wall, 1) if wall else 0.0,
+        "goodput_frac": round(good / throughput, 4) if throughput else 0.0,
+    }
 
 
 def _compile_economy() -> dict:
@@ -352,13 +394,35 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
     reqs = [all_inputs[b : b + req_size] for b in range(0, round_inputs, req_size)]
     decisions_per_round = sum(len(i.actions) for r in reqs for i in r)
 
+    # each bench client carries a latency-budget waterfall, exactly as a
+    # server ingress would, so the artifact gets the per-stage attribution
+    # and goodput split for free
+    from cerbos_tpu.engine import budget as _budget
+
+    def _serve(r):
+        trk = _budget.tracker()
+        wf = trk.start()
+        try:
+            out = batcher.check(r, params, wf=wf)
+        except Exception:
+            trk.finish(wf, _budget.OUTCOME_EXPIRED)
+            raise
+        trk.finish(
+            wf,
+            _budget.OUTCOME_ORACLE
+            if wf is not None and wf.served_by == "oracle"
+            else _budget.OUTCOME_MET,
+            final_stage=_budget.STAGE_REPLY_ENCODE,
+        )
+        return out
+
     pool = ThreadPoolExecutor(max_workers=n_clients)
     try:
-        outs = list(pool.map(lambda r: batcher.check(r, params), reqs))  # warmup
+        outs = list(pool.map(_serve, reqs))  # warmup
         gctune.tune_for_serving()
         t0 = time.perf_counter()
         for _ in range(n_rounds):
-            outs = list(pool.map(lambda r: batcher.check(r, params), reqs))
+            outs = list(pool.map(_serve, reqs))
         wall = time.perf_counter() - t0
     finally:
         pool.shutdown(wait=True)
@@ -396,6 +460,11 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
         # per-stage latency attribution + device-layout economics from the
         # observability layer (the same series /_cerbos/metrics exposes)
         "stages": _stage_percentiles(),
+        # per-request latency-budget waterfall + goodput accounting (PR 9):
+        # where each request's wall clock went, and how much of the measured
+        # throughput was served inside its budget
+        "waterfall": _request_waterfall(),
+        "goodput": _goodput(wall),
         "occupancy": occupancy,
         "padding_waste_rows": padding_waste,
         "compile": _compile_economy(),

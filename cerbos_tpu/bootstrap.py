@@ -160,6 +160,26 @@ def initialize(
         max_artifacts=int(prof_conf.get("maxArtifacts", 4)),
         max_seconds=float(prof_conf.get("maxSeconds", 30)),
     )
+    # per-request latency-budget waterfall + goodput accounting; the
+    # saturation pressure monitor binds its role-specific signal sources
+    # further down, once the batcher topology exists
+    budget_conf = tpu_conf.get("latencyBudget", {}) or {}
+    from .engine import budget as _budget
+
+    _budget.tracker().configure(
+        enabled=bool(budget_conf.get("enabled", True)),
+        slow_capacity=int(budget_conf.get("slowRingCapacity", 64)),
+        slow_threshold_ms=float(budget_conf.get("slowThresholdMs", 250)),
+    )
+    _flight.bind_slow_requests(_budget.tracker().slow_dump)
+    pressure_conf = tpu_conf.get("pressure", {}) or {}
+    from .engine import pressure as _pressure
+
+    _pressure.monitor().configure(
+        enabled=bool(pressure_conf.get("enabled", True)),
+        window_s=float(pressure_conf.get("windowSec", 30)),
+        interval_s=float(pressure_conf.get("intervalMs", 500)) / 1000.0,
+    )
 
     tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
     tpu_evaluator = None
@@ -320,6 +340,51 @@ def initialize(
             sentinel = s.attach(batcher)
     rstate.bind_parity(sentinel.storm_shards if sentinel is not None else None)
 
+    # pressure monitor: bind whatever saturation sources this role actually
+    # has (zero-arg callables, read defensively at sample time) and start
+    # the ticker so the rolling windows stay warm between scrapes
+    mon = _pressure.monitor()
+    mon.bind(decisions=lambda: _budget.tracker().m_decisions.value)
+    if role == "frontend":
+        client = batcher
+        mon.bind(
+            ipc=lambda c=client, s=shared_conf: (
+                len(c._pending),
+                int(s.get("maxOutstanding", 4096)),
+            ),
+            fallbacks=lambda c=client: c.stats["oracle_fallbacks"],
+            breaker=lambda c=client: ((c._last_status or {}).get("breaker", "")),
+        )
+    elif batcher is not None and hasattr(batcher, "shards"):
+        pool = batcher
+        mon.bind(
+            queue=lambda p=pool: (
+                sum(l.load() for l in p.shards),
+                sum(l.max_batch for l in p.shards),
+            ),
+            inflight=lambda p=pool: (
+                sum(l.m_inflight.value for l in p.shards),
+                sum(l.max_inflight for l in p.shards),
+            ),
+            fallbacks=lambda p=pool: p.stats["oracle_fallbacks"],
+            breaker=pool.health_state,
+        )
+    elif batcher is not None:
+        b = batcher
+        mon.bind(
+            queue=lambda b=b: (b.load(), b.max_batch),
+            inflight=lambda b=b: (b.m_inflight.value, b.max_inflight),
+            fallbacks=lambda b=b: b.stats["oracle_fallbacks"],
+            breaker=(lambda h=health: h.state) if health is not None else None,
+        )
+    if sentinel is not None:
+        mon.bind(parity=sentinel.storm_shards)
+    if tpu_evaluator is not None:
+        from .tpu import compilestats as _compilestats
+
+        mon.bind(storms=lambda: _compilestats.stats().detector.storms)
+    mon.start_ticker()
+
     warm_conf = tpu_conf.get("warmup", {}) or {}
     if role == "frontend":
         pass
@@ -461,6 +526,13 @@ def build_batcher_ipc(core: Core, socket_path: str):
         readiness=_readiness.state().snapshot,
         max_outstanding=int(shared_conf.get("maxOutstanding", 4096)),
         faults=faults,
+    )
+    # this process fronts the ticket ring: its occupancy is the ipc
+    # pressure component (front ends see their own pending count instead)
+    from .engine import pressure as _pressure
+
+    _pressure.monitor().bind(
+        ipc=lambda s=server: (s._outstanding, s.max_outstanding)
     )
     server.start()
     return server

@@ -90,6 +90,21 @@ DEFAULTS: dict[str, Any] = {
                 "maxArtifacts": 4,
                 "maxSeconds": 30,
             },
+            # per-request latency-budget waterfall + goodput accounting:
+            # stage histograms, decisions_total{outcome}, and the bounded
+            # slow-request ring at /_cerbos/debug/slow
+            "latencyBudget": {
+                "enabled": True,
+                "slowRingCapacity": 64,
+                "slowThresholdMs": 250,
+            },
+            # saturation pressure signals: rolling 0..1 components + the
+            # cerbos_tpu_pressure_score gauge and /_cerbos/debug/pressure
+            "pressure": {
+                "enabled": True,
+                "intervalMs": 500,
+                "windowSec": 30,
+            },
         },
     },
     "storage": {"driver": "disk", "disk": {"directory": "policies", "watchForChanges": False}},

@@ -43,6 +43,19 @@ from typing import Any, Optional, Sequence
 from ..observability import SpanContext, current_span_context, export_span, start_span
 from ..ruletable import check_input
 from . import types as T
+from .budget import (
+    POINT_DEVICE_SUBMIT,
+    POINT_ENQUEUE,
+    STAGE_ADMISSION,
+    STAGE_COLLECT,
+    STAGE_DEVICE,
+    STAGE_ORACLE,
+    STAGE_PACK,
+    STAGE_QUEUE_WAIT,
+    STAGE_SETTLE,
+    Waterfall,
+)
+from .budget import tracker as budget_tracker
 from .flight import recorder as flight_recorder
 from .health import DeviceHealth  # noqa: F401  (re-exported for wiring/tests)
 
@@ -79,6 +92,10 @@ class _Pending:
     # request's trace (span parenting via observability._current is
     # thread-local and dies at this hop otherwise)
     ctx: Optional[SpanContext] = None
+    # the request's latency-budget waterfall (engine/budget.py); like ctx it
+    # migrates with the request across the thread hop, and the drain thread
+    # books queue_wait/pack/device/collect/settle into it at settle time
+    wf: Optional[Waterfall] = None
 
 
 @dataclass
@@ -151,6 +168,9 @@ class BatchingEvaluator:
 
     # Engine forwards per-request deadlines only to evaluators that opt in.
     supports_deadline = True
+    # Engine forwards latency-budget waterfalls only to evaluators that
+    # book their own stages (admission/queue/pack/device/collect/settle).
+    supports_waterfall = True
 
     def __init__(
         self,
@@ -273,14 +293,20 @@ class BatchingEvaluator:
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams],
         reason: str,
+        wf: Optional[Waterfall] = None,
     ) -> list[T.CheckOutput]:
         self.stats["oracle_fallbacks"] += 1
         self.m_oracle_fallbacks.inc(reason)
+        if wf is not None:
+            wf.note_fallback(reason)
         ev = self.evaluator
-        return [
+        out = [
             check_input(ev.rule_table, i, params or T.EvalParams(), ev.schema_mgr)
             for i in inputs
         ]
+        if wf is not None:
+            wf.mark(STAGE_ORACLE)
+        return out
 
     # -- request path -------------------------------------------------------
 
@@ -289,13 +315,16 @@ class BatchingEvaluator:
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
+        wf: Optional[Waterfall] = None,
     ) -> list[T.CheckOutput]:
         T.set_current_shard(self.shard_id if self.shard_id is not None else 0)
+        if wf is not None:
+            wf.shard = self.shard_id if self.shard_id is not None else 0
         if deadline is not None and time.monotonic() >= deadline:
             self._count_deadline_drop()
             raise DeadlineExceeded("request deadline expired before evaluation")
         if self._quarantine and self._has_quarantined(inputs):
-            return self._serve_oracle(inputs, params, "quarantine")
+            return self._serve_oracle(inputs, params, "quarantine", wf=wf)
         health = self.health
         if health is not None and not health.allow_device():
             # breaker open: serve from the oracle with NO device wait; a due
@@ -303,15 +332,18 @@ class BatchingEvaluator:
             token = health.should_probe()
             if token is not None:
                 self._spawn_probe(token, list(inputs)[:16], params)
-            return self._serve_oracle(inputs, params, "breaker_open")
+            return self._serve_oracle(inputs, params, "breaker_open", wf=wf)
         if self._stop or self._dead is not None or not self._thread.is_alive():
             # drain loop gone (shutdown or crash): fail fast to the oracle
-            return self._serve_oracle(inputs, params, "batcher_dead")
+            return self._serve_oracle(inputs, params, "batcher_dead", wf=wf)
         with start_span("batcher.enqueue", inputs=len(inputs)) as span:
             fut: Future = Future()
             # the span context crosses the batcher thread hop in _Pending so
             # the device batch's spans land in this request's trace
-            pending = _Pending(list(inputs), params, fut, deadline=deadline, ctx=span.context)
+            pending = _Pending(
+                list(inputs), params, fut, deadline=deadline, ctx=span.context, wf=wf
+            )
+            self._admit_wf(wf, deadline)
             with self._wakeup:
                 self._queue.append(pending)
                 self._wakeup.notify()
@@ -328,7 +360,7 @@ class BatchingEvaluator:
                 # dead, or the breaker opened while queued): recover this
                 # request's own inputs from the oracle
                 span.set_attribute("outcome", e.reason)
-                return self._serve_oracle(pending.inputs, params, e.reason)
+                return self._serve_oracle(pending.inputs, params, e.reason, wf=wf)
             except (TimeoutError, FutureTimeoutError):  # distinct classes before 3.11
                 # a wedged device must not block server threads forever: drop the
                 # request from the queue (if still there) and serve it from the
@@ -345,7 +377,7 @@ class BatchingEvaluator:
                 if health is not None:
                     health.record_timeout()
                 span.set_attribute("outcome", "timeout")
-                return self._serve_oracle(pending.inputs, params, "timeout")
+                return self._serve_oracle(pending.inputs, params, "timeout", wf=wf)
 
     def check_async(
         self,
@@ -353,6 +385,7 @@ class BatchingEvaluator:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         ctx: Optional[SpanContext] = None,
+        wf: Optional[Waterfall] = None,
     ) -> Future:
         """Non-blocking enqueue for callers that hold many tickets at once
         (the IPC server fronting N worker processes cannot burn a thread per
@@ -363,6 +396,8 @@ class BatchingEvaluator:
         to ``list[CheckOutput]`` or raises ``DeadlineExceeded``/``_BatchFailed``.
         """
         fut: Future = Future()
+        if wf is not None:
+            wf.shard = self.shard_id if self.shard_id is not None else 0
         if deadline is not None and time.monotonic() >= deadline:
             self._count_deadline_drop()
             _settle(fut, error=DeadlineExceeded("request deadline expired before evaluation"))
@@ -380,11 +415,23 @@ class BatchingEvaluator:
         if self._stop or self._dead is not None or not self._thread.is_alive():
             _settle(fut, error=_BatchFailed(self._dead, "batcher_dead"))
             return fut
-        pending = _Pending(list(inputs), params, fut, deadline=deadline, ctx=ctx)
+        pending = _Pending(list(inputs), params, fut, deadline=deadline, ctx=ctx, wf=wf)
+        self._admit_wf(wf, deadline)
         with self._wakeup:
             self._queue.append(pending)
             self._wakeup.notify()
         return fut
+
+    def _admit_wf(self, wf: Optional[Waterfall], deadline: Optional[float]) -> None:
+        """Book the admission stage at enqueue and sample the remaining
+        deadline budget at the enqueue point."""
+        shard = self.shard_id if self.shard_id is not None else 0
+        if wf is not None:
+            wf.mark(STAGE_ADMISSION)
+        if deadline is not None:
+            budget_tracker().observe_budget(
+                POINT_ENQUEUE, deadline - time.monotonic(), shard=shard
+            )
 
     def _count_deadline_drop(self) -> None:
         self.stats["deadline_drops"] += 1
@@ -504,11 +551,20 @@ class BatchingEvaluator:
         for p in pending:
             groups.setdefault(id(p.params), []).append(p)
         now = time.perf_counter()
+        shard = self.shard_id if self.shard_id is not None else 0
         for group in groups.values():
             all_inputs: list[T.CheckInput] = []
             for p in group:
                 all_inputs.extend(p.inputs)
                 self.m_queue_wait.observe(now - p.enqueued_at)
+                if p.wf is not None:
+                    p.wf.mark(STAGE_QUEUE_WAIT)
+                if p.deadline is not None:
+                    # the second budget sample point: requests that reach the
+                    # device already near-expired show up here, not at enqueue
+                    budget_tracker().observe_budget(
+                        POINT_DEVICE_SUBMIT, p.deadline - time.monotonic(), shard=shard
+                    )
             batch_id = flight_recorder().next_batch_id()
             submit = getattr(self.evaluator, "submit", None)
             # parent the batch under the first co-batched request's trace and
@@ -618,8 +674,21 @@ class BatchingEvaluator:
         ):
             offset = 0
             for p in group:
+                if p.wf is not None:
+                    # batch-level stage durations attributed to every rider;
+                    # the residual (inflight-slot waits, scheduling) folds
+                    # into the settle mark so the stage sum still tiles the
+                    # request's wall clock
+                    p.wf.add(
+                        STAGE_PACK,
+                        flight.timings.get("pack", 0.0) + flight.timings.get("submit", 0.0),
+                    )
+                    p.wf.add(STAGE_DEVICE, flight.timings.get("device", 0.0))
+                    p.wf.add(STAGE_COLLECT, collect_s)
                 _settle(p.future, result=outputs[offset : offset + len(p.inputs)])
                 offset += len(p.inputs)
+                if p.wf is not None:
+                    p.wf.mark(STAGE_SETTLE)
         settle_s = time.perf_counter() - settle_start
         flight.timings["settle"] = settle_s
         self.m_stage_seconds.observe("settle", settle_s)

@@ -45,6 +45,7 @@ class Engine:
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
+        wf: Optional[Any] = None,
     ) -> list[T.CheckOutput]:
         from ..observability import start_span
 
@@ -52,17 +53,25 @@ class Engine:
         with start_span("engine.Check", batch_size=len(inputs)) as span:
             if self.tpu_evaluator is not None and len(inputs) >= self.tpu_batch_threshold:
                 span.set_attribute("path", "device")
+                kwargs = {}
                 if deadline is not None and getattr(self.tpu_evaluator, "supports_deadline", False):
                     # per-request deadline (from the gRPC context) rides down
                     # to the batcher, which drops expired work at drain time
-                    outputs = self.tpu_evaluator.check(list(inputs), params, deadline=deadline)
-                else:
-                    outputs = self.tpu_evaluator.check(list(inputs), params)
+                    kwargs["deadline"] = deadline
+                if wf is not None and getattr(self.tpu_evaluator, "supports_waterfall", False):
+                    kwargs["wf"] = wf
+                outputs = self.tpu_evaluator.check(list(inputs), params, **kwargs)
+                if wf is not None and "wf" not in kwargs:
+                    # evaluator without stage bookkeeping: the whole device
+                    # call books as one evaluate stage
+                    wf.mark("evaluate")
             else:
                 from ..ruletable import check_input
 
                 span.set_attribute("path", "serial")
                 outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
+                if wf is not None:
+                    wf.mark("evaluate")
         if self.on_decision is not None:
             self.on_decision(list(inputs), outputs)
         return outputs
@@ -79,6 +88,7 @@ class Engine:
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
+        wf: Optional[Any] = None,
     ) -> list[T.CheckOutput]:
         """Event-loop-native check: awaits the evaluator's reply future with
         no executor hop. Small batches below the device threshold still take
@@ -94,14 +104,21 @@ class Engine:
                 and hasattr(self.tpu_evaluator, "check_await")
             ):
                 span.set_attribute("path", "device")
+                kwargs = {}
+                if wf is not None and getattr(self.tpu_evaluator, "supports_waterfall", False):
+                    kwargs["wf"] = wf
                 outputs = await self.tpu_evaluator.check_await(
-                    list(inputs), params, deadline=deadline
+                    list(inputs), params, deadline=deadline, **kwargs
                 )
+                if wf is not None and "wf" not in kwargs:
+                    wf.mark("evaluate")
             else:
                 from ..ruletable import check_input
 
                 span.set_attribute("path", "serial")
                 outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
+                if wf is not None:
+                    wf.mark("evaluate")
         if self.on_decision is not None:
             self.on_decision(list(inputs), outputs)
         return outputs

@@ -108,9 +108,19 @@ class FlightRecorder:
 
 _recorder = FlightRecorder()
 
+# optional provider of the latency-budget slow-request ring, bound by
+# bootstrap to BudgetTracker.slow_dump so the SIGQUIT forensics dump
+# carries the worst recent waterfalls next to the batch records
+_slow_provider: Optional[Any] = None
+
 
 def recorder() -> FlightRecorder:
     return _recorder
+
+
+def bind_slow_requests(provider: Optional[Any]) -> None:
+    global _slow_provider
+    _slow_provider = provider
 
 
 def configure(capacity: int = DEFAULT_CAPACITY, enabled: bool = True) -> FlightRecorder:
@@ -136,7 +146,11 @@ def install_sigquit_dump() -> bool:
 
     def dump(_sig, _frm):
         try:
-            sys.stderr.write(json.dumps(_recorder.dump(), default=str) + "\n")
+            out = _recorder.dump()
+            if _slow_provider is not None:
+                with contextlib.suppress(Exception):
+                    out["slow_requests"] = _slow_provider()
+            sys.stderr.write(json.dumps(out, default=str) + "\n")
             sys.stderr.flush()
         except Exception:  # noqa: BLE001  (diagnostics must never kill serving)
             pass

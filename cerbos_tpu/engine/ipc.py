@@ -52,6 +52,8 @@ from ..observability import current_span_context, parse_traceparent
 from ..ruletable import check_input
 from . import types as T
 from .batcher import DeadlineExceeded, _BatchFailed
+from .budget import STAGE_IPC_ENCODE, STAGE_ORACLE, Waterfall
+from .budget import tracker as budget_tracker
 
 _log = logging.getLogger("cerbos_tpu.engine.ipc")
 
@@ -69,6 +71,10 @@ T_FLIGHT = 7
 T_FLIGHT_R = 8
 T_METRICS = 9
 T_METRICS_R = 10
+T_SLOW = 11
+T_SLOW_R = 12
+T_PRESSURE = 13
+T_PRESSURE_R = 14
 
 _MAX_FRAME = 64 * 1024 * 1024  # a corrupt length must not allocate the moon
 
@@ -360,6 +366,12 @@ class BatcherIpcServer:
 
                     text = metrics().render()
                     writer.send(T_METRICS_R, req_id, lambda t=text: t.encode())
+                elif mtype == T_SLOW:
+                    dump = self._slow_snapshot(payload)
+                    writer.send(T_SLOW_R, req_id, lambda d=dump: marshal.dumps(d))
+                elif mtype == T_PRESSURE:
+                    snap = self._pressure_snapshot()
+                    writer.send(T_PRESSURE_R, req_id, lambda s=snap: marshal.dumps(s))
         except (IpcError, OSError, EOFError, ValueError, TypeError):
             pass
         finally:
@@ -389,7 +401,11 @@ class BatcherIpcServer:
             self.stats["wedged_drops"] += 1
             return
         try:
-            deadline_rel, traceparent, rows = marshal.loads(payload)
+            decoded = marshal.loads(payload)
+            deadline_rel, traceparent, rows = decoded[0], decoded[1], decoded[2]
+            # 4th element: latency-budget carry spec (age, attributed) — absent
+            # from pre-waterfall front ends, None when the budget is disabled
+            carry = decoded[3] if len(decoded) > 3 else None
             inputs = decode_inputs(rows)
         except Exception:  # noqa: BLE001
             writer.send(T_ERR, req_id, lambda: marshal.dumps("codec"))
@@ -408,7 +424,12 @@ class BatcherIpcServer:
         self.m_depth.set(self._outstanding)
         deadline = time.monotonic() + deadline_rel if deadline_rel is not None else None
         ctx = parse_traceparent(traceparent) if traceparent else None
-        fut = self.batcher.check_async(inputs, deadline=deadline, ctx=ctx)
+        # rebuild the waterfall from the carried relative spec; the
+        # unattributed remainder (encode + socket + decode) books as transit
+        wf = budget_tracker().resume(
+            carry, trace_id=getattr(ctx, "trace_id", "") or "", deadline=deadline
+        )
+        fut = self.batcher.check_async(inputs, deadline=deadline, ctx=ctx, wf=wf)
         self.m_enqueue.observe(worker, time.perf_counter() - t0)
 
         def settle(f: Future) -> None:
@@ -426,9 +447,17 @@ class BatcherIpcServer:
                     T_ERR, req_id, lambda r=f"batch_error:{type(e).__name__}": marshal.dumps(r)
                 )
             else:
-                # encode runs on the writer thread, not here (the callback
-                # fires on the batcher drain loop, which must stay hot)
-                writer.send(T_RESULT, req_id, lambda o=outs: marshal.dumps(encode_outputs(o)))
+                # reply spec is snapshotted here (the drain thread is done
+                # with the record); writer-queue time lands in the front
+                # end's ipc_return residual. Encode runs on the writer
+                # thread, not here (the callback fires on the batcher drain
+                # loop, which must stay hot).
+                spec = wf.reply_spec() if wf is not None else None
+                writer.send(
+                    T_RESULT,
+                    req_id,
+                    lambda o=outs, s=spec: marshal.dumps((encode_outputs(o), s)),
+                )
 
         fut.add_done_callback(settle)
 
@@ -462,6 +491,30 @@ class BatcherIpcServer:
             pass
         return out
 
+    def _slow_snapshot(self, payload: bytes) -> dict:
+        """Slow-request ring dump for `/_cerbos/debug/slow` on a front end
+        (the ring lives here, where requests actually settle)."""
+        shard = None
+        try:
+            args = marshal.loads(payload) if payload else {}
+            if isinstance(args, dict) and args.get("shard") is not None:
+                shard = int(args["shard"])
+        except Exception:  # noqa: BLE001
+            pass
+        out = budget_tracker().slow_dump(shard=shard)
+        out["pid"] = os.getpid()
+        return out
+
+    def _pressure_snapshot(self) -> dict:
+        from .pressure import monitor
+
+        try:
+            out = monitor().sample()
+        except Exception:  # noqa: BLE001
+            out = {"score": 0.0, "components": {}}
+        out["pid"] = os.getpid()
+        return out
+
 
 # -- front-end client --------------------------------------------------------
 
@@ -481,6 +534,7 @@ class RemoteBatcherClient:
     """
 
     supports_deadline = True
+    supports_waterfall = True
 
     def __init__(
         self,
@@ -680,17 +734,31 @@ class RemoteBatcherClient:
     # -- oracle fallback ----------------------------------------------------
 
     def _serve_oracle(
-        self, inputs: Sequence[T.CheckInput], params: Optional[T.EvalParams], reason: str
+        self,
+        inputs: Sequence[T.CheckInput],
+        params: Optional[T.EvalParams],
+        reason: str,
+        wf: Optional[Waterfall] = None,
     ) -> list[T.CheckOutput]:
         self.stats["oracle_fallbacks"] += 1
         self.m_fallbacks.inc(reason)
+        if wf is not None:
+            wf.note_fallback(reason)
         p = params or self.params
-        return [check_input(self.rule_table, i, p, self.schema_mgr) for i in inputs]
+        out = [check_input(self.rule_table, i, p, self.schema_mgr) for i in inputs]
+        if wf is not None:
+            # books everything since the last mark — including any dead
+            # round trip that preceded the fallback — as the oracle stage
+            wf.mark(STAGE_ORACLE)
+        return out
 
     # -- check surface ------------------------------------------------------
 
     def _encode_check(
-        self, inputs: Sequence[T.CheckInput], deadline: Optional[float]
+        self,
+        inputs: Sequence[T.CheckInput],
+        deadline: Optional[float],
+        wf: Optional[Waterfall] = None,
     ) -> Optional[bytes]:
         deadline_rel = None
         if deadline is not None:
@@ -698,7 +766,15 @@ class RemoteBatcherClient:
         ctx = current_span_context()
         traceparent = ctx.to_traceparent() if ctx is not None else ""
         try:
-            return marshal.dumps((deadline_rel, traceparent, encode_inputs(inputs)))
+            rows = encode_inputs(inputs)
+            # book the row conversion as ipc_encode BEFORE taking the carry
+            # spec, so the batcher's transit stage (age-at-receipt minus
+            # attributed-at-carry) covers only marshal + socket + decode and
+            # never double-counts the encode
+            if wf is not None:
+                wf.mark(STAGE_IPC_ENCODE)
+            carry = wf.carry() if wf is not None else None
+            return marshal.dumps((deadline_rel, traceparent, rows, carry))
         except Exception:  # noqa: BLE001  (unmarshalable attr value: oracle handles it)
             return None
 
@@ -708,36 +784,53 @@ class RemoteBatcherClient:
             wait = min(wait, max(0.0, deadline - time.monotonic()))
         return wait
 
+    @staticmethod
+    def _decode_result(payload: bytes, wf: Optional[Waterfall]) -> list[T.CheckOutput]:
+        obj = marshal.loads(payload)
+        if isinstance(obj, tuple):
+            rows, spec = obj
+        else:  # pre-waterfall batcher: bare row list
+            rows, spec = obj, None
+        outs = decode_outputs(rows)
+        if wf is not None and spec is not None:
+            try:
+                wf.splice_reply(spec)
+            except Exception:  # noqa: BLE001 — a malformed spec must not fail the request
+                pass
+        return outs
+
     def _settle_reply(
         self,
         mtype: int,
         payload: bytes,
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams],
+        wf: Optional[Waterfall] = None,
     ) -> list[T.CheckOutput]:
         if mtype == T_RESULT:
-            return decode_outputs(marshal.loads(payload))
+            return self._decode_result(payload, wf)
         if mtype == T_ERR:
             reason = marshal.loads(payload)
             if reason == "deadline":
                 raise DeadlineExceeded("request deadline expired in the shared batcher")
-            return self._serve_oracle(inputs, params, str(reason))
-        return self._serve_oracle(inputs, params, "protocol")
+            return self._serve_oracle(inputs, params, str(reason), wf=wf)
+        return self._serve_oracle(inputs, params, "protocol", wf=wf)
 
     def check(
         self,
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
+        wf: Optional[Waterfall] = None,
     ) -> list[T.CheckOutput]:
         if deadline is not None and time.monotonic() >= deadline:
             raise DeadlineExceeded("request deadline expired before evaluation")
         self.stats["checks"] += 1
         if not self._connected.is_set():
-            return self._serve_oracle(inputs, params, "batcher_down")
-        payload = self._encode_check(inputs, deadline)
+            return self._serve_oracle(inputs, params, "batcher_down", wf=wf)
+        payload = self._encode_check(inputs, deadline, wf=wf)
         if payload is None:
-            return self._serve_oracle(inputs, params, "codec")
+            return self._serve_oracle(inputs, params, "codec", wf=wf)
         t0 = time.perf_counter()
         req_id, fut = self._register()
         try:
@@ -745,35 +838,38 @@ class RemoteBatcherClient:
             mtype, data = fut.result(timeout=self._wait_budget(deadline))
         except IpcDisconnected:
             self._unregister(req_id)
-            return self._serve_oracle(inputs, params, "batcher_down")
+            return self._serve_oracle(inputs, params, "batcher_down", wf=wf)
         except (TimeoutError, FutureTimeoutError):
             self._unregister(req_id)
             if deadline is not None and time.monotonic() >= deadline:
                 raise DeadlineExceeded("request deadline expired while queued") from None
-            return self._serve_oracle(inputs, params, "ipc_timeout")
+            return self._serve_oracle(inputs, params, "ipc_timeout", wf=wf)
         self._unregister(req_id)
         self.m_rtt.observe(time.perf_counter() - t0)
-        return self._settle_reply(mtype, data, inputs, params)
+        return self._settle_reply(mtype, data, inputs, params, wf=wf)
 
     async def check_await(
         self,
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
+        wf: Optional[Waterfall] = None,
     ) -> list[T.CheckOutput]:
         """Event-loop-native check: awaits the reply future with zero
         thread-pool hops; only degraded-path oracle work leaves the loop."""
         loop = asyncio.get_running_loop()
 
         def oracle(reason: str):
-            return loop.run_in_executor(None, self._serve_oracle, list(inputs), params, reason)
+            return loop.run_in_executor(
+                None, self._serve_oracle, list(inputs), params, reason, wf
+            )
 
         if deadline is not None and time.monotonic() >= deadline:
             raise DeadlineExceeded("request deadline expired before evaluation")
         self.stats["checks"] += 1
         if not self._connected.is_set():
             return await oracle("batcher_down")
-        payload = self._encode_check(inputs, deadline)
+        payload = self._encode_check(inputs, deadline, wf=wf)
         if payload is None:
             return await oracle("codec")
         t0 = time.perf_counter()
@@ -794,7 +890,7 @@ class RemoteBatcherClient:
         self._unregister(req_id)
         self.m_rtt.observe(time.perf_counter() - t0)
         if mtype == T_RESULT:
-            return decode_outputs(marshal.loads(data))
+            return self._decode_result(data, wf)
         if mtype == T_ERR:
             reason = marshal.loads(data)
             if reason == "deadline":
@@ -832,6 +928,23 @@ class RemoteBatcherClient:
         if mtype != T_FLIGHT_R:
             raise IpcError("unexpected reply to flight request")
         return marshal.loads(payload)
+
+    def fetch_slow(self, shard: Optional[int] = None, timeout: float = 5.0) -> dict:
+        """Slow-request ring dump from the batcher process — requests settle
+        there, so that is where the ring fills."""
+        payload = marshal.dumps({"shard": shard} if shard is not None else {})
+        mtype, data = self._request(T_SLOW, payload, timeout=timeout)
+        if mtype != T_SLOW_R:
+            raise IpcError("unexpected reply to slow-ring request")
+        return marshal.loads(data)
+
+    def fetch_pressure(self, timeout: float = 5.0) -> dict:
+        """Pressure snapshot from the batcher process (queue, inflight, and
+        breaker signals live there; the front end has only its own view)."""
+        mtype, data = self._request(T_PRESSURE, b"", timeout=timeout)
+        if mtype != T_PRESSURE_R:
+            raise IpcError("unexpected reply to pressure request")
+        return marshal.loads(data)
 
     def fetch_metrics_text(self, timeout: float = 5.0) -> str:
         mtype, payload = self._request(T_METRICS, b"", timeout=timeout)

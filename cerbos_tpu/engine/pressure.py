@@ -1,0 +1,288 @@
+"""Saturation pressure signals: the input surface for admission control.
+
+Today the only pressure valves are deadline expiry (a 504 after the queue
+time is already spent) and the IPC ring filling — both fire *after* the
+damage. ROADMAP item 5 (admission control, priority lanes, brownout) needs
+a signal that rises *before* deadlines start dying. This module aggregates
+the rolling saturation signals the serving path already produces into
+normalized 0..1 components and one headline ``cerbos_tpu_pressure_score``:
+
+- ``queue``    — rolling p90 of batcher queue+inflight load against the
+                 admission capacity (the earliest overload symptom: work
+                 piling up faster than the device drains it);
+- ``inflight`` — device batches in flight against ``inflightDepth`` (the
+                 device is the bottleneck when this pins at 1.0);
+- ``ipc``      — shared-batcher ticket ring occupancy against
+                 ``maxOutstanding`` (front-door topology);
+- ``fallback`` — fraction of decisions served by the CPU oracle over the
+                 window (capacity silently degrading);
+- ``degraded`` — breaker open (1.0) / half-open (0.5) or a parity storm
+                 (the lane is refusing device traffic outright);
+- ``compile``  — a recompile storm fired inside the window (the device is
+                 spending its time in XLA instead of serving).
+
+``score = max(components)``: saturation is not additive — any one
+saturated dimension saturates the service, and a max never dilutes a
+critical signal with healthy ones. Signal sources are bound as zero-arg
+callables (the readiness ``bind_*`` pattern) so the monitor carries no
+topology knowledge; bootstrap wires whatever the role actually has, and
+every read is defensive — a dead source reads as 0, never as an error on
+the sampling path.
+
+Sampling is both pulled (each ``/_cerbos/metrics`` render and
+``/_cerbos/debug/pressure`` hit samples first, so scrapes are always
+fresh) and pushed (a daemon ticker at ``pressure.intervalMs`` keeps the
+rolling windows warm between scrapes). One process-global instance, the
+flight-recorder pattern.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..observability import metrics
+from . import flight
+
+# score at/above which a rising edge records a flight event — the "it was
+# already red before the expiries" breadcrumb for incident forensics
+HIGH_WATER = 0.8
+
+
+def _read(fn: Optional[Callable], default=0.0):
+    if fn is None:
+        return default
+    try:
+        v = fn()
+        return default if v is None else v
+    except Exception:  # noqa: BLE001 — sampling must never throw
+        return default
+
+
+class PressureMonitor:
+    """Rolling aggregation of saturation signals into pressure gauges."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        reg = metrics()
+        self.m_score = reg.gauge(
+            "cerbos_tpu_pressure_score",
+            "Aggregate saturation pressure 0..1 (max over components; >=0.8 is the act-now line)",
+        )
+        self.m_queue = reg.gauge(
+            "cerbos_tpu_pressure_queue",
+            "Queue pressure: rolling p90 of batcher queue+inflight load vs capacity",
+        )
+        self.m_inflight = reg.gauge(
+            "cerbos_tpu_pressure_inflight",
+            "Device in-flight pressure: batches in flight vs inflightDepth",
+        )
+        self.m_ipc = reg.gauge(
+            "cerbos_tpu_pressure_ipc",
+            "IPC ring pressure: shared-batcher tickets outstanding vs maxOutstanding",
+        )
+        self.m_fallback = reg.gauge(
+            "cerbos_tpu_pressure_fallback",
+            "Oracle-fallback pressure: fraction of windowed decisions served by the CPU oracle",
+        )
+        self.m_degraded = reg.gauge(
+            "cerbos_tpu_pressure_degraded",
+            "Degradation pressure: 1 breaker open or parity storm, 0.5 half-open",
+        )
+        self.m_compile = reg.gauge(
+            "cerbos_tpu_pressure_compile",
+            "Compile pressure: 1 while a recompile storm fired inside the window",
+        )
+        self.enabled = True
+        self.window_s = 30.0
+        self.interval_s = 0.5
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._queue_samples: deque = deque()   # (t, load fraction)
+        self._counter_samples: deque = deque()  # (t, fallbacks, decisions, storms)
+        self._high = False
+        self._ticker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # signal sources; all optional, bound by bootstrap per role
+        self._queue_fn: Optional[Callable] = None      # -> (depth, capacity)
+        self._inflight_fn: Optional[Callable] = None   # -> (inflight, depth limit)
+        self._ipc_fn: Optional[Callable] = None        # -> (outstanding, max)
+        self._fallbacks_fn: Optional[Callable] = None  # -> cumulative fallback count
+        self._decisions_fn: Optional[Callable] = None  # -> cumulative decision count
+        self._breaker_fn: Optional[Callable] = None    # -> state str (closed/open/half_open)
+        self._parity_fn: Optional[Callable] = None     # -> storming shard ids
+        self._storms_fn: Optional[Callable] = None     # -> cumulative recompile storms
+
+    def configure(
+        self,
+        enabled: Optional[bool] = None,
+        window_s: Optional[float] = None,
+        interval_s: Optional[float] = None,
+    ) -> None:
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if window_s is not None:
+            self.window_s = max(1.0, float(window_s))
+        if interval_s is not None:
+            self.interval_s = max(0.05, float(interval_s))
+
+    def bind(
+        self,
+        queue: Optional[Callable] = None,
+        inflight: Optional[Callable] = None,
+        ipc: Optional[Callable] = None,
+        fallbacks: Optional[Callable] = None,
+        decisions: Optional[Callable] = None,
+        breaker: Optional[Callable] = None,
+        parity: Optional[Callable] = None,
+        storms: Optional[Callable] = None,
+    ) -> None:
+        """Attach signal sources; None leaves the existing binding alone."""
+        if queue is not None:
+            self._queue_fn = queue
+        if inflight is not None:
+            self._inflight_fn = inflight
+        if ipc is not None:
+            self._ipc_fn = ipc
+        if fallbacks is not None:
+            self._fallbacks_fn = fallbacks
+        if decisions is not None:
+            self._decisions_fn = decisions
+        if breaker is not None:
+            self._breaker_fn = breaker
+        if parity is not None:
+            self._parity_fn = parity
+        if storms is not None:
+            self._storms_fn = storms
+
+    def unbind(self) -> None:
+        """Drop every source and rolling window (re-initialization, tests)."""
+        with self._lock:
+            self._queue_fn = self._inflight_fn = self._ipc_fn = None
+            self._fallbacks_fn = self._decisions_fn = None
+            self._breaker_fn = self._parity_fn = self._storms_fn = None
+            self._queue_samples.clear()
+            self._counter_samples.clear()
+            self._high = False
+
+    # -- sampling -----------------------------------------------------------
+
+    @staticmethod
+    def _frac(pair, default=0.0) -> float:
+        try:
+            depth, cap = pair
+            cap = float(cap)
+            if cap <= 0:
+                return default
+            return max(0.0, min(1.0, float(depth) / cap))
+        except Exception:  # noqa: BLE001
+            return default
+
+    def sample(self, now: Optional[float] = None) -> dict:
+        """Read every bound source, roll the windows, publish the gauges,
+        and return the snapshot the debug endpoint serves."""
+        now = self._clock() if now is None else now
+        queue_frac = self._frac(_read(self._queue_fn, (0, 0)))
+        inflight_frac = self._frac(_read(self._inflight_fn, (0, 0)))
+        ipc_frac = self._frac(_read(self._ipc_fn, (0, 0)))
+        fallbacks = float(_read(self._fallbacks_fn, 0.0))
+        decisions = float(_read(self._decisions_fn, 0.0))
+        storms = float(_read(self._storms_fn, 0.0))
+        breaker = str(_read(self._breaker_fn, "") or "")
+        parity_shards = _read(self._parity_fn, []) or []
+
+        with self._lock:
+            horizon = now - self.window_s
+            self._queue_samples.append((now, queue_frac))
+            while self._queue_samples and self._queue_samples[0][0] < horizon:
+                self._queue_samples.popleft()
+            self._counter_samples.append((now, fallbacks, decisions, storms))
+            while len(self._counter_samples) > 1 and self._counter_samples[0][0] < horizon:
+                self._counter_samples.popleft()
+            fracs = sorted(f for _, f in self._queue_samples)
+            queue_p90 = fracs[min(len(fracs) - 1, int(0.9 * len(fracs)))] if fracs else 0.0
+            t0, fb0, dec0, st0 = self._counter_samples[0]
+            d_fb = max(0.0, fallbacks - fb0)
+            d_dec = max(0.0, decisions - dec0)
+
+        fallback_frac = d_fb / d_dec if d_dec > 0 else (1.0 if d_fb > 0 else 0.0)
+        fallback_frac = min(1.0, fallback_frac)
+        compile_frac = 1.0 if storms - st0 > 0 else 0.0
+        degraded = 0.0
+        if breaker == "open" or list(parity_shards):
+            degraded = 1.0
+        elif breaker == "half_open":
+            degraded = 0.5
+
+        components = {
+            "queue": round(queue_p90, 4),
+            "inflight": round(inflight_frac, 4),
+            "ipc": round(ipc_frac, 4),
+            "fallback": round(fallback_frac, 4),
+            "degraded": degraded,
+            "compile": compile_frac,
+        }
+        score = max(components.values())
+        self.m_queue.set(components["queue"])
+        self.m_inflight.set(components["inflight"])
+        self.m_ipc.set(components["ipc"])
+        self.m_fallback.set(components["fallback"])
+        self.m_degraded.set(degraded)
+        self.m_compile.set(compile_frac)
+        self.m_score.set(score)
+
+        if score >= HIGH_WATER and not self._high:
+            self._high = True
+            flight.recorder().record_event(
+                "pressure_high",
+                score=round(score, 4),
+                components=components,
+            )
+        elif score < HIGH_WATER:
+            self._high = False
+
+        return {
+            "score": round(score, 4),
+            "components": components,
+            "window_sec": self.window_s,
+            "signals": {
+                "queue_load": queue_frac,
+                "ipc_ring": ipc_frac,
+                "breaker": breaker or None,
+                "parity_shards": list(parity_shards),
+                "fallbacks_total": fallbacks,
+                "decisions_total": decisions,
+                "recompile_storms_total": storms,
+            },
+        }
+
+    # -- background ticker ---------------------------------------------------
+
+    def start_ticker(self) -> None:
+        """Keep the rolling windows warm between scrapes. Idempotent."""
+        if not self.enabled or (self._ticker is not None and self._ticker.is_alive()):
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                if self.enabled:
+                    try:
+                        self.sample()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._ticker = threading.Thread(target=loop, daemon=True, name="pressure-monitor")
+        self._ticker.start()
+
+    def stop_ticker(self) -> None:
+        self._stop.set()
+        self._ticker = None
+
+
+_monitor = PressureMonitor()
+
+
+def monitor() -> PressureMonitor:
+    return _monitor

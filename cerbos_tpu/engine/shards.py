@@ -53,6 +53,7 @@ class ShardedBatchingEvaluator:
     """
 
     supports_deadline = True
+    supports_waterfall = True
 
     def __init__(
         self,
@@ -124,8 +125,9 @@ class ShardedBatchingEvaluator:
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
+        wf: Optional[Any] = None,
     ) -> list[T.CheckOutput]:
-        return self.route(inputs).check(inputs, params, deadline=deadline)
+        return self.route(inputs).check(inputs, params, deadline=deadline, wf=wf)
 
     def check_async(
         self,
@@ -133,8 +135,11 @@ class ShardedBatchingEvaluator:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         ctx: Optional[SpanContext] = None,
+        wf: Optional[Any] = None,
     ) -> Future:
-        return self.route(inputs).check_async(inputs, params, deadline=deadline, ctx=ctx)
+        return self.route(inputs).check_async(
+            inputs, params, deadline=deadline, ctx=ctx, wf=wf
+        )
 
     def close(self) -> None:
         for lane in self.shards:

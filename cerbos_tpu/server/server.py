@@ -24,11 +24,67 @@ from aiohttp import web
 
 from ..engine import types as T
 from ..engine.batcher import DeadlineExceeded
+from ..engine.budget import (
+    OUTCOME_EXPIRED,
+    OUTCOME_MET,
+    OUTCOME_ORACLE,
+    OUTCOME_REFUSED,
+    STAGE_INGRESS_PARSE,
+    STAGE_REPLY_ENCODE,
+)
+from ..engine.budget import tracker as budget_tracker
 from ..engine.flight import recorder as flight_recorder
+from ..engine.pressure import monitor as pressure_monitor
 from ..engine.readiness import state as readiness_state
 from ..observability import parse_traceparent
 from . import convert, wire_validate
 from .service import CerbosService, RequestLimitExceeded
+
+
+class _IngressStamps:
+    """Raw-bytes ingress timestamps for the gRPC path.
+
+    The latency waterfall must start when the request BYTES arrive, not
+    after protobuf decode — otherwise decode cost is invisible and the
+    stage sum can never reconcile with socket-level wall clock. gRPC gives
+    handlers only the decoded message, so the request deserializer (which
+    runs on the raw bytes) records ``(t_raw, t_decoded)`` keyed by the
+    decoded message's identity, and the handler pops its stamp by the same
+    key. Bounded: an entry whose handler never runs (abort between decode
+    and dispatch) is evicted FIFO instead of leaking."""
+
+    def __init__(self, cap: int = 4096):
+        self._lock = threading.Lock()
+        self._stamps: dict[int, tuple[float, float]] = {}  # insertion-ordered
+        self._cap = cap
+
+    def put(self, key: int, t_raw: float, t_decoded: float) -> None:
+        with self._lock:
+            self._stamps.pop(key, None)  # re-insert at the tail on id reuse
+            self._stamps[key] = (t_raw, t_decoded)
+            while len(self._stamps) > self._cap:
+                self._stamps.pop(next(iter(self._stamps)))
+
+    def pop(self, key: int) -> Optional[tuple[float, float]]:
+        with self._lock:
+            return self._stamps.pop(key, None)
+
+
+_GRPC_STAMPS = _IngressStamps()
+
+
+def _stamping_deserializer(deserialize):
+    """Wrap a protobuf ``FromString`` so decode start/end are captured at
+    the raw-bytes boundary (works under both the sync and aio servers —
+    each runs the deserializer before dispatching to the handler)."""
+
+    def wrapped(data: bytes):
+        t_raw = time.monotonic()
+        msg = deserialize(data)
+        _GRPC_STAMPS.put(id(msg), t_raw, time.monotonic())
+        return msg
+
+    return wrapped
 
 
 @dataclass
@@ -227,9 +283,15 @@ def _grpc_rpcs(svc: CerbosService):
     from ..api.cerbos.response.v1 import response_pb2
 
     def check_resources(req: request_pb2.CheckResourcesRequest, ctx: grpc.ServicerContext):
+        # raw-bytes ingress stamp recorded by the wrapped deserializer: the
+        # waterfall starts when the request BYTES arrived, so protobuf
+        # decode cost is a visible stage instead of unattributed time
+        stamp = _GRPC_STAMPS.pop(id(req))
         verr = wire_validate.check_resources_proto(req)
         if verr:
+            budget_tracker().count(OUTCOME_REFUSED)
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, verr)
+        wf = None
         try:
             aux = None
             if req.HasField("aux_data") and req.aux_data.jwt.token:
@@ -241,6 +303,11 @@ def _grpc_rpcs(svc: CerbosService):
             remaining = ctx.time_remaining()
             if remaining is not None:
                 deadline = time.monotonic() + remaining
+            wf = budget_tracker().start(
+                deadline=deadline, t0=stamp[0] if stamp is not None else None
+            )
+            if wf is not None and stamp is not None:
+                wf.mark(STAGE_INGRESS_PARSE, now=stamp[1])
             # W3C trace-context rides gRPC metadata; the parsed context
             # parents the request span so the device batch joins the
             # caller's trace (shim contexts may lack the metadata accessor)
@@ -249,15 +316,20 @@ def _grpc_rpcs(svc: CerbosService):
                 dict(meta_fn() or ()).get("traceparent") if meta_fn is not None else None
             )
             outputs, call_id = svc.check_resources(
-                inputs, deadline=deadline, trace_ctx=trace_ctx
+                inputs, deadline=deadline, trace_ctx=trace_ctx, wf=wf
             )
             if trace_ctx is not None:
                 with contextlib.suppress(Exception):  # shim contexts may lack it
                     ctx.set_trailing_metadata((("traceparent", trace_ctx.to_traceparent()),))
-            return convert.outputs_to_check_resources_response(req, outputs, call_id)
+            resp = convert.outputs_to_check_resources_response(req, outputs, call_id)
+            outcome = OUTCOME_ORACLE if wf is not None and wf.served_by == "oracle" else OUTCOME_MET
+            budget_tracker().finish(wf, outcome, final_stage=STAGE_REPLY_ENCODE)
+            return resp
         except RequestLimitExceeded as e:
+            budget_tracker().finish(wf, OUTCOME_REFUSED)
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except DeadlineExceeded as e:
+            budget_tracker().finish(wf, OUTCOME_EXPIRED)
             ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:  # noqa: BLE001
             ctx.abort(grpc.StatusCode.INTERNAL, f"check failed: {e}")
@@ -406,7 +478,11 @@ def _grpc_rpcs(svc: CerbosService):
         ),
         "CheckResources": grpc.unary_unary_rpc_method_handler(
             check_resources,
-            request_deserializer=request_pb2.CheckResourcesRequest.FromString,
+            # stamped at the raw-bytes boundary: decode cost is waterfall
+            # stage one, not invisible pre-handler time
+            request_deserializer=_stamping_deserializer(
+                request_pb2.CheckResourcesRequest.FromString
+            ),
             response_serializer=lambda m: m.SerializeToString(),
         ),
         "PlanResources": grpc.unary_unary_rpc_method_handler(
@@ -616,6 +692,8 @@ class Server:
         app.router.add_get("/_cerbos/ready", self._h_ready)
         app.router.add_get("/_cerbos/metrics", self._h_metrics)
         app.router.add_get("/_cerbos/debug/flight", self._h_flight)
+        app.router.add_get("/_cerbos/debug/slow", self._h_slow)
+        app.router.add_get("/_cerbos/debug/pressure", self._h_pressure)
         app.router.add_get("/_cerbos/debug/profile", self._h_profile)
         app.router.add_get("/api/server_info", self._h_server_info)
         # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
@@ -708,6 +786,64 @@ class Server:
             pass
         return resp
 
+    async def _h_slow(self, request: web.Request) -> web.Response:
+        """Slow-request ring: the top-K waterfalls (trace id, per-stage ms,
+        outcome) of requests slower than ``latencyBudget.slowThresholdMs``.
+        ``?shard=N`` narrows to one lane; ``?top=K`` caps the list. In the
+        front-door topology the batcher process keeps its own (usually
+        empty — requests finish on the front ends) ring; it is merged in so
+        the surface stays one URL in every topology."""
+        shard_q = request.query.get("shard")
+        shard_filter: Optional[int] = None
+        if shard_q is not None:
+            try:
+                shard_filter = int(shard_q)
+            except ValueError:
+                return web.json_response(
+                    {"error": f"invalid shard {shard_q!r} (want an integer)"}, status=400
+                )
+        try:
+            top = int(request.query.get("top", "0"))
+        except ValueError:
+            return web.json_response({"error": "top must be an integer"}, status=400)
+        body = budget_tracker().slow_dump(shard=shard_filter, top=top)
+        ev = getattr(self.svc.engine, "tpu_evaluator", None)
+        if ev is not None and hasattr(ev, "fetch_slow"):
+            try:
+                remote = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: ev.fetch_slow(shard=shard_filter)
+                )
+                extra = remote.get("requests") or []
+                if extra:
+                    merged = body["requests"] + list(extra)
+                    merged.sort(key=lambda e: e.get("total_ms", 0.0), reverse=True)
+                    body["requests"] = merged[:top] if top > 0 else merged
+                body["batcher_pid"] = remote.get("pid")
+            except Exception:  # noqa: BLE001  (batcher down: local ring only)
+                pass
+        return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _h_pressure(self, request: web.Request) -> web.Response:
+        """Aggregate saturation pressure: a fresh sample of every bound
+        signal, the 0..1 components, and the headline score — the input
+        surface admission control (ROADMAP item 5) will consume. In the
+        front-door topology the batcher's snapshot (queue, inflight,
+        breaker — the signals that live with the device) is attached and
+        the headline is the max of both processes."""
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(None, pressure_monitor().sample)
+        ev = getattr(self.svc.engine, "tpu_evaluator", None)
+        if ev is not None and hasattr(ev, "fetch_pressure"):
+            try:
+                remote = await loop.run_in_executor(None, ev.fetch_pressure)
+                body["batcher"] = remote
+                body["score"] = max(
+                    float(body.get("score", 0.0)), float(remote.get("score", 0.0))
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+
     async def _h_profile(self, request: web.Request) -> web.Response:
         """Operator-gated jax.profiler capture; see tpu/profiler.py."""
         from ..tpu import profiler
@@ -770,6 +906,14 @@ class Server:
         from ..observability import merge_metrics_texts, relabel_metrics_text
         from ..observability import metrics as _obs_metrics
 
+        # refresh the pressure gauges so every scrape sees current saturation,
+        # not the last background tick
+        mon = pressure_monitor()
+        if mon.enabled:
+            try:
+                await asyncio.get_running_loop().run_in_executor(None, mon.sample)
+            except Exception:  # noqa: BLE001  (a dead signal source must not break scrapes)
+                pass
         body = "\n".join(lines) + "\n" + _obs_metrics().render()
         label = self.config.worker_label
         if label:
@@ -793,13 +937,20 @@ class Server:
         return web.Response(text=body, content_type="text/plain")
 
     async def _h_check_resources(self, request: web.Request) -> web.Response:
+        # ingress stamp BEFORE the body is read/parsed: the waterfall starts
+        # at the raw-bytes boundary, so JSON decode cost is stage one
+        t_raw = time.monotonic()
         try:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
         verr = wire_validate.check_resources_body(body)
         if verr:
+            budget_tracker().count(OUTCOME_REFUSED)
             return web.json_response({"code": 3, "message": verr}, status=400)
+        wf = budget_tracker().start(t0=t_raw)
+        if wf is not None:
+            wf.mark(STAGE_INGRESS_PARSE)
         try:
             aux = None
             aux_j = (body.get("auxData") or {}).get("jwt") or {}
@@ -812,14 +963,14 @@ class Server:
                 # (RemoteBatcherClient futures) — awaiting directly skips the
                 # per-request thread-pool hop entirely
                 outputs, call_id = await self.svc.check_resources_async(
-                    inputs, trace_ctx=trace_ctx
+                    inputs, trace_ctx=trace_ctx, wf=wf
                 )
             elif self.config.direct_dispatch:
-                outputs, call_id = self.svc.check_resources(inputs, trace_ctx=trace_ctx)
+                outputs, call_id = self.svc.check_resources(inputs, trace_ctx=trace_ctx, wf=wf)
             else:
                 loop = asyncio.get_running_loop()
                 outputs, call_id = await loop.run_in_executor(
-                    None, lambda: self.svc.check_resources(inputs, trace_ctx=trace_ctx)
+                    None, lambda: self.svc.check_resources(inputs, trace_ctx=trace_ctx, wf=wf)
                 )
             resp = web.json_response(
                 convert.outputs_to_json(body, outputs, request_id, include_meta, call_id)
@@ -827,10 +978,14 @@ class Server:
             if trace_ctx is not None:
                 # echo the trace the work joined so callers can correlate
                 resp.headers["traceparent"] = trace_ctx.to_traceparent()
+            outcome = OUTCOME_ORACLE if wf is not None and wf.served_by == "oracle" else OUTCOME_MET
+            budget_tracker().finish(wf, outcome, final_stage=STAGE_REPLY_ENCODE)
             return resp
         except RequestLimitExceeded as e:
+            budget_tracker().finish(wf, OUTCOME_REFUSED)
             return web.json_response({"code": 3, "message": str(e)}, status=400)
         except DeadlineExceeded as e:
+            budget_tracker().finish(wf, OUTCOME_EXPIRED)
             return web.json_response({"code": 4, "message": str(e)}, status=504)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"code": 13, "message": f"check failed: {e}"}, status=500)

@@ -91,6 +91,7 @@ class CerbosService:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         trace_ctx: Optional[SpanContext] = None,
+        wf: Optional[Any] = None,
     ) -> tuple[list[T.CheckOutput], str]:
         self._validate_check(inputs)
         call_id = uuid.uuid4().hex
@@ -104,7 +105,9 @@ class CerbosService:
             # clear any shard affinity left by a previous request on this
             # thread; the batcher re-stamps it if the device path is taken
             T.set_current_shard(None)
-            outputs = self.engine.check(inputs, params=params, deadline=deadline)
+            if wf is not None and not wf.trace_id:
+                wf.trace_id = span.context.trace_id
+            outputs = self.engine.check(inputs, params=params, deadline=deadline, wf=wf)
             trace_id = span.context.trace_id
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
@@ -132,6 +135,7 @@ class CerbosService:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         trace_ctx: Optional[SpanContext] = None,
+        wf: Optional[Any] = None,
     ) -> tuple[list[T.CheckOutput], str]:
         """``check_resources`` for evaluators that settle on the event loop
         (front-end mode): the handler coroutine awaits the batcher ticket
@@ -144,7 +148,11 @@ class CerbosService:
         ) as span:
             span.set_attribute("call_id", call_id)
             T.set_current_shard(None)
-            outputs = await self.engine.check_await(inputs, params=params, deadline=deadline)
+            if wf is not None and not wf.trace_id:
+                wf.trace_id = span.context.trace_id
+            outputs = await self.engine.check_await(
+                inputs, params=params, deadline=deadline, wf=wf
+            )
             trace_id = span.context.trace_id
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:

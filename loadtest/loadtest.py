@@ -100,7 +100,12 @@ def _make_bodies(n_mods: int, n: int = 512, unique: bool = False) -> list[bytes]
 
 
 def spawn_server(
-    policy_dir: str, workers: int, use_tpu: bool, frontends: int = 0, shards: int = 0
+    policy_dir: str,
+    workers: int,
+    use_tpu: bool,
+    frontends: int = 0,
+    shards: int = 0,
+    budget: bool = True,
 ) -> tuple[subprocess.Popen, int, int]:
     import base64
 
@@ -111,6 +116,10 @@ def spawn_server(
         # sharded serving pool (engine/shards.py): N batcher lanes, one
         # device-pinned evaluator clone each; -1 = one per visible device
         tpu_cfg["mesh"] = {"shards": "auto" if shards < 0 else int(shards)}
+    if not budget:
+        # --no-budget: the overhead-drill baseline (waterfall + pressure off)
+        tpu_cfg["latencyBudget"] = {"enabled": False}
+        tpu_cfg["pressure"] = {"enabled": False}
     cfg_path = os.path.join(policy_dir, ".cerbos.yaml")
     with open(cfg_path, "w") as f:
         yaml.safe_dump(
@@ -302,10 +311,132 @@ def _parity_block(text: str, elapsed: float) -> dict:
     }
 
 
-def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0) -> dict:
+def _bucket_p99(buckets: dict, count: float) -> float:
+    if not count:
+        return 0.0
+    target = 0.99 * count
+    finite = sorted(b for b in buckets if b != float("inf"))
+    for le in sorted(buckets):
+        if buckets[le] >= target:
+            return le if le != float("inf") else (finite[-1] if finite else 0.0)
+    return finite[-1] if finite else 0.0
+
+
+def _waterfall_block(text: str) -> dict:
+    """Fold the latency-budget waterfall series into the artifact: per-stage
+    p99/mean plus the fraction of request wall clock the named stages
+    explain (the >=95% attribution acceptance figure). Shards and workers
+    merge: the stage label is the only key."""
+    stage_sum: dict[str, float] = {}
+    stage_count: dict[str, float] = {}
+    stage_buckets: dict[str, dict] = {}
+    total_sum = total_count = 0.0
+    total_buckets: dict = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith("cerbos_tpu_request_"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        if series.startswith("cerbos_tpu_request_stage_seconds"):
+            at = series.find('stage="')
+            if at < 0:
+                continue
+            stage = series[at + 7 : series.index('"', at + 7)]
+            if series.startswith("cerbos_tpu_request_stage_seconds_sum"):
+                stage_sum[stage] = stage_sum.get(stage, 0.0) + v
+            elif series.startswith("cerbos_tpu_request_stage_seconds_count"):
+                stage_count[stage] = stage_count.get(stage, 0.0) + v
+            elif series.startswith("cerbos_tpu_request_stage_seconds_bucket"):
+                at = series.find('le="')
+                if at >= 0:
+                    le = series[at + 4 : series.index('"', at + 4)]
+                    b = float("inf") if le == "+Inf" else float(le)
+                    d = stage_buckets.setdefault(stage, {})
+                    d[b] = d.get(b, 0.0) + v
+        elif series.startswith("cerbos_tpu_request_total_seconds_sum"):
+            total_sum += v
+        elif series.startswith("cerbos_tpu_request_total_seconds_count"):
+            total_count += v
+        elif series.startswith("cerbos_tpu_request_total_seconds_bucket"):
+            at = series.find('le="')
+            if at >= 0:
+                le = series[at + 4 : series.index('"', at + 4)]
+                b = float("inf") if le == "+Inf" else float(le)
+                total_buckets[b] = total_buckets.get(b, 0.0) + v
+    stages = {}
+    for s in sorted(stage_sum):
+        n = stage_count.get(s, 0.0)
+        stages[s] = {
+            "p99_ms": round(_bucket_p99(stage_buckets.get(s, {}), n) * 1000, 3),
+            "mean_ms": round(stage_sum[s] / n * 1000, 3) if n else 0.0,
+            "count": int(n),
+        }
+    return {
+        "requests": int(total_count),
+        "total_p99_ms": round(_bucket_p99(total_buckets, total_count) * 1000, 3),
+        "attributed_frac": round(sum(stage_sum.values()) / total_sum, 4) if total_sum else 0.0,
+        "stages": stages,
+    }
+
+
+def _goodput_block(text: str, elapsed: float) -> dict:
+    """Goodput vs throughput from cerbos_tpu_decisions_total{outcome}:
+    goodput counts decisions served correctly inside their budget (device
+    path or oracle fallback); expired/refused are throughput-only."""
+    outcomes: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith("cerbos_tpu_decisions_total"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        at = series.find('outcome="')
+        if at < 0:
+            continue  # unlabelled base series from a worker that never counted
+        outcome = series[at + 9 : series.index('"', at + 9)]
+        outcomes[outcome] = outcomes.get(outcome, 0.0) + v
+    throughput = sum(outcomes.values())
+    good = outcomes.get("deadline_met", 0.0) + outcomes.get("oracle_fallback", 0.0)
+    return {
+        "outcomes": {k: int(v) for k, v in sorted(outcomes.items())},
+        "throughput_per_sec": round(throughput / elapsed, 1) if elapsed else 0.0,
+        "goodput_per_sec": round(good / elapsed, 1) if elapsed else 0.0,
+        "goodput_frac": round(good / throughput, 4) if throughput else 0.0,
+    }
+
+
+def _pressure_block(text: str) -> dict:
+    """Saturation pressure at scrape time: max over workers per component
+    (the score is already a max over components within each process)."""
+    score = 0.0
+    components: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith("cerbos_tpu_pressure_"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        comp = series.split("{", 1)[0][len("cerbos_tpu_pressure_"):]
+        if comp == "score":
+            score = max(score, v)
+        else:
+            components[comp] = max(components.get(comp, 0.0), v)
+    return {"score": score, "components": components}
+
+
+def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0, budget: bool = True) -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
-    proc, http_port, grpc_port = spawn_server(tmp, workers, use_tpu, frontends=frontends, shards=shards)
+    proc, http_port, grpc_port = spawn_server(
+        tmp, workers, use_tpu, frontends=frontends, shards=shards, budget=budget
+    )
     # --cold: a large pool of per-request-unique bodies (unique attr values
     # and principal ids) so the server's value/shape/assembly memos miss;
     # once the run exhausts the pool, repeats re-warm — the pool is sized so
@@ -399,9 +530,13 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     for w in threads:
         w.join(timeout=10)
     elapsed = time.perf_counter() - t_start
-    # scrape the parity sentinel's series BEFORE killing the server — the
-    # correctness half of the artifact lives in the server process
-    parity = _parity_block(_scrape_metrics(http_port), elapsed)
+    # scrape the server's series BEFORE killing it — parity, the latency
+    # waterfall, goodput, and pressure all live in the server process(es)
+    metrics_text = _scrape_metrics(http_port)
+    parity = _parity_block(metrics_text, elapsed)
+    waterfall = _waterfall_block(metrics_text)
+    goodput = _goodput_block(metrics_text, elapsed)
+    pressure = _pressure_block(metrics_text)
     proc.terminate()
     try:
         proc.wait(timeout=15)
@@ -444,6 +579,15 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         # shadow-oracle parity over the server's own device batches
         # (engine/sentinel.py), scraped from /_cerbos/metrics pre-shutdown
         "parity": parity,
+        # per-request latency-budget waterfall (engine/budget.py): where the
+        # server says each request's wall clock went, and what fraction of
+        # it the named stages explain (>=0.95 is the acceptance bar)
+        "budget_enabled": budget,
+        "waterfall": waterfall,
+        # goodput vs throughput: decisions served inside their budget vs all
+        "goodput": goodput,
+        # saturation pressure at scrape time (engine/pressure.py)
+        "pressure": pressure,
     }
 
 
@@ -470,6 +614,12 @@ def main() -> None:
     )
     ap.add_argument("--cold", action="store_true", help="per-request-unique bodies (memo-cold)")
     ap.add_argument(
+        "--no-budget",
+        action="store_true",
+        help="disable the latency-budget waterfall + pressure monitor in the "
+        "server under test (the overhead-drill baseline)",
+    )
+    ap.add_argument(
         "--json",
         metavar="PATH",
         default="",
@@ -479,6 +629,7 @@ def main() -> None:
     result = run(
         args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers,
         cold=args.cold, frontends=args.frontends, shards=args.shards,
+        budget=not args.no_budget,
     )
     print(json.dumps(result))
     if args.json:

@@ -133,6 +133,10 @@ class TestChartStatic:
             assert tpu["warmup"][knob] == want["warmup"][knob], knob
         for knob in ("enabled", "maxArtifacts", "maxSeconds"):
             assert tpu["profiler"][knob] == want["profiler"][knob], knob
+        for knob in ("enabled", "slowRingCapacity", "slowThresholdMs"):
+            assert tpu["latencyBudget"][knob] == want["latencyBudget"][knob], knob
+        for knob in ("enabled", "intervalMs", "windowSec"):
+            assert tpu["pressure"][knob] == want["pressure"][knob], knob
 
     def test_readiness_probe_split_from_liveness(self):
         # a cold replica must not take traffic until warmup has compiled the
@@ -180,6 +184,11 @@ class TestChartStatic:
             "cerbos_tpu_xla_layout_cardinality",
             "cerbos_tpu_device_memory_bytes_in_use",
             "cerbos_tpu_readiness_state",
+            # latency budget & pressure row (PR 9)
+            "cerbos_tpu_request_stage_seconds_bucket",
+            "cerbos_tpu_deadline_budget_remaining_seconds_bucket",
+            "cerbos_tpu_decisions_total",
+            "cerbos_tpu_pressure_score",
         ):
             assert needle in joined, needle
 

@@ -419,3 +419,41 @@ class TestMetricsRelabel:
         assert merged.count("# HELP m help") == 1
         assert 'm{worker="fe1"} 1' in merged
         assert 'm{worker="batcher"} 2' in merged
+
+    def test_relabel_and_merge_cover_budget_and_pressure_families(self):
+        """The PR 9 families flow through the purely textual relabel/merge
+        machinery like any other series: labeled histograms keep their
+        stage/shard labels, gauges pick up the worker label, and merging a
+        front end's text with the batcher's keeps both processes' series."""
+        fe = (
+            "# TYPE cerbos_tpu_request_stage_seconds histogram\n"
+            'cerbos_tpu_request_stage_seconds_bucket{stage="ipc_encode",shard="0",le="0.001"} 3\n'
+            'cerbos_tpu_request_stage_seconds_sum{stage="ipc_encode",shard="0"} 0.002\n'
+            "# TYPE cerbos_tpu_decisions_total counter\n"
+            'cerbos_tpu_decisions_total{outcome="deadline_met"} 7\n'
+            "# TYPE cerbos_tpu_pressure_score gauge\n"
+            "cerbos_tpu_pressure_score 0.25\n"
+        )
+        batcher = (
+            "# TYPE cerbos_tpu_request_stage_seconds histogram\n"
+            'cerbos_tpu_request_stage_seconds_bucket{stage="queue_wait",shard="1",le="0.001"} 5\n'
+            "# TYPE cerbos_tpu_pressure_score gauge\n"
+            "cerbos_tpu_pressure_score 0.75\n"
+        )
+        fe_rel = relabel_metrics_text(fe, "worker", "fe0")
+        b_rel = relabel_metrics_text(batcher, "worker", "batcher")
+        assert (
+            'cerbos_tpu_request_stage_seconds_bucket{worker="fe0",stage="ipc_encode",shard="0",le="0.001"} 3'
+            in fe_rel
+        )
+        assert 'cerbos_tpu_decisions_total{worker="fe0",outcome="deadline_met"} 7' in fe_rel
+        assert 'cerbos_tpu_pressure_score{worker="batcher"} 0.75' in b_rel
+        merged = merge_metrics_texts(fe_rel, b_rel)
+        assert merged.count("# TYPE cerbos_tpu_request_stage_seconds histogram") == 1
+        assert merged.count("# TYPE cerbos_tpu_pressure_score gauge") == 1
+        assert 'cerbos_tpu_pressure_score{worker="fe0"} 0.25' in merged
+        assert 'cerbos_tpu_pressure_score{worker="batcher"} 0.75' in merged
+        assert (
+            'cerbos_tpu_request_stage_seconds_bucket{worker="batcher",stage="queue_wait",shard="1",le="0.001"} 5'
+            in merged
+        )

@@ -200,6 +200,20 @@ class TestMetricsLint:
                 # async audit-path family (audit/log.py)
                 "cerbos_tpu_audit_queue_depth",
                 "cerbos_tpu_audit_dropped_total",
+                # latency-budget waterfall + goodput family (engine/budget.py)
+                "cerbos_tpu_request_stage_seconds",
+                "cerbos_tpu_request_total_seconds",
+                "cerbos_tpu_deadline_budget_remaining_seconds",
+                "cerbos_tpu_decisions_total",
+                "cerbos_tpu_slow_requests_total",
+                # saturation pressure family (engine/pressure.py)
+                "cerbos_tpu_pressure_score",
+                "cerbos_tpu_pressure_queue",
+                "cerbos_tpu_pressure_inflight",
+                "cerbos_tpu_pressure_ipc",
+                "cerbos_tpu_pressure_fallback",
+                "cerbos_tpu_pressure_degraded",
+                "cerbos_tpu_pressure_compile",
             ):
                 assert name in inst, name
             known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.GaugeVec, obs.Histogram, obs.HistogramVec)
@@ -226,9 +240,17 @@ class TestMetricsLint:
                 label = m.label if isinstance(m.label, str) else None
                 assert label == "shard", (name, m.label)
             # multi-dimension vecs keep shard as the LAST label dimension
-            for name in ("cerbos_tpu_batch_stage_seconds", "cerbos_tpu_breaker_transitions_total"):
+            for name in (
+                "cerbos_tpu_batch_stage_seconds",
+                "cerbos_tpu_breaker_transitions_total",
+                "cerbos_tpu_request_stage_seconds",
+                "cerbos_tpu_deadline_budget_remaining_seconds",
+            ):
                 m = inst.get(name)
                 assert isinstance(m.label, tuple) and m.label[-1] == "shard", (name, m.label)
+            # goodput accounting splits on outcome only (process-global)
+            m = inst.get("cerbos_tpu_decisions_total")
+            assert isinstance(m, obs.CounterVec) and m.label == "outcome", m.label
             # rendered exposition carries the label on every child series
             text = obs.metrics().render()
             for line in text.splitlines():
