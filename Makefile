@@ -1,0 +1,30 @@
+# Developer / CI entry points. The native module is optional at runtime
+# (every caller degrades to the pure-Python path) but CI must prove BOTH
+# legs: `test-transport` runs the ticket-queue suites with the module
+# built and again with CERBOS_TPU_NO_NATIVE=1 so the uds fallback and the
+# stdlib codecs stay honest.
+PYTHON ?= python3
+PYTEST_FLAGS ?= -q -p no:cacheprovider
+
+TRANSPORT_TESTS := tests/test_shm_transport.py tests/test_ipc.py tests/test_latency_budget.py
+
+.PHONY: all native clean test test-transport
+
+all: native
+
+native:
+	$(MAKE) -C native PYTHON=$(PYTHON)
+
+clean:
+	$(MAKE) -C native clean
+
+# tier-1: the full fast suite (slow-marked tests excluded)
+test: native
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
+
+# both transport legs: shm granted (native present) and uds fallback
+# (native disabled) — the second leg must PASS, not skip-collapse, because
+# the suites parametrize/guard on native availability themselves.
+test-transport: native
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(TRANSPORT_TESTS) $(PYTEST_FLAGS)
+	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(TRANSPORT_TESTS) $(PYTEST_FLAGS)

@@ -313,7 +313,13 @@ def _compile_economy() -> dict:
     }
 
 
-def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str = "least_loaded") -> int:
+def served_main(
+    smoke: bool,
+    json_path: str = "",
+    shards: int = 0,
+    routing: str = "least_loaded",
+    transport: str = "local",
+) -> int:
     """--served: throughput through the real serving path (BatchingEvaluator).
 
     The direct-evaluator numbers above measure the device backend in
@@ -328,6 +334,12 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
     evaluator clone each, see engine/shards.py) instead of the single
     batcher, and adds a ``topology`` block to the artifact: per-shard
     decisions/s, occupancy, and routing-imbalance.
+
+    ``--transport shm|uds`` interposes the REAL front-door ticket queue
+    (engine/ipc.py: BatcherIpcServer + RemoteBatcherClient over a temp
+    socket) between the clients and the batcher, so the artifact's
+    ``ipc_transport`` block measures the data plane itself — the uds-vs-shm
+    A/B at identical topology (loadtest/ab_transport.py drives both legs).
     """
     import os
     from concurrent.futures import ThreadPoolExecutor
@@ -386,6 +398,36 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
         }
     ).attach(batcher)
 
+    ipc_server = ipc_client = None
+    serve_target = batcher
+    if transport in ("shm", "uds"):
+        import tempfile
+
+        from cerbos_tpu.engine.ipc import BatcherIpcServer, RemoteBatcherClient
+
+        ipc_server = BatcherIpcServer(
+            os.path.join(tempfile.mkdtemp(prefix="cerbos-bench-ipc-"), "batcher.sock"),
+            batcher,
+            transport=transport,
+        )
+        ipc_server.start()
+        ipc_client = RemoteBatcherClient(
+            ipc_server.socket_path,
+            rt,
+            params=params,
+            worker_label="bench-fe",
+            status_poll_s=0.25,
+            transport=transport,
+        )
+        if not ipc_client._connected.wait(10.0):
+            print("ticket queue never attached", file=sys.stderr)
+            return 1
+        serve_target = ipc_client
+        print(
+            f"front door: ticket queue over {ipc_client.transport} (requested {transport})",
+            flush=True,
+        )
+
     req_size = 4  # inputs per client request (the classic template's shape)
     n_clients = 16 if smoke else 64
     n_rounds = 2 if smoke else 6
@@ -403,7 +445,7 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
         trk = _budget.tracker()
         wf = trk.start()
         try:
-            out = batcher.check(r, params, wf=wf)
+            out = serve_target.check(r, params, wf=wf)
         except Exception:
             trk.finish(wf, _budget.OUTCOME_EXPIRED)
             raise
@@ -429,6 +471,12 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
         sentinel.drain(timeout=30.0)  # let queued shadow replays finish
         parity = sentinel.snapshot()
         sentinel.close()
+        ipc_stats = {"transport": "local"}
+        if ipc_client is not None:
+            ipc_stats = ipc_client.transport_stats()  # before close() drops the plane
+            ipc_client.close()
+        if ipc_server is not None:
+            ipc_server.close()
         batcher.close()
     parity["overhead_pct"] = round(100.0 * parity["replay_seconds"] / wall, 3) if wall else 0.0
 
@@ -472,6 +520,10 @@ def served_main(smoke: bool, json_path: str = "", shards: int = 0, routing: str 
         # online shadow-oracle parity over this run's own batches
         # (engine/sentinel.py): divergences must be 0 with faults off
         "parity": parity,
+        # ticket-queue data plane (engine/ipc.py): negotiated transport,
+        # frames each way, native codec ns/frame, ring-full sheds;
+        # transport=local when the clients call the batcher in-process
+        "ipc_transport": ipc_stats,
     }
     if sharded_pool is not None:
         # per-shard share of the measured rate: routed requests carry equal
@@ -543,11 +595,25 @@ def main() -> None:
         "--routing", default="least_loaded", choices=["least_loaded", "round_robin"],
         help="with --served --shards: request routing policy across lanes",
     )
+    parser.add_argument(
+        "--transport", default="local", choices=["local", "shm", "uds"],
+        help="with --served: interpose the front-door ticket queue between "
+        "clients and batcher over this data plane (local = in-process calls, "
+        "no queue); shm vs uds at identical topology is the transport A/B",
+    )
     args = parser.parse_args()
     if args.index_only:
         sys.exit(index_only_main(smoke=args.smoke))
     if args.served:
-        sys.exit(served_main(smoke=args.smoke, json_path=args.json, shards=args.shards, routing=args.routing))
+        sys.exit(
+            served_main(
+                smoke=args.smoke,
+                json_path=args.json,
+                shards=args.shards,
+                routing=args.routing,
+                transport=args.transport,
+            )
+        )
 
     evidence = {"available": False, "platform": None, "rungs": [], "env_overrides": {}}
     probe = tpu_probe.probe_ladder(attempts=1)

@@ -201,6 +201,8 @@ def initialize(
             / 1000.0,
             worker_label=worker_label or "fe",
             status_poll_s=float(shared_conf.get("statusPollMs", 500)) / 1000.0,
+            transport=str(shared_conf.get("transport", "shm") or "shm"),
+            ring_kib=int(shared_conf.get("ringKiB", 1024)),
         )
         dispatch_evaluator = client
         # Core.batcher doubles as "the thing check() awaits on" for the
@@ -526,6 +528,7 @@ def build_batcher_ipc(core: Core, socket_path: str):
         readiness=_readiness.state().snapshot,
         max_outstanding=int(shared_conf.get("maxOutstanding", 4096)),
         faults=faults,
+        transport=str(shared_conf.get("transport", "shm") or "shm"),
     )
     # this process fronts the ticket ring: its occupancy is the ipc
     # pressure component (front ends see their own pending count instead)

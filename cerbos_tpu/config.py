@@ -66,6 +66,18 @@ DEFAULTS: dict[str, Any] = {
                 "perShardInflight": 0,
                 "routing": "least_loaded",
             },
+            # front-door ticket queue (server.frontends > 0): transport
+            # "shm" runs native shared-memory frame rings per front end
+            # (auto-falling back to uds when the native module is missing
+            # on either side); "uds" forces marshal frames over the socket
+            "sharedBatcher": {
+                "socketPath": "",
+                "transport": "shm",
+                "ringKiB": 1024,
+                "requestTimeoutMs": 30000,
+                "maxOutstanding": 4096,
+                "statusPollMs": 500,
+            },
             # bounded ring of recent device-batch records + fault events,
             # served at /_cerbos/debug/flight and dumped on SIGQUIT
             "flightRecorder": {"enabled": True, "capacity": 256},

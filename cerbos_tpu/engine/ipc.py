@@ -10,15 +10,28 @@ device — and this module is the seam between them: a per-worker ticket
 queue over a unix domain socket carrying compact check tickets in and packed
 effect/meta rows out.
 
-Transport: SOCK_STREAM unix socket, one connection per front-end process,
-length-prefixed frames (the portable equivalent of an shm ring — the kernel
-socket buffer IS the ring, with blocking-read wakeups for free). Payloads are
-``marshal``-encoded plain containers: C-speed (de)serialization with no
-schema-compile step and no security caveat — both ends are same-host
-processes forked by one supervisor. All padding/stacking of decoded tickets
-stays on the batcher side via the evaluator's pooled ``_pad_stack`` staging
-buffers, so the marshalling cost the device cares about never leaves the
-device-owning process.
+Transport: two interchangeable data planes under one control plane.
+
+- The control plane is always a SOCK_STREAM unix socket, one connection per
+  front-end process: HELLO negotiation, status/flight/metrics/slow/pressure
+  snapshots, and — critically — liveness. A dying peer closes the socket,
+  and that close is what fails in-flight tickets instantly and flips the
+  front end onto its oracle, whichever data plane carried the tickets.
+- ``transport: uds`` (fallback) carries check tickets on that same socket as
+  length-prefixed ``marshal`` frames — the kernel socket buffer IS the ring,
+  with blocking-read wakeups for free, and it works on pure-Python hosts.
+- ``transport: shm`` (default where the native module builds) moves the hot
+  frames — CHECK in, RESULT/ERR out — onto a pair of shared-memory byte
+  rings (one per direction) with futex wakeups, packed and unpacked by the
+  native frame codec (``ticket_pack``/``reply_pack``): no marshal, no
+  socket syscall, no intermediate row tuples on the per-request path. The
+  front end creates the segment, offers it in HELLO, and the batcher maps
+  it or refuses (HELLO_R), so a native-less peer on either end degrades the
+  pair to uds automatically.
+
+All padding/stacking of decoded tickets stays on the batcher side via the
+evaluator's pooled ``_pad_stack`` staging buffers, so the marshalling cost
+the device cares about never leaves the device-owning process.
 
 Fault semantics mirror docs/ROBUSTNESS.md, distributed:
 
@@ -38,9 +51,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import marshal
+import mmap
 import os
 import socket
 import struct
+import tempfile
 import threading
 import time
 from collections import deque
@@ -48,6 +63,7 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable, Optional, Sequence
 
+from .. import native
 from ..observability import current_span_context, parse_traceparent
 from ..ruletable import check_input
 from . import types as T
@@ -75,6 +91,7 @@ T_SLOW = 11
 T_SLOW_R = 12
 T_PRESSURE = 13
 T_PRESSURE_R = 14
+T_HELLO_R = 15
 
 _MAX_FRAME = 64 * 1024 * 1024  # a corrupt length must not allocate the moon
 
@@ -106,6 +123,109 @@ def _recv_frame(sock: socket.socket) -> tuple[int, int, bytes]:
     if length > _MAX_FRAME:
         raise IpcError(f"oversized frame ({length} bytes)")
     return mtype, req_id, _recv_exact(sock, length) if length else b""
+
+
+# -- shared-memory segment ---------------------------------------------------
+#
+# One file-backed mmap per front-end connection: a 4 KiB descriptor page
+# (magic / version / ring size) followed by two native byte rings — tickets
+# toward the batcher (c2s) and replies back (s2c). The FRONT END creates and
+# sizes the segment, offers its path in HELLO, and unlinks the name as soon
+# as the handshake settles either way: from then on the mapping lives exactly
+# as long as the two processes that hold it, and a SIGKILL on either side
+# cannot leak a name into /dev/shm.
+
+_SHM_MAGIC = 0x43544652
+_SHM_VER = 1
+_SHM_HDR = struct.Struct("<IIQ")
+_RING_HDR_BYTES = 256
+_shm_counter = 0
+
+
+def _align_page(n: int) -> int:
+    return (n + 4095) & ~4095
+
+
+def _shm_dir() -> str:
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+class _ShmSegment:
+    """The mapped segment plus the two ring memoryviews the native kernels
+    operate on. ``create`` is the front-end side, ``attach`` the batcher
+    side; both hold identical mappings once the HELLO handshake grants shm."""
+
+    def __init__(self, path: str, mm: mmap.mmap, ring_bytes: int):
+        self.path = path
+        self.mm = mm
+        self.ring_bytes = ring_bytes
+        span = _align_page(_RING_HDR_BYTES + ring_bytes)
+        view = memoryview(mm)
+        self._view = view
+        self.c2s = view[4096 : 4096 + _RING_HDR_BYTES + ring_bytes]
+        self.s2c = view[4096 + span : 4096 + span + _RING_HDR_BYTES + ring_bytes]
+
+    @classmethod
+    def create(cls, name_hint: str, ring_bytes: int) -> "_ShmSegment":
+        global _shm_counter
+        _shm_counter += 1
+        nat = native.get()
+        if nat is None:
+            raise IpcError("native module unavailable")
+        path = os.path.join(
+            _shm_dir(), f"cerbos-tpu-ring-{os.getpid()}-{_shm_counter}-{name_hint}"
+        )
+        span = _align_page(_RING_HDR_BYTES + ring_bytes)
+        total = 4096 + 2 * span
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, total)
+            mm = mmap.mmap(fd, total)
+        except BaseException:
+            os.close(fd)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        _SHM_HDR.pack_into(mm, 0, _SHM_MAGIC, _SHM_VER, ring_bytes)
+        seg = cls(path, mm, ring_bytes)
+        nat.ring_init(seg.c2s)
+        nat.ring_init(seg.s2c)
+        return seg
+
+    @classmethod
+    def attach(cls, path: str) -> "_ShmSegment":
+        if native.get() is None:
+            raise IpcError("native module unavailable")
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        magic, ver, ring_bytes = _SHM_HDR.unpack_from(mm, 0)
+        span = _align_page(_RING_HDR_BYTES + ring_bytes)
+        if magic != _SHM_MAGIC or ver != _SHM_VER or size != 4096 + 2 * span:
+            mm.close()
+            raise IpcError(f"not a cerbos-tpu ring segment: {path}")
+        return cls(path, mm, ring_bytes)
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.c2s.release()
+            self.s2c.release()
+            self._view.release()
+            self.mm.close()
+        except (BufferError, ValueError, OSError):
+            pass
 
 
 # -- ticket codec ------------------------------------------------------------
@@ -235,6 +355,76 @@ class _ConnWriter:
                 return
 
 
+class _ShmWriter:
+    """The shm counterpart of ``_ConnWriter``: reply encoding (native
+    ``reply_pack``) and ring pushes happen on this thread, never on the
+    batcher's drain loop, and the single thread keeps the s2c ring SPSC no
+    matter how many device lanes settle futures concurrently. A full ring
+    gets a bounded space-futex wait; a consumer that stays gone past the
+    budget costs a dropped reply (the front end times out onto its oracle
+    exactly as for a wedged uds socket)."""
+
+    def __init__(self, seg: _ShmSegment, name: str, on_frame=None, on_drop=None):
+        self._seg = seg
+        self._on_frame = on_frame
+        self._on_drop = on_drop
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
+        self._thread.start()
+
+    def send(self, mtype: int, req_id: int, encode: Callable[[], bytes]) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._queue.append((mtype, req_id, encode))
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        nat = native.get()
+        if nat is not None:
+            try:
+                nat.ring_wake(self._seg.s2c, 1)  # unblock a space wait
+            except (ValueError, OSError):
+                pass
+
+    def _loop(self) -> None:
+        nat = native.get()
+        mv = self._seg.s2c
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return
+                mtype, req_id, encode = self._queue.popleft()
+            try:
+                payload = encode()
+            except Exception:  # noqa: BLE001  (unpackable reply: front end times out → oracle)
+                continue
+            pushed = False
+            try:
+                for _ in range(20):  # ~1s of space waits before dropping
+                    seq = nat.ring_seq(mv, 1)
+                    if nat.ring_push(mv, mtype, req_id, payload):
+                        pushed = True
+                        break
+                    if self._closed:
+                        return
+                    nat.ring_wait(mv, 1, seq, 50)
+            except (ValueError, OSError):
+                return  # segment gone mid-teardown
+            if pushed:
+                if self._on_frame is not None:
+                    self._on_frame(len(payload))
+            elif self._on_drop is not None:
+                self._on_drop()
+
+
 class BatcherIpcServer:
     """The device-owning process's end of the ticket queue.
 
@@ -253,33 +443,55 @@ class BatcherIpcServer:
         readiness: Optional[Callable[[], dict]] = None,
         max_outstanding: int = 4096,
         faults: Optional[dict] = None,
+        transport: str = "shm",
     ):
         self.socket_path = socket_path
         self.batcher = batcher
         self.readiness = readiness
         self.max_outstanding = max(1, int(max_outstanding))
         self.faults = dict(faults or {})
+        # the transport this server is WILLING to grant; a front end still
+        # has to offer a segment, and either side without the native module
+        # degrades the pair to uds
+        self.transport = transport if transport in ("shm", "uds") else "shm"
         self._listener: Optional[socket.socket] = None
         self._conns: list[socket.socket] = []
         self._lock = threading.Lock()
         self._outstanding = 0
+        self._out_by = {"uds": 0, "shm": 0}
         self._checks_seen = 0
         self._stop = False
-        self.stats = {"connections": 0, "checks": 0, "rejected_full": 0, "wedged_drops": 0}
+        self.stats = {
+            "connections": 0,
+            "checks": 0,
+            "rejected_full": 0,
+            "wedged_drops": 0,
+            "shm_conns": 0,
+            "reply_drops": 0,
+        }
         self._init_metrics()
 
     def _init_metrics(self) -> None:
         from ..observability import metrics
 
         reg = metrics()
-        self.m_depth = reg.gauge(
+        self.m_depth = reg.gauge_vec(
             "cerbos_tpu_ipc_ring_depth",
             "check tickets accepted from front ends and not yet answered",
+            label="transport",
             track_max=True,
         )
-        self.m_full = reg.counter(
+        self._g_depth = {t: self.m_depth.labels(t) for t in ("uds", "shm")}
+        self.m_full = reg.counter_vec(
             "cerbos_tpu_ipc_full_total",
-            "tickets refused because the shared batcher queue was full (front end served its oracle)",
+            "tickets refused because the shared batcher queue or ring was full (front end served its oracle)",
+            label="transport",
+        )
+        self.m_frame_bytes = reg.histogram_vec(
+            "cerbos_tpu_ipc_frame_bytes",
+            "check/reply frame payload sizes crossing the ticket queue",
+            label=("transport", "dir"),
+            buckets=[64, 128, 256, 512, 1024, 4096, 16384, 65536, 1 << 20],
         )
         self.m_enqueue = reg.histogram_vec(
             "cerbos_tpu_ipc_enqueue_seconds",
@@ -347,12 +559,46 @@ class BatcherIpcServer:
         conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
         writer = _ConnWriter(conn, "ipc-writer")
         worker = "?"
+        seg: Optional[_ShmSegment] = None
+        shm_writer: Optional[_ShmWriter] = None
+        shm_stop = threading.Event()
         try:
             while True:
                 mtype, req_id, payload = _recv_frame(conn)
                 if mtype == T_HELLO:
                     hello = marshal.loads(payload)
                     worker = str(hello.get("worker", "?"))
+                    grant = "uds"
+                    if (
+                        seg is None
+                        and self.transport == "shm"
+                        and hello.get("transport") == "shm"
+                        and hello.get("shm_path")
+                        and native.get() is not None
+                    ):
+                        try:
+                            seg = _ShmSegment.attach(str(hello["shm_path"]))
+                            grant = "shm"
+                        except (IpcError, OSError, struct.error):
+                            seg = None
+                    if seg is not None:
+                        self.stats["shm_conns"] += 1
+                        shm_writer = _ShmWriter(
+                            seg,
+                            "ipc-shm-writer",
+                            on_frame=lambda n: self.m_frame_bytes.observe(("shm", "out"), n),
+                            on_drop=self._count_reply_drop,
+                        )
+                        threading.Thread(
+                            target=self._shm_serve_loop,
+                            args=(worker, seg, shm_writer, shm_stop),
+                            daemon=True,
+                            name="ipc-shm-serve",
+                        ).start()
+                    # HELLO_R must be the first frame back on this connection:
+                    # the client blocks on it before sending any traffic, so
+                    # the writer queue is empty here by construction
+                    writer.send(T_HELLO_R, req_id, lambda g=grant: marshal.dumps({"transport": g}))
                 elif mtype == T_CHECK:
                     self._handle_check(worker, req_id, payload, writer)
                 elif mtype == T_STATUS:
@@ -376,6 +622,16 @@ class BatcherIpcServer:
             pass
         finally:
             writer.close()
+            shm_stop.set()
+            if shm_writer is not None:
+                shm_writer.close()
+            if seg is not None:
+                nat = native.get()
+                if nat is not None:
+                    try:
+                        nat.ring_wake(seg.c2s, 0)  # unblock the shm serve loop
+                    except (ValueError, OSError):
+                        pass
             with self._lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
@@ -385,30 +641,90 @@ class BatcherIpcServer:
             except OSError:
                 pass
 
+    def _count_reply_drop(self) -> None:
+        self.stats["reply_drops"] += 1
+
+    def _shm_serve_loop(
+        self,
+        worker: str,
+        seg: _ShmSegment,
+        writer: _ShmWriter,
+        stop: threading.Event,
+    ) -> None:
+        """Ticket consumer for one front end's c2s ring. The socket reader
+        (`_serve_conn`) owns lifecycle: when the connection drops it sets
+        ``stop`` and wakes the ring, and THIS loop must release its
+        memoryview references before the segment closes under it — hence
+        the stop checks on both sides of the pop."""
+        nat = native.get()
+        mv = seg.c2s
+        try:
+            while not stop.is_set():
+                seq = nat.ring_seq(mv, 0)
+                item = nat.ring_pop(mv)
+                if item is None:
+                    nat.ring_wait(mv, 0, seq, 200)
+                    continue
+                mtype, req_id, payload = item
+                if stop.is_set():
+                    return
+                if mtype == T_CHECK:
+                    self._handle_check(worker, req_id, payload, writer, transport="shm")
+        except (ValueError, OSError):
+            return  # segment torn down mid-pop
+        finally:
+            seg.close()
+
     def _wedged(self) -> bool:
         wedge_after = self.faults.get("ipc_wedge_after")
         if wedge_after is None:
             return False
         return self._checks_seen > int(wedge_after)
 
-    def _handle_check(self, worker: str, req_id: int, payload: bytes, writer: _ConnWriter) -> None:
+    def _handle_check(
+        self,
+        worker: str,
+        req_id: int,
+        payload: bytes,
+        writer: Any,
+        transport: str = "uds",
+    ) -> None:
         t0 = time.perf_counter()
         self._checks_seen += 1
         self.stats["checks"] += 1
+        self.m_frame_bytes.observe((transport, "in"), len(payload))
         if self._wedged():
             # simulated wedged ring (engine/faults.py ipc_wedge_after): the
-            # ticket is swallowed; the front end times out onto its oracle
+            # ticket is swallowed whichever transport carried it; the front
+            # end times out onto its oracle
             self.stats["wedged_drops"] += 1
             return
+        if transport == "shm":
+            # shm ERR payloads are the raw utf-8 reason (no codec at all);
+            # outbound sizes are observed by the _ShmWriter push loop
+            def err(reason: str) -> Callable[[], bytes]:
+                return lambda r=str(reason): r.encode()
+
+        else:
+
+            def err(reason: str) -> Callable[[], bytes]:
+                return lambda r=reason: self._sized("uds", marshal.dumps(r))
+
         try:
-            decoded = marshal.loads(payload)
-            deadline_rel, traceparent, rows = decoded[0], decoded[1], decoded[2]
-            # 4th element: latency-budget carry spec (age, attributed) — absent
-            # from pre-waterfall front ends, None when the budget is disabled
-            carry = decoded[3] if len(decoded) > 3 else None
-            inputs = decode_inputs(rows)
+            if transport == "shm":
+                nat = native.get()
+                deadline_rel, traceparent, inputs, carry = nat.ticket_unpack(
+                    payload, T.Principal, T.Resource, T.AuxData, T.CheckInput
+                )
+            else:
+                decoded = marshal.loads(payload)
+                deadline_rel, traceparent, rows = decoded[0], decoded[1], decoded[2]
+                # 4th element: latency-budget carry spec (age, attributed) —
+                # absent from pre-waterfall front ends, None when disabled
+                carry = decoded[3] if len(decoded) > 3 else None
+                inputs = decode_inputs(rows)
         except Exception:  # noqa: BLE001
-            writer.send(T_ERR, req_id, lambda: marshal.dumps("codec"))
+            writer.send(T_ERR, req_id, err("codec"))
             return
         with self._lock:
             if self._outstanding >= self.max_outstanding:
@@ -416,16 +732,19 @@ class BatcherIpcServer:
             else:
                 full = False
                 self._outstanding += 1
+                self._out_by[transport] += 1
+                depth = self._out_by[transport]
         if full:
             self.stats["rejected_full"] += 1
-            self.m_full.inc()
-            writer.send(T_ERR, req_id, lambda: marshal.dumps("ipc_full"))
+            self.m_full.inc(transport)
+            writer.send(T_ERR, req_id, err("ipc_full"))
             return
-        self.m_depth.set(self._outstanding)
+        self._g_depth[transport].set(depth)
         deadline = time.monotonic() + deadline_rel if deadline_rel is not None else None
         ctx = parse_traceparent(traceparent) if traceparent else None
         # rebuild the waterfall from the carried relative spec; the
-        # unattributed remainder (encode + socket + decode) books as transit
+        # unattributed remainder (encode + ring/socket + decode) books as
+        # transit
         wf = budget_tracker().resume(
             carry, trace_id=getattr(ctx, "trace_id", "") or "", deadline=deadline
         )
@@ -435,17 +754,17 @@ class BatcherIpcServer:
         def settle(f: Future) -> None:
             with self._lock:
                 self._outstanding -= 1
-            self.m_depth.set(self._outstanding)
+                self._out_by[transport] -= 1
+                depth = self._out_by[transport]
+            self._g_depth[transport].set(depth)
             try:
                 outs = f.result()
             except DeadlineExceeded:
-                writer.send(T_ERR, req_id, lambda: marshal.dumps("deadline"))
+                writer.send(T_ERR, req_id, err("deadline"))
             except _BatchFailed as e:
-                writer.send(T_ERR, req_id, lambda r=e.reason: marshal.dumps(r))
+                writer.send(T_ERR, req_id, err(e.reason))
             except BaseException as e:  # noqa: BLE001
-                writer.send(
-                    T_ERR, req_id, lambda r=f"batch_error:{type(e).__name__}": marshal.dumps(r)
-                )
+                writer.send(T_ERR, req_id, err(f"batch_error:{type(e).__name__}"))
             else:
                 # reply spec is snapshotted here (the drain thread is done
                 # with the record); writer-queue time lands in the front
@@ -453,13 +772,26 @@ class BatcherIpcServer:
                 # thread, not here (the callback fires on the batcher drain
                 # loop, which must stay hot).
                 spec = wf.reply_spec() if wf is not None else None
-                writer.send(
-                    T_RESULT,
-                    req_id,
-                    lambda o=outs, s=spec: marshal.dumps((encode_outputs(o), s)),
-                )
+                if transport == "shm":
+                    writer.send(
+                        T_RESULT,
+                        req_id,
+                        lambda o=outs, s=spec: native.get().reply_pack(o, s),
+                    )
+                else:
+                    writer.send(
+                        T_RESULT,
+                        req_id,
+                        lambda o=outs, s=spec: self._sized(
+                            "uds", marshal.dumps((encode_outputs(o), s))
+                        ),
+                    )
 
         fut.add_done_callback(settle)
+
+    def _sized(self, transport: str, data: bytes) -> bytes:
+        self.m_frame_bytes.observe((transport, "out"), len(data))
+        return data
 
     def _status_snapshot(self) -> dict:
         snap: dict = {"pid": os.getpid()}
@@ -546,6 +878,8 @@ class RemoteBatcherClient:
         worker_label: str = "fe",
         status_poll_s: float = 0.5,
         connect_retry_s: float = 0.25,
+        transport: str = "shm",
+        ring_kib: int = 1024,
     ):
         self.socket_path = socket_path
         self.rule_table = rule_table
@@ -555,6 +889,13 @@ class RemoteBatcherClient:
         self.worker_label = worker_label
         self.status_poll_s = status_poll_s
         self.connect_retry_s = connect_retry_s
+        # requested transport; the ACTIVE one is renegotiated per attach
+        # (native module present on both ends, server willing) and visible
+        # as .transport for bench/loadtest reporting
+        self.transport_requested = transport if transport in ("shm", "uds") else "shm"
+        self.ring_bytes = max(64 * 1024, int(ring_kib) * 1024)
+        self._transport_active = "uds"
+        self._shm: Optional[_ShmSegment] = None
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._plock = threading.Lock()
@@ -564,7 +905,16 @@ class RemoteBatcherClient:
         self._ever_ready = False
         self._last_status: Optional[dict] = None
         self._stop = False
-        self.stats = {"oracle_fallbacks": 0, "reconnects": 0, "checks": 0}
+        self.stats = {
+            "oracle_fallbacks": 0,
+            "reconnects": 0,
+            "checks": 0,
+            "enc_ns": 0,
+            "enc_frames": 0,
+            "dec_ns": 0,
+            "dec_frames": 0,
+            "ring_full": 0,
+        }
         self._init_metrics()
         self._conn_thread = threading.Thread(
             target=self._connection_loop, daemon=True, name="ipc-client"
@@ -575,18 +925,33 @@ class RemoteBatcherClient:
         )
         self._status_thread.start()
 
+    @property
+    def transport(self) -> str:
+        """The data plane actually carrying tickets right now."""
+        return self._transport_active if self._connected.is_set() else "none"
+
     def _init_metrics(self) -> None:
         from ..observability import metrics
 
         reg = metrics()
-        self.m_rtt = reg.histogram(
+        self.m_rtt = reg.histogram_vec(
             "cerbos_tpu_ipc_client_rtt_seconds",
             "front-end round trip through the shared batcher (encode to decode)",
+            label="transport",
             buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0],
         )
-        self.m_reconnects = reg.counter(
+        self.m_reconnects = reg.counter_vec(
             "cerbos_tpu_ipc_client_reconnects_total",
-            "times the front end (re)attached to the shared batcher",
+            "times the front end (re)attached to the shared batcher, by granted transport",
+            label="transport",
+        )
+        # shares the server's family: a ring-full refusal surfaces here (the
+        # push fails in THIS process) while a queue-full refusal surfaces in
+        # the batcher; dashboards read one family either way
+        self.m_full = reg.counter_vec(
+            "cerbos_tpu_ipc_full_total",
+            "tickets refused because the shared batcher queue or ring was full (front end served its oracle)",
+            label="transport",
         )
         # same family the in-process batcher exports, so existing fallback
         # dashboards keep working against front-end processes
@@ -618,22 +983,70 @@ class RemoteBatcherClient:
                 time.sleep(retry_s)
                 continue
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
+            seg: Optional[_ShmSegment] = None
+            hello = {"worker": self.worker_label, "pid": os.getpid()}
+            if self.transport_requested == "shm" and native.get() is not None:
+                try:
+                    seg = _ShmSegment.create(self.worker_label, self.ring_bytes)
+                    hello.update(
+                        {"transport": "shm", "shm_path": seg.path, "ring_bytes": self.ring_bytes}
+                    )
+                except (IpcError, OSError):
+                    seg = None  # no /dev/shm headroom etc.: run uds
+            granted = "uds"
             try:
-                _send_frame(
-                    sock, T_HELLO, 0, marshal.dumps({"worker": self.worker_label, "pid": os.getpid()})
-                )
-            except OSError:
+                _send_frame(sock, T_HELLO, 0, marshal.dumps(hello))
+                # synchronous handshake: HELLO_R is the first frame the
+                # server sends on a connection, so a blocking read here
+                # races nothing — and no traffic may enter either plane
+                # until the grant decides which one carries it
+                sock.settimeout(5.0)
+                try:
+                    mtype, _, payload = _recv_frame(sock)
+                finally:
+                    sock.settimeout(None)
+                if mtype == T_HELLO_R:
+                    granted = str(marshal.loads(payload).get("transport", "uds"))
+            except (IpcError, OSError, socket.timeout, ValueError, TypeError, EOFError):
+                if seg is not None:
+                    seg.unlink()
+                    seg.close()
                 try:
                     sock.close()
                 except OSError:
                     pass
                 time.sleep(retry_s)
                 continue
+            if seg is not None:
+                # the name has served its purpose: both ends hold the
+                # mapping (or the grant fell back) — unlink so a SIGKILL on
+                # either side cannot leak segments into /dev/shm
+                seg.unlink()
+                if granted != "shm":
+                    seg.close()
+                    seg = None
+            shm_stop = threading.Event()
+            shm_thread: Optional[threading.Thread] = None
+            if seg is not None:
+                shm_thread = threading.Thread(
+                    target=self._shm_read_loop,
+                    args=(seg, shm_stop),
+                    daemon=True,
+                    name="ipc-client-shm",
+                )
+            self._shm = seg
+            self._transport_active = "shm" if seg is not None else "uds"
             self._sock = sock
+            if shm_thread is not None:
+                shm_thread.start()
             self._connected.set()
             self.stats["reconnects"] += 1
-            self.m_reconnects.inc()
-            _log.info("attached to shared batcher at %s", self.socket_path)
+            self.m_reconnects.inc(self._transport_active)
+            _log.info(
+                "attached to shared batcher at %s (transport=%s)",
+                self.socket_path,
+                self._transport_active,
+            )
             try:
                 self._read_loop(sock)
             except (IpcError, OSError):
@@ -641,6 +1054,18 @@ class RemoteBatcherClient:
             finally:
                 self._connected.clear()
                 self._sock = None
+                self._shm = None
+                shm_stop.set()
+                if seg is not None:
+                    nat = native.get()
+                    if nat is not None:
+                        try:
+                            nat.ring_wake(seg.s2c, 0)  # unblock the shm reader
+                        except (ValueError, OSError):
+                            pass
+                    if shm_thread is not None:
+                        shm_thread.join(timeout=2.0)
+                    seg.close()
                 try:
                     sock.close()
                 except OSError:
@@ -656,15 +1081,37 @@ class RemoteBatcherClient:
     def _read_loop(self, sock: socket.socket) -> None:
         while True:
             mtype, req_id, payload = _recv_frame(sock)
-            with self._plock:
-                fut = self._pending.pop(req_id, None)
-            if fut is None:
-                continue  # abandoned (timed-out) ticket: drop the late reply
-            try:
-                if fut.set_running_or_notify_cancel():
-                    fut.set_result((mtype, payload))
-            except Exception:  # noqa: BLE001
-                pass
+            self._settle_frame(mtype, req_id, payload)
+
+    def _shm_read_loop(self, seg: _ShmSegment, stop: threading.Event) -> None:
+        """Reply consumer for the s2c ring: pops RESULT/ERR frames and
+        settles the matching futures, exactly as ``_read_loop`` does for
+        socket frames. Liveness still belongs to the socket — a dead
+        batcher is noticed there, and the connection loop wakes this thread
+        to exit before closing the segment under it."""
+        nat = native.get()
+        mv = seg.s2c
+        try:
+            while not stop.is_set():
+                seq = nat.ring_seq(mv, 0)
+                item = nat.ring_pop(mv)
+                if item is None:
+                    nat.ring_wait(mv, 0, seq, 200)
+                    continue
+                self._settle_frame(*item)
+        except (ValueError, OSError):
+            return  # segment torn down mid-pop
+
+    def _settle_frame(self, mtype: int, req_id: int, payload: bytes) -> None:
+        with self._plock:
+            fut = self._pending.pop(req_id, None)
+        if fut is None:
+            return  # abandoned (timed-out) ticket: drop the late reply
+        try:
+            if fut.set_running_or_notify_cancel():
+                fut.set_result((mtype, payload))
+        except Exception:  # noqa: BLE001
+            pass
 
     def _fail_all_pending(self, err: Exception) -> None:
         with self._plock:
@@ -759,6 +1206,7 @@ class RemoteBatcherClient:
         inputs: Sequence[T.CheckInput],
         deadline: Optional[float],
         wf: Optional[Waterfall] = None,
+        transport: str = "uds",
     ) -> Optional[bytes]:
         deadline_rel = None
         if deadline is not None:
@@ -766,6 +1214,21 @@ class RemoteBatcherClient:
         ctx = current_span_context()
         traceparent = ctx.to_traceparent() if ctx is not None else ""
         try:
+            if transport == "shm":
+                # the native pack runs AFTER the carry snapshot (the carry
+                # rides inside the frame), so its cost books into the
+                # batcher's transit stage — transit genuinely is
+                # "pack + ring + unpack" on this plane, and ipc_encode
+                # shrinks to the admission bookkeeping above it
+                if wf is not None:
+                    wf.mark(STAGE_IPC_ENCODE)
+                carry = wf.carry() if wf is not None else None
+                t0 = time.perf_counter_ns()
+                frame = native.get().ticket_pack(inputs, deadline_rel, traceparent, carry)
+                self.stats["enc_ns"] += time.perf_counter_ns() - t0
+                self.stats["enc_frames"] += 1
+                return frame
+            t0 = time.perf_counter_ns()
             rows = encode_inputs(inputs)
             # book the row conversion as ipc_encode BEFORE taking the carry
             # spec, so the batcher's transit stage (age-at-receipt minus
@@ -774,9 +1237,41 @@ class RemoteBatcherClient:
             if wf is not None:
                 wf.mark(STAGE_IPC_ENCODE)
             carry = wf.carry() if wf is not None else None
-            return marshal.dumps((deadline_rel, traceparent, rows, carry))
-        except Exception:  # noqa: BLE001  (unmarshalable attr value: oracle handles it)
+            frame = marshal.dumps((deadline_rel, traceparent, rows, carry))
+            self.stats["enc_ns"] += time.perf_counter_ns() - t0
+            self.stats["enc_frames"] += 1
+            return frame
+        except Exception:  # noqa: BLE001  (unencodable attr value: oracle handles it)
             return None
+
+    def _send_check(self, req_id: int, payload: bytes, transport: str) -> bool:
+        """Dispatch one CHECK ticket on the active plane. Returns False when
+        the shm ring stayed full through the bounded space wait — the caller
+        serves its oracle under the ``ipc_full`` reason, the same degradation
+        the batcher signals for a full admission queue."""
+        if transport != "shm":
+            self._send(T_CHECK, req_id, payload)
+            return True
+        seg = self._shm
+        nat = native.get()
+        if seg is None or nat is None:
+            raise IpcDisconnected("shm plane detached")
+        try:
+            mv = seg.c2s
+            for _ in range(3):  # immediate try + two bounded space waits
+                seq = nat.ring_seq(mv, 1)
+                if nat.ring_push(mv, T_CHECK, req_id, payload):
+                    return True
+                nat.ring_wait(mv, 1, seq, 50)
+            self.stats["ring_full"] += 1
+            self.m_full.inc("shm")
+            return False
+        except ValueError:
+            # frame larger than the ring, or segment torn down mid-push:
+            # either way this ticket cannot cross — the oracle serves it
+            self.stats["ring_full"] += 1
+            self.m_full.inc("shm")
+            return False
 
     def _wait_budget(self, deadline: Optional[float]) -> float:
         wait = self.request_timeout
@@ -784,20 +1279,35 @@ class RemoteBatcherClient:
             wait = min(wait, max(0.0, deadline - time.monotonic()))
         return wait
 
-    @staticmethod
-    def _decode_result(payload: bytes, wf: Optional[Waterfall]) -> list[T.CheckOutput]:
-        obj = marshal.loads(payload)
-        if isinstance(obj, tuple):
-            rows, spec = obj
-        else:  # pre-waterfall batcher: bare row list
-            rows, spec = obj, None
-        outs = decode_outputs(rows)
+    def _decode_result(
+        self, payload: bytes, wf: Optional[Waterfall], transport: str = "uds"
+    ) -> list[T.CheckOutput]:
+        t0 = time.perf_counter_ns()
+        if transport == "shm":
+            outs, spec = native.get().reply_unpack(
+                payload, T.CheckOutput, T.ActionEffect, T.ValidationError, T.OutputEntry
+            )
+        else:
+            obj = marshal.loads(payload)
+            if isinstance(obj, tuple):
+                rows, spec = obj
+            else:  # pre-waterfall batcher: bare row list
+                rows, spec = obj, None
+            outs = decode_outputs(rows)
+        self.stats["dec_ns"] += time.perf_counter_ns() - t0
+        self.stats["dec_frames"] += 1
         if wf is not None and spec is not None:
             try:
                 wf.splice_reply(spec)
             except Exception:  # noqa: BLE001 — a malformed spec must not fail the request
                 pass
         return outs
+
+    @staticmethod
+    def _err_reason(payload: bytes, transport: str) -> str:
+        if transport == "shm":
+            return payload.decode("utf-8", "replace")
+        return str(marshal.loads(payload))
 
     def _settle_reply(
         self,
@@ -806,14 +1316,15 @@ class RemoteBatcherClient:
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams],
         wf: Optional[Waterfall] = None,
+        transport: str = "uds",
     ) -> list[T.CheckOutput]:
         if mtype == T_RESULT:
-            return self._decode_result(payload, wf)
+            return self._decode_result(payload, wf, transport)
         if mtype == T_ERR:
-            reason = marshal.loads(payload)
+            reason = self._err_reason(payload, transport)
             if reason == "deadline":
                 raise DeadlineExceeded("request deadline expired in the shared batcher")
-            return self._serve_oracle(inputs, params, str(reason), wf=wf)
+            return self._serve_oracle(inputs, params, reason, wf=wf)
         return self._serve_oracle(inputs, params, "protocol", wf=wf)
 
     def check(
@@ -828,13 +1339,19 @@ class RemoteBatcherClient:
         self.stats["checks"] += 1
         if not self._connected.is_set():
             return self._serve_oracle(inputs, params, "batcher_down", wf=wf)
-        payload = self._encode_check(inputs, deadline, wf=wf)
+        # pin the plane for this request: a reconnect mid-flight may
+        # renegotiate, but reconnects also fail every pending future, so a
+        # reply never arrives encoded for a different transport than pinned
+        tr = self._transport_active
+        payload = self._encode_check(inputs, deadline, wf=wf, transport=tr)
         if payload is None:
             return self._serve_oracle(inputs, params, "codec", wf=wf)
         t0 = time.perf_counter()
         req_id, fut = self._register()
         try:
-            self._send(T_CHECK, req_id, payload)
+            if not self._send_check(req_id, payload, tr):
+                self._unregister(req_id)
+                return self._serve_oracle(inputs, params, "ipc_full", wf=wf)
             mtype, data = fut.result(timeout=self._wait_budget(deadline))
         except IpcDisconnected:
             self._unregister(req_id)
@@ -845,8 +1362,8 @@ class RemoteBatcherClient:
                 raise DeadlineExceeded("request deadline expired while queued") from None
             return self._serve_oracle(inputs, params, "ipc_timeout", wf=wf)
         self._unregister(req_id)
-        self.m_rtt.observe(time.perf_counter() - t0)
-        return self._settle_reply(mtype, data, inputs, params, wf=wf)
+        self.m_rtt.observe(tr, time.perf_counter() - t0)
+        return self._settle_reply(mtype, data, inputs, params, wf=wf, transport=tr)
 
     async def check_await(
         self,
@@ -869,13 +1386,16 @@ class RemoteBatcherClient:
         self.stats["checks"] += 1
         if not self._connected.is_set():
             return await oracle("batcher_down")
-        payload = self._encode_check(inputs, deadline, wf=wf)
+        tr = self._transport_active
+        payload = self._encode_check(inputs, deadline, wf=wf, transport=tr)
         if payload is None:
             return await oracle("codec")
         t0 = time.perf_counter()
         req_id, fut = self._register()
         try:
-            self._send(T_CHECK, req_id, payload)
+            if not self._send_check(req_id, payload, tr):
+                self._unregister(req_id)
+                return await oracle("ipc_full")
             mtype, data = await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=self._wait_budget(deadline)
             )
@@ -888,17 +1408,32 @@ class RemoteBatcherClient:
                 raise DeadlineExceeded("request deadline expired while queued") from None
             return await oracle("ipc_timeout")
         self._unregister(req_id)
-        self.m_rtt.observe(time.perf_counter() - t0)
+        self.m_rtt.observe(tr, time.perf_counter() - t0)
         if mtype == T_RESULT:
-            return self._decode_result(data, wf)
+            return self._decode_result(data, wf, tr)
         if mtype == T_ERR:
-            reason = marshal.loads(data)
+            reason = self._err_reason(data, tr)
             if reason == "deadline":
                 raise DeadlineExceeded("request deadline expired in the shared batcher")
-            return await oracle(str(reason))
+            return await oracle(reason)
         return await oracle("protocol")
 
     # -- pool observability surfaces ----------------------------------------
+
+    def transport_stats(self) -> dict:
+        """The ``transport`` block loadtest/bench report: which plane carried
+        tickets, frame counts, and mean encode/decode ns per frame."""
+        s = self.stats
+        return {
+            "transport": self.transport,
+            "requested": self.transport_requested,
+            "ring_kib": self.ring_bytes // 1024,
+            "frames_out": s["enc_frames"],
+            "frames_in": s["dec_frames"],
+            "encode_ns_per_frame": (s["enc_ns"] // s["enc_frames"]) if s["enc_frames"] else 0,
+            "decode_ns_per_frame": (s["dec_ns"] // s["dec_frames"]) if s["dec_frames"] else 0,
+            "ring_full_events": s["ring_full"],
+        }
 
     def remote_status(self) -> dict:
         """Front-end readiness provider (engine/readiness.bind_remote):

@@ -33,6 +33,7 @@ from ..engine.budget import (
     STAGE_REPLY_ENCODE,
 )
 from ..engine.budget import tracker as budget_tracker
+from .. import fastjson
 from ..engine.flight import recorder as flight_recorder
 from ..engine.pressure import monitor as pressure_monitor
 from ..engine.readiness import state as readiness_state
@@ -694,6 +695,7 @@ class Server:
         app.router.add_get("/_cerbos/debug/flight", self._h_flight)
         app.router.add_get("/_cerbos/debug/slow", self._h_slow)
         app.router.add_get("/_cerbos/debug/pressure", self._h_pressure)
+        app.router.add_get("/_cerbos/debug/transport", self._h_transport)
         app.router.add_get("/_cerbos/debug/profile", self._h_profile)
         app.router.add_get("/api/server_info", self._h_server_info)
         # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
@@ -844,6 +846,17 @@ class Server:
                 pass
         return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
 
+    async def _h_transport(self, request: web.Request) -> web.Response:
+        """Ticket-queue data-plane stats for THIS front end: the active
+        plane (shm ring / uds socket), requested vs granted transport, frame
+        counts, native codec cost per frame, and ring-full shed events —
+        the numbers loadtest/bench fold into their --json artifacts. The
+        single-process topology (no ticket queue) reports transport=local."""
+        ev = getattr(self.svc.engine, "tpu_evaluator", None)
+        if ev is not None and hasattr(ev, "transport_stats"):
+            return web.json_response(ev.transport_stats())
+        return web.json_response({"transport": "local"})
+
     async def _h_profile(self, request: web.Request) -> web.Response:
         """Operator-gated jax.profiler capture; see tpu/profiler.py."""
         from ..tpu import profiler
@@ -941,8 +954,12 @@ class Server:
         # at the raw-bytes boundary, so JSON decode cost is stage one
         t_raw = time.monotonic()
         try:
-            body = await request.json()
+            # parse from raw bytes via the native JSON kernel when built
+            # (fastjson falls back to stdlib) — skips aiohttp's str decode
+            body = fastjson.loads(await request.read())
         except json.JSONDecodeError:
+            return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        if not isinstance(body, dict):
             return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
         verr = wire_validate.check_resources_body(body)
         if verr:
@@ -972,8 +989,11 @@ class Server:
                 outputs, call_id = await loop.run_in_executor(
                     None, lambda: self.svc.check_resources(inputs, trace_ctx=trace_ctx, wf=wf)
                 )
-            resp = web.json_response(
-                convert.outputs_to_json(body, outputs, request_id, include_meta, call_id)
+            resp = web.Response(
+                body=fastjson.dumps(
+                    convert.outputs_to_json(body, outputs, request_id, include_meta, call_id)
+                ),
+                content_type="application/json",
             )
             if trace_ctx is not None:
                 # echo the trace the work joined so callers can correlate

@@ -431,6 +431,59 @@ def _pressure_block(text: str) -> dict:
     return {"score": score, "components": components}
 
 
+def _fetch_transport(http_port: int) -> dict:
+    """GET /_cerbos/debug/transport: the answering front end's data-plane
+    stats (transport=local when there is no ticket queue)."""
+    try:
+        s = socket.create_connection(("127.0.0.1", http_port), timeout=5)
+        s.sendall(
+            b"GET /_cerbos/debug/transport HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+        )
+        data = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data.extend(chunk)
+        s.close()
+        return json.loads(bytes(data).split(b"\r\n\r\n", 1)[-1].decode(errors="replace"))
+    except (OSError, ValueError):
+        return {"transport": "unknown"}
+
+
+def _transport_block(text: str, http_port: int, elapsed: float) -> dict:
+    """Fold the ticket-queue data plane into the artifact: which transport
+    the answering front end negotiated plus fleet-wide frame rates and
+    ring-full sheds summed over every worker's series in the merged scrape
+    (the per-process codec ns/frame comes from the debug endpoint)."""
+    block = _fetch_transport(http_port)
+    frames = {"in": 0.0, "out": 0.0}
+    bytes_by_dir = {"in": 0.0, "out": 0.0}
+    full = 0.0
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith("cerbos_tpu_ipc_"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        if series.startswith("cerbos_tpu_ipc_frame_bytes_count"):
+            d = "in" if 'dir="in"' in series else "out"
+            frames[d] += v
+        elif series.startswith("cerbos_tpu_ipc_frame_bytes_sum"):
+            d = "in" if 'dir="in"' in series else "out"
+            bytes_by_dir[d] += v
+        elif series.startswith("cerbos_tpu_ipc_full_total"):
+            full += v
+    block["frames_per_sec"] = round((frames["in"] + frames["out"]) / elapsed, 1) if elapsed else 0.0
+    block["mean_frame_bytes"] = {
+        d: round(bytes_by_dir[d] / frames[d], 1) if frames[d] else 0.0 for d in ("in", "out")
+    }
+    block["ring_full_total"] = int(full)
+    return block
+
+
 def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0, budget: bool = True) -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
@@ -537,6 +590,7 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     waterfall = _waterfall_block(metrics_text)
     goodput = _goodput_block(metrics_text, elapsed)
     pressure = _pressure_block(metrics_text)
+    ipc_transport = _transport_block(metrics_text, http_port, elapsed)
     proc.terminate()
     try:
         proc.wait(timeout=15)
@@ -588,6 +642,11 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         "goodput": goodput,
         # saturation pressure at scrape time (engine/pressure.py)
         "pressure": pressure,
+        # ticket-queue data plane (engine/ipc.py): negotiated transport
+        # (shm frame rings vs uds marshal), frames/s, codec ns/frame,
+        # ring-full sheds — transport=local outside the front-door topology
+        # (the top-level "transport" key is the CLIENT protocol, http/grpc)
+        "ipc_transport": ipc_transport,
     }
 
 
@@ -626,6 +685,12 @@ def main() -> None:
         help="also write the result artifact to PATH (CI-checkable, like bench.py --served --json)",
     )
     args = ap.parse_args()
+    if args.frontends and not args.tpu:
+        # the front-door topology IS the shared device batcher: its batcher
+        # process refuses to boot with engine.tpu.enabled=false, so without
+        # this the pool crash-loops and the readiness poll times out
+        print("--frontends implies the TPU engine path; enabling --tpu", file=sys.stderr)
+        args.tpu = True
     result = run(
         args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers,
         cold=args.cold, frontends=args.frontends, shards=args.shards,

@@ -15,10 +15,21 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <atomic>
+#include <climits>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <ctime>
 #include <string>
 #include <vector>
+
+#if defined(__linux__)
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+#endif
 
 namespace {
 
@@ -1626,6 +1637,1457 @@ PyObject* PyStackPadRows(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// ===========================================================================
+// Front-door transport kernels (engine/ipc.py shm transport + server hot path)
+//
+// The multi-process front door's per-request path crosses these four pieces:
+//
+//   ticket_pack / ticket_unpack   CheckInput rows + relative deadline +
+//                                 traceparent + waterfall carry <-> one
+//                                 fixed-field-order binary frame
+//   reply_pack / reply_unpack    CheckOutput effect rows + reply spec
+//   ring_*                       lock-light SPSC byte ring over a shared
+//                                mmap with futex wakeups (one ring per
+//                                direction per front end)
+//   json_loads / json_dumps      the CheckResources HTTP body parser and
+//                                reply encoder (stdlib-compatible subset)
+//
+// Values inside frames use a small tagged binary codec (the marshal
+// replacement): N/T/F, i (int64), g (bigint decimal), d (double), s (utf8
+// string), b (bytes), l (list), m (dict). Field ORDER is fixed per frame
+// type; values are self-describing so attr payloads stay schema-free.
+
+PyObject* kEmptyTuple = nullptr;
+
+struct InternTable {
+  PyObject* request_id;
+  PyObject* principal;
+  PyObject* resource;
+  PyObject* actions;
+  PyObject* aux_data;
+  PyObject* id;
+  PyObject* roles;
+  PyObject* attr;
+  PyObject* policy_version;
+  PyObject* scope;
+  PyObject* kind;
+  PyObject* jwt;
+  PyObject* resource_id;
+  PyObject* effective_derived_roles;
+  PyObject* validation_errors;
+  PyObject* outputs;
+  PyObject* effective_policies;
+  PyObject* effect;
+  PyObject* policy;
+  PyObject* src;
+  PyObject* action;
+  PyObject* val;
+  PyObject* error;
+  PyObject* path;
+  PyObject* message;
+  PyObject* source;
+};
+InternTable I;
+
+bool InitTransportStatics() {
+  kEmptyTuple = PyTuple_New(0);
+  if (!kEmptyTuple) return false;
+#define CN_INTERN(f)                                      \
+  if (!(I.f = PyUnicode_InternFromString(#f))) return false;
+  CN_INTERN(request_id)
+  CN_INTERN(principal)
+  CN_INTERN(resource)
+  CN_INTERN(actions)
+  CN_INTERN(aux_data)
+  CN_INTERN(id)
+  CN_INTERN(roles)
+  CN_INTERN(attr)
+  CN_INTERN(policy_version)
+  CN_INTERN(scope)
+  CN_INTERN(kind)
+  CN_INTERN(jwt)
+  CN_INTERN(resource_id)
+  CN_INTERN(effective_derived_roles)
+  CN_INTERN(validation_errors)
+  CN_INTERN(outputs)
+  CN_INTERN(effective_policies)
+  CN_INTERN(effect)
+  CN_INTERN(policy)
+  CN_INTERN(src)
+  CN_INTERN(action)
+  CN_INTERN(val)
+  CN_INTERN(error)
+  CN_INTERN(path)
+  CN_INTERN(message)
+  CN_INTERN(source)
+#undef CN_INTERN
+  return true;
+}
+
+// -- tagged value codec ------------------------------------------------------
+
+struct Buf {
+  std::string s;
+  void u8(uint8_t v) { s.push_back(static_cast<char>(v)); }
+  void u32(uint32_t v) { s.append(reinterpret_cast<const char*>(&v), 4); }
+  void u64(uint64_t v) { s.append(reinterpret_cast<const char*>(&v), 8); }
+  void f64(double v) { s.append(reinterpret_cast<const char*>(&v), 8); }
+  void raw(const char* p, size_t n) { s.append(p, n); }
+};
+
+struct Rd {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool need(size_t n) {
+    if (static_cast<size_t>(end - p) < n) {
+      PyErr_SetString(PyExc_ValueError, "truncated frame");
+      return false;
+    }
+    return true;
+  }
+  bool u8(uint8_t* out) {
+    if (!need(1)) return false;
+    *out = *p++;
+    return true;
+  }
+  bool u32(uint32_t* out) {
+    if (!need(4)) return false;
+    memcpy(out, p, 4);
+    p += 4;
+    return true;
+  }
+  bool u64(uint64_t* out) {
+    if (!need(8)) return false;
+    memcpy(out, p, 8);
+    p += 8;
+    return true;
+  }
+  bool f64(double* out) {
+    if (!need(8)) return false;
+    memcpy(out, p, 8);
+    p += 8;
+    return true;
+  }
+};
+
+bool EncodeValue(Buf& b, PyObject* v, int depth) {
+  if (depth > 64) {
+    PyErr_SetString(PyExc_ValueError, "value nesting too deep for frame codec");
+    return false;
+  }
+  if (v == Py_None) {
+    b.u8('N');
+    return true;
+  }
+  if (PyBool_Check(v)) {
+    b.u8(v == Py_True ? 'T' : 'F');
+    return true;
+  }
+  if (PyLong_Check(v)) {
+    int overflow = 0;
+    long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (!overflow) {
+      if (x == -1 && PyErr_Occurred()) return false;
+      b.u8('i');
+      b.u64(static_cast<uint64_t>(x));
+      return true;
+    }
+    PyObject* s = PyObject_Str(v);  // arbitrary-precision: decimal string
+    if (!s) return false;
+    Py_ssize_t n;
+    const char* u = PyUnicode_AsUTF8AndSize(s, &n);
+    if (!u) {
+      Py_DECREF(s);
+      return false;
+    }
+    b.u8('g');
+    b.u32(static_cast<uint32_t>(n));
+    b.raw(u, static_cast<size_t>(n));
+    Py_DECREF(s);
+    return true;
+  }
+  if (PyFloat_Check(v)) {
+    b.u8('d');
+    b.f64(PyFloat_AS_DOUBLE(v));
+    return true;
+  }
+  if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char* u = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!u) return false;
+    b.u8('s');
+    b.u32(static_cast<uint32_t>(n));
+    b.raw(u, static_cast<size_t>(n));
+    return true;
+  }
+  if (PyBytes_Check(v)) {
+    b.u8('b');
+    b.u32(static_cast<uint32_t>(PyBytes_GET_SIZE(v)));
+    b.raw(PyBytes_AS_STRING(v), static_cast<size_t>(PyBytes_GET_SIZE(v)));
+    return true;
+  }
+  if (PyList_Check(v) || PyTuple_Check(v)) {
+    PyObject* fast = PySequence_Fast(v, "sequence");
+    if (!fast) return false;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    b.u8('l');
+    b.u32(static_cast<uint32_t>(n));
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!EncodeValue(b, PySequence_Fast_GET_ITEM(fast, i), depth + 1)) {
+        Py_DECREF(fast);
+        return false;
+      }
+    }
+    Py_DECREF(fast);
+    return true;
+  }
+  if (PyDict_Check(v)) {
+    b.u8('m');
+    b.u32(static_cast<uint32_t>(PyDict_GET_SIZE(v)));
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(v, &pos, &key, &value)) {
+      if (!EncodeValue(b, key, depth + 1)) return false;
+      if (!EncodeValue(b, value, depth + 1)) return false;
+    }
+    return true;
+  }
+  PyErr_Format(PyExc_TypeError, "frame codec cannot encode %s",
+               Py_TYPE(v)->tp_name);
+  return false;
+}
+
+PyObject* DecodeValue(Rd& rd, int depth) {
+  if (depth > 64) {
+    PyErr_SetString(PyExc_ValueError, "frame nesting too deep");
+    return nullptr;
+  }
+  uint8_t tag;
+  if (!rd.u8(&tag)) return nullptr;
+  switch (tag) {
+    case 'N':
+      Py_RETURN_NONE;
+    case 'T':
+      Py_RETURN_TRUE;
+    case 'F':
+      Py_RETURN_FALSE;
+    case 'i': {
+      uint64_t v;
+      if (!rd.u64(&v)) return nullptr;
+      return PyLong_FromLongLong(static_cast<long long>(v));
+    }
+    case 'd': {
+      double v;
+      if (!rd.f64(&v)) return nullptr;
+      return PyFloat_FromDouble(v);
+    }
+    case 'g': {
+      uint32_t n;
+      if (!rd.u32(&n) || !rd.need(n)) return nullptr;
+      std::string s(reinterpret_cast<const char*>(rd.p), n);
+      rd.p += n;
+      return PyLong_FromString(s.c_str(), nullptr, 10);
+    }
+    case 's': {
+      uint32_t n;
+      if (!rd.u32(&n) || !rd.need(n)) return nullptr;
+      const char* q = reinterpret_cast<const char*>(rd.p);
+      rd.p += n;
+      return PyUnicode_DecodeUTF8(q, n, "surrogatepass");
+    }
+    case 'b': {
+      uint32_t n;
+      if (!rd.u32(&n) || !rd.need(n)) return nullptr;
+      const char* q = reinterpret_cast<const char*>(rd.p);
+      rd.p += n;
+      return PyBytes_FromStringAndSize(q, n);
+    }
+    case 'l': {
+      uint32_t n;
+      if (!rd.u32(&n)) return nullptr;
+      if (n > static_cast<size_t>(rd.end - rd.p)) {  // >=1 byte per item
+        PyErr_SetString(PyExc_ValueError, "truncated frame");
+        return nullptr;
+      }
+      PyObject* lst = PyList_New(n);
+      if (!lst) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject* item = DecodeValue(rd, depth + 1);
+        if (!item) {
+          Py_DECREF(lst);
+          return nullptr;
+        }
+        PyList_SET_ITEM(lst, i, item);
+      }
+      return lst;
+    }
+    case 'm': {
+      uint32_t n;
+      if (!rd.u32(&n)) return nullptr;
+      if (n > static_cast<size_t>(rd.end - rd.p)) {
+        PyErr_SetString(PyExc_ValueError, "truncated frame");
+        return nullptr;
+      }
+      PyObject* d = PyDict_New();
+      if (!d) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject* key = DecodeValue(rd, depth + 1);
+        if (!key) {
+          Py_DECREF(d);
+          return nullptr;
+        }
+        PyObject* value = DecodeValue(rd, depth + 1);
+        if (!value) {
+          Py_DECREF(key);
+          Py_DECREF(d);
+          return nullptr;
+        }
+        const int r = PyDict_SetItem(d, key, value);
+        Py_DECREF(key);
+        Py_DECREF(value);
+        if (r < 0) {
+          Py_DECREF(d);
+          return nullptr;
+        }
+      }
+      return d;
+    }
+    default:
+      PyErr_Format(PyExc_ValueError, "bad frame tag 0x%02x", tag);
+      return nullptr;
+  }
+}
+
+// GetAttr + encode, dropping the temporary.
+bool EncodeAttrOf(Buf& b, PyObject* obj, PyObject* name) {
+  PyObject* v = PyObject_GetAttr(obj, name);
+  if (!v) return false;
+  const bool ok = EncodeValue(b, v, 0);
+  Py_DECREF(v);
+  return ok;
+}
+
+// cls.__new__(cls): construct without running __init__/__post_init__ — the
+// attrs crossing the queue were normalized at ingestion (see engine/ipc.py).
+PyObject* NewInstance(PyObject* cls) {
+  if (!PyType_Check(cls)) {
+    PyErr_SetString(PyExc_TypeError, "expected a class");
+    return nullptr;
+  }
+  PyTypeObject* t = reinterpret_cast<PyTypeObject*>(cls);
+  return t->tp_new(t, kEmptyTuple, nullptr);
+}
+
+bool DecodeInto(Rd& rd, PyObject* obj, PyObject* name) {
+  PyObject* v = DecodeValue(rd, 0);
+  if (!v) return false;
+  const int r = PyObject_SetAttr(obj, name, v);
+  Py_DECREF(v);
+  return r == 0;
+}
+
+// -- check-ticket frames -----------------------------------------------------
+//
+// ticket_pack(inputs, deadline_rel, traceparent, carry) -> bytes
+// Layout: u8 version; value(deadline_rel); value(traceparent); u32 n;
+// n x [request_id, principal(id, roles, attr, policy_version, scope),
+//      resource(kind, id, attr, policy_version, scope), actions, jwt|None];
+// value(carry).
+
+constexpr uint8_t kFrameVersion = 1;
+
+PyObject* PyTicketPack(PyObject*, PyObject* args) {
+  PyObject *inputs, *deadline, *traceparent, *carry;
+  if (!PyArg_ParseTuple(args, "OOOO", &inputs, &deadline, &traceparent, &carry))
+    return nullptr;
+  Buf b;
+  b.s.reserve(512);
+  b.u8(kFrameVersion);
+  if (!EncodeValue(b, deadline, 0) || !EncodeValue(b, traceparent, 0))
+    return nullptr;
+  PyObject* fast = PySequence_Fast(inputs, "inputs must be a sequence");
+  if (!fast) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  b.u32(static_cast<uint32_t>(n));
+  bool ok = true;
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    PyObject* inp = PySequence_Fast_GET_ITEM(fast, i);
+    ok = EncodeAttrOf(b, inp, I.request_id);
+    PyObject* p = ok ? PyObject_GetAttr(inp, I.principal) : nullptr;
+    if (ok && !p) ok = false;
+    if (ok) {
+      ok = EncodeAttrOf(b, p, I.id) && EncodeAttrOf(b, p, I.roles) &&
+           EncodeAttrOf(b, p, I.attr) && EncodeAttrOf(b, p, I.policy_version) &&
+           EncodeAttrOf(b, p, I.scope);
+    }
+    Py_XDECREF(p);
+    PyObject* r = ok ? PyObject_GetAttr(inp, I.resource) : nullptr;
+    if (ok && !r) ok = false;
+    if (ok) {
+      ok = EncodeAttrOf(b, r, I.kind) && EncodeAttrOf(b, r, I.id) &&
+           EncodeAttrOf(b, r, I.attr) && EncodeAttrOf(b, r, I.policy_version) &&
+           EncodeAttrOf(b, r, I.scope);
+    }
+    Py_XDECREF(r);
+    if (ok) ok = EncodeAttrOf(b, inp, I.actions);
+    if (ok) {
+      PyObject* aux = PyObject_GetAttr(inp, I.aux_data);
+      if (!aux) {
+        ok = false;
+      } else {
+        if (aux == Py_None) {
+          b.u8('N');
+        } else {
+          ok = EncodeAttrOf(b, aux, I.jwt);
+        }
+        Py_DECREF(aux);
+      }
+    }
+  }
+  Py_DECREF(fast);
+  if (!ok) return nullptr;
+  if (!EncodeValue(b, carry, 0)) return nullptr;
+  return PyBytes_FromStringAndSize(b.s.data(),
+                                   static_cast<Py_ssize_t>(b.s.size()));
+}
+
+// ticket_unpack(data, Principal, Resource, AuxData, CheckInput)
+//   -> (deadline_rel, traceparent, [CheckInput], carry)
+PyObject* PyTicketUnpack(PyObject*, PyObject* args) {
+  const char* data;
+  Py_ssize_t len;
+  PyObject *cls_p, *cls_r, *cls_aux, *cls_inp;
+  if (!PyArg_ParseTuple(args, "y#OOOO", &data, &len, &cls_p, &cls_r, &cls_aux,
+                        &cls_inp))
+    return nullptr;
+  Rd rd{reinterpret_cast<const uint8_t*>(data),
+        reinterpret_cast<const uint8_t*>(data) + len};
+  uint8_t ver;
+  if (!rd.u8(&ver)) return nullptr;
+  if (ver != kFrameVersion) {
+    PyErr_Format(PyExc_ValueError, "unknown ticket frame version %d", ver);
+    return nullptr;
+  }
+  PyObject* deadline = DecodeValue(rd, 0);
+  if (!deadline) return nullptr;
+  PyObject* traceparent = DecodeValue(rd, 0);
+  if (!traceparent) {
+    Py_DECREF(deadline);
+    return nullptr;
+  }
+  uint32_t n = 0;
+  PyObject* lst = nullptr;
+  PyObject* carry = nullptr;
+  bool ok = rd.u32(&n) && n <= static_cast<size_t>(rd.end - rd.p);
+  if (ok) {
+    lst = PyList_New(n);
+    ok = lst != nullptr;
+  } else if (!PyErr_Occurred()) {
+    PyErr_SetString(PyExc_ValueError, "truncated frame");
+  }
+  for (uint32_t i = 0; ok && i < n; i++) {
+    PyObject* rid = DecodeValue(rd, 0);
+    PyObject* p = rid ? NewInstance(cls_p) : nullptr;
+    ok = p && DecodeInto(rd, p, I.id) && DecodeInto(rd, p, I.roles) &&
+         DecodeInto(rd, p, I.attr) && DecodeInto(rd, p, I.policy_version) &&
+         DecodeInto(rd, p, I.scope);
+    PyObject* r = ok ? NewInstance(cls_r) : nullptr;
+    ok = ok && r && DecodeInto(rd, r, I.kind) && DecodeInto(rd, r, I.id) &&
+         DecodeInto(rd, r, I.attr) && DecodeInto(rd, r, I.policy_version) &&
+         DecodeInto(rd, r, I.scope);
+    PyObject* actions = ok ? DecodeValue(rd, 0) : nullptr;
+    ok = ok && actions;
+    PyObject* aux = nullptr;
+    if (ok) {
+      PyObject* jwt = DecodeValue(rd, 0);
+      if (!jwt) {
+        ok = false;
+      } else if (jwt == Py_None) {
+        aux = Py_None;
+        Py_INCREF(aux);
+        Py_DECREF(jwt);
+      } else {
+        aux = NewInstance(cls_aux);
+        ok = aux && PyObject_SetAttr(aux, I.jwt, jwt) == 0;
+        Py_DECREF(jwt);
+      }
+    }
+    PyObject* inp = ok ? NewInstance(cls_inp) : nullptr;
+    ok = ok && inp && PyObject_SetAttr(inp, I.request_id, rid) == 0 &&
+         PyObject_SetAttr(inp, I.principal, p) == 0 &&
+         PyObject_SetAttr(inp, I.resource, r) == 0 &&
+         PyObject_SetAttr(inp, I.actions, actions) == 0 &&
+         PyObject_SetAttr(inp, I.aux_data, aux) == 0;
+    Py_XDECREF(rid);
+    Py_XDECREF(p);
+    Py_XDECREF(r);
+    Py_XDECREF(actions);
+    Py_XDECREF(aux);
+    if (ok) {
+      PyList_SET_ITEM(lst, i, inp);  // steals
+    } else {
+      Py_XDECREF(inp);
+    }
+  }
+  if (ok) {
+    carry = DecodeValue(rd, 0);
+    ok = carry != nullptr;
+  }
+  if (!ok) {
+    Py_DECREF(deadline);
+    Py_DECREF(traceparent);
+    Py_XDECREF(lst);
+    return nullptr;
+  }
+  PyObject* out = PyTuple_Pack(4, deadline, traceparent, lst, carry);
+  Py_DECREF(deadline);
+  Py_DECREF(traceparent);
+  Py_DECREF(lst);
+  Py_DECREF(carry);
+  return out;
+}
+
+// -- reply frames ------------------------------------------------------------
+//
+// reply_pack(outputs, spec) -> bytes
+// Layout: u8 version; u32 n; n x [request_id, resource_id,
+//   u32 n_actions x (action, effect, policy, scope),
+//   effective_derived_roles,
+//   u32 n_verrs x (path, message, source),
+//   u32 n_outs x (src, action, val, error),
+//   effective_policies]; value(spec).
+
+PyObject* PyReplyPack(PyObject*, PyObject* args) {
+  PyObject *outputs, *spec;
+  if (!PyArg_ParseTuple(args, "OO", &outputs, &spec)) return nullptr;
+  Buf b;
+  b.s.reserve(512);
+  b.u8(kFrameVersion);
+  PyObject* fast = PySequence_Fast(outputs, "outputs must be a sequence");
+  if (!fast) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  b.u32(static_cast<uint32_t>(n));
+  bool ok = true;
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    PyObject* o = PySequence_Fast_GET_ITEM(fast, i);
+    ok = EncodeAttrOf(b, o, I.request_id) && EncodeAttrOf(b, o, I.resource_id);
+    if (ok) {
+      PyObject* acts = PyObject_GetAttr(o, I.actions);
+      ok = acts && PyDict_Check(acts);
+      if (!ok && acts && !PyErr_Occurred())
+        PyErr_SetString(PyExc_TypeError, "actions must be a dict");
+      if (ok) {
+        b.u32(static_cast<uint32_t>(PyDict_GET_SIZE(acts)));
+        PyObject *key, *ae;
+        Py_ssize_t pos = 0;
+        while (ok && PyDict_Next(acts, &pos, &key, &ae)) {
+          ok = EncodeValue(b, key, 0) && EncodeAttrOf(b, ae, I.effect) &&
+               EncodeAttrOf(b, ae, I.policy) && EncodeAttrOf(b, ae, I.scope);
+        }
+      }
+      Py_XDECREF(acts);
+    }
+    if (ok) ok = EncodeAttrOf(b, o, I.effective_derived_roles);
+    if (ok) {
+      PyObject* verrs = PyObject_GetAttr(o, I.validation_errors);
+      PyObject* vfast =
+          verrs ? PySequence_Fast(verrs, "validation_errors") : nullptr;
+      ok = vfast != nullptr;
+      if (ok) {
+        const Py_ssize_t nv = PySequence_Fast_GET_SIZE(vfast);
+        b.u32(static_cast<uint32_t>(nv));
+        for (Py_ssize_t j = 0; ok && j < nv; j++) {
+          PyObject* ve = PySequence_Fast_GET_ITEM(vfast, j);
+          ok = EncodeAttrOf(b, ve, I.path) && EncodeAttrOf(b, ve, I.message) &&
+               EncodeAttrOf(b, ve, I.source);
+        }
+      }
+      Py_XDECREF(vfast);
+      Py_XDECREF(verrs);
+    }
+    if (ok) {
+      PyObject* oents = PyObject_GetAttr(o, I.outputs);
+      PyObject* ofast = oents ? PySequence_Fast(oents, "outputs") : nullptr;
+      ok = ofast != nullptr;
+      if (ok) {
+        const Py_ssize_t no = PySequence_Fast_GET_SIZE(ofast);
+        b.u32(static_cast<uint32_t>(no));
+        for (Py_ssize_t j = 0; ok && j < no; j++) {
+          PyObject* oe = PySequence_Fast_GET_ITEM(ofast, j);
+          ok = EncodeAttrOf(b, oe, I.src) && EncodeAttrOf(b, oe, I.action) &&
+               EncodeAttrOf(b, oe, I.val) && EncodeAttrOf(b, oe, I.error);
+        }
+      }
+      Py_XDECREF(ofast);
+      Py_XDECREF(oents);
+    }
+    if (ok) ok = EncodeAttrOf(b, o, I.effective_policies);
+  }
+  Py_DECREF(fast);
+  if (!ok) return nullptr;
+  if (!EncodeValue(b, spec, 0)) return nullptr;
+  return PyBytes_FromStringAndSize(b.s.data(),
+                                   static_cast<Py_ssize_t>(b.s.size()));
+}
+
+// reply_unpack(data, CheckOutput, ActionEffect, ValidationError, OutputEntry)
+//   -> ([CheckOutput], spec)
+PyObject* PyReplyUnpack(PyObject*, PyObject* args) {
+  const char* data;
+  Py_ssize_t len;
+  PyObject *cls_out, *cls_ae, *cls_ve, *cls_oe;
+  if (!PyArg_ParseTuple(args, "y#OOOO", &data, &len, &cls_out, &cls_ae, &cls_ve,
+                        &cls_oe))
+    return nullptr;
+  Rd rd{reinterpret_cast<const uint8_t*>(data),
+        reinterpret_cast<const uint8_t*>(data) + len};
+  uint8_t ver;
+  if (!rd.u8(&ver)) return nullptr;
+  if (ver != kFrameVersion) {
+    PyErr_Format(PyExc_ValueError, "unknown reply frame version %d", ver);
+    return nullptr;
+  }
+  uint32_t n = 0;
+  if (!rd.u32(&n)) return nullptr;
+  if (n > static_cast<size_t>(rd.end - rd.p)) {
+    PyErr_SetString(PyExc_ValueError, "truncated frame");
+    return nullptr;
+  }
+  PyObject* lst = PyList_New(n);
+  if (!lst) return nullptr;
+  bool ok = true;
+  for (uint32_t i = 0; ok && i < n; i++) {
+    PyObject* o = NewInstance(cls_out);
+    ok = o && DecodeInto(rd, o, I.request_id) &&
+         DecodeInto(rd, o, I.resource_id);
+    if (ok) {
+      uint32_t na = 0;
+      ok = rd.u32(&na) && na <= static_cast<size_t>(rd.end - rd.p);
+      PyObject* acts = ok ? PyDict_New() : nullptr;
+      ok = ok && acts;
+      for (uint32_t j = 0; ok && j < na; j++) {
+        PyObject* action = DecodeValue(rd, 0);
+        PyObject* ae = action ? NewInstance(cls_ae) : nullptr;
+        ok = ae && DecodeInto(rd, ae, I.effect) &&
+             DecodeInto(rd, ae, I.policy) && DecodeInto(rd, ae, I.scope);
+        ok = ok && PyDict_SetItem(acts, action, ae) == 0;
+        Py_XDECREF(action);
+        Py_XDECREF(ae);
+      }
+      ok = ok && PyObject_SetAttr(o, I.actions, acts) == 0;
+      Py_XDECREF(acts);
+    }
+    ok = ok && DecodeInto(rd, o, I.effective_derived_roles);
+    if (ok) {
+      uint32_t nv = 0;
+      ok = rd.u32(&nv) && nv <= static_cast<size_t>(rd.end - rd.p);
+      PyObject* verrs = ok ? PyList_New(nv) : nullptr;
+      ok = ok && verrs;
+      for (uint32_t j = 0; ok && j < nv; j++) {
+        PyObject* ve = NewInstance(cls_ve);
+        ok = ve && DecodeInto(rd, ve, I.path) &&
+             DecodeInto(rd, ve, I.message) && DecodeInto(rd, ve, I.source);
+        if (ok) {
+          PyList_SET_ITEM(verrs, j, ve);  // steals
+        } else {
+          Py_XDECREF(ve);
+        }
+      }
+      ok = ok && PyObject_SetAttr(o, I.validation_errors, verrs) == 0;
+      Py_XDECREF(verrs);
+    }
+    if (ok) {
+      uint32_t no = 0;
+      ok = rd.u32(&no) && no <= static_cast<size_t>(rd.end - rd.p);
+      PyObject* oents = ok ? PyList_New(no) : nullptr;
+      ok = ok && oents;
+      for (uint32_t j = 0; ok && j < no; j++) {
+        PyObject* oe = NewInstance(cls_oe);
+        ok = oe && DecodeInto(rd, oe, I.src) && DecodeInto(rd, oe, I.action) &&
+             DecodeInto(rd, oe, I.val) && DecodeInto(rd, oe, I.error);
+        if (ok) {
+          PyList_SET_ITEM(oents, j, oe);  // steals
+        } else {
+          Py_XDECREF(oe);
+        }
+      }
+      ok = ok && PyObject_SetAttr(o, I.outputs, oents) == 0;
+      Py_XDECREF(oents);
+    }
+    ok = ok && DecodeInto(rd, o, I.effective_policies);
+    if (ok) {
+      PyList_SET_ITEM(lst, i, o);  // steals
+    } else {
+      Py_XDECREF(o);
+    }
+  }
+  if (!ok && !PyErr_Occurred())
+    PyErr_SetString(PyExc_ValueError, "truncated frame");
+  PyObject* spec = ok ? DecodeValue(rd, 0) : nullptr;
+  if (!spec) {
+    Py_DECREF(lst);
+    return nullptr;
+  }
+  PyObject* out = PyTuple_Pack(2, lst, spec);
+  Py_DECREF(lst);
+  Py_DECREF(spec);
+  return out;
+}
+
+// -- shared-memory byte ring -------------------------------------------------
+//
+// One ring per direction per front end, over a file-backed shared mmap. The
+// producer and consumer live in different processes; within a process the
+// GIL serializes callers (push/pop never release it), so no extra lock is
+// needed — "MPSC" on the front end is N request threads serialized by the
+// GIL into the single producer role. head/tail are monotonic byte counters
+// (used = head - tail); records are contiguous, with a 0xFFFFFFFF skip
+// marker when a record would straddle the wrap point. Wakeups are futexes
+// on two sequence words (data for the consumer, space for a full producer),
+// guarded by waiter counts so the uncontended path makes no syscall.
+
+constexpr uint32_t kRingMagic = 0x63724E31;  // "1Nrc"
+constexpr size_t kRingHdrBytes = 256;
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+constexpr size_t kRecHdrBytes = 16;  // u32 len, u32 mtype, u64 req_id
+
+struct RingHdr {
+  uint32_t magic;
+  uint32_t flags;
+  uint64_t capacity;
+  char pad0[48];
+  std::atomic<uint64_t> head;
+  char pad1[56];
+  std::atomic<uint64_t> tail;
+  char pad2[56];
+  std::atomic<uint32_t> data_seq;
+  std::atomic<uint32_t> data_waiters;
+  std::atomic<uint32_t> space_seq;
+  std::atomic<uint32_t> space_waiters;
+  std::atomic<uint64_t> pushed;
+  std::atomic<uint64_t> popped;
+  std::atomic<uint64_t> full_events;
+  char pad3[24];
+};
+static_assert(sizeof(RingHdr) == kRingHdrBytes, "ring header layout");
+
+#if defined(__linux__)
+void FutexWait(std::atomic<uint32_t>* addr, uint32_t expected, int timeout_ms) {
+  timespec ts;
+  ts.tv_sec = timeout_ms / 1000;
+  ts.tv_nsec = static_cast<long>(timeout_ms % 1000) * 1000000L;
+  // non-PRIVATE: the ring is shared across processes
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAIT, expected,
+          timeout_ms >= 0 ? &ts : nullptr, nullptr, 0);
+}
+void FutexWakeAll(std::atomic<uint32_t>* addr) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAKE, INT_MAX,
+          nullptr, nullptr, 0);
+}
+#else
+void FutexWait(std::atomic<uint32_t>* addr, uint32_t expected, int timeout_ms) {
+  // portable fallback: bounded sleep-poll
+  const int step_us = 200;
+  int waited_us = 0;
+  while (addr->load(std::memory_order_acquire) == expected &&
+         (timeout_ms < 0 || waited_us < timeout_ms * 1000)) {
+    struct timespec ts = {0, step_us * 1000L};
+    nanosleep(&ts, nullptr);
+    waited_us += step_us;
+  }
+}
+void FutexWakeAll(std::atomic<uint32_t>*) {}
+#endif
+
+RingHdr* RingFromBuffer(Py_buffer* view, bool init) {
+  if (static_cast<size_t>(view->len) < kRingHdrBytes + 64) {
+    PyErr_SetString(PyExc_ValueError, "ring buffer too small");
+    return nullptr;
+  }
+  RingHdr* h = static_cast<RingHdr*>(view->buf);
+  if (!init && (h->magic != kRingMagic ||
+                h->capacity != static_cast<uint64_t>(view->len) - kRingHdrBytes)) {
+    PyErr_SetString(PyExc_ValueError, "not an initialized ring buffer");
+    return nullptr;
+  }
+  return h;
+}
+
+PyObject* PyRingInit(PyObject*, PyObject* args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "w*", &view)) return nullptr;
+  RingHdr* h = RingFromBuffer(&view, true);
+  if (!h) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  memset(view.buf, 0, kRingHdrBytes);
+  h->capacity = static_cast<uint64_t>(view.len) - kRingHdrBytes;
+  h->magic = kRingMagic;
+  PyBuffer_Release(&view);
+  Py_RETURN_NONE;
+}
+
+PyObject* PyRingPush(PyObject*, PyObject* args) {
+  Py_buffer view;
+  unsigned int mtype;
+  unsigned long long req_id;
+  const char* payload;
+  Py_ssize_t plen;
+  if (!PyArg_ParseTuple(args, "w*IKy#", &view, &mtype, &req_id, &payload,
+                        &plen))
+    return nullptr;
+  RingHdr* h = RingFromBuffer(&view, false);
+  if (!h) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  char* data = static_cast<char*>(view.buf) + kRingHdrBytes;
+  const uint64_t cap = h->capacity;
+  const size_t need =
+      kRecHdrBytes + ((static_cast<size_t>(plen) + 7) & ~static_cast<size_t>(7));
+  if (need + kRecHdrBytes >= cap) {
+    PyBuffer_Release(&view);
+    PyErr_Format(PyExc_ValueError, "frame (%zd bytes) larger than ring", plen);
+    return nullptr;
+  }
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  const uint64_t tail = h->tail.load(std::memory_order_acquire);
+  uint64_t pos = head % cap;
+  const uint64_t contig = cap - pos;
+  const uint64_t skip = contig < need ? contig : 0;
+  if ((head - tail) + skip + need > cap) {
+    h->full_events.fetch_add(1, std::memory_order_relaxed);
+    PyBuffer_Release(&view);
+    Py_RETURN_FALSE;
+  }
+  if (skip) {
+    if (contig >= 4)
+      memcpy(data + pos, &kWrapMarker, 4);  // consumer skips to the wrap
+    head += skip;
+    pos = 0;
+  }
+  const uint32_t len32 = static_cast<uint32_t>(plen);
+  const uint32_t mtype32 = static_cast<uint32_t>(mtype);
+  const uint64_t rid = static_cast<uint64_t>(req_id);
+  memcpy(data + pos, &len32, 4);
+  memcpy(data + pos + 4, &mtype32, 4);
+  memcpy(data + pos + 8, &rid, 8);
+  if (plen) memcpy(data + pos + kRecHdrBytes, payload, plen);
+  h->head.store(head + need, std::memory_order_release);
+  h->pushed.fetch_add(1, std::memory_order_relaxed);
+  h->data_seq.fetch_add(1, std::memory_order_release);
+  if (h->data_waiters.load(std::memory_order_acquire))
+    FutexWakeAll(&h->data_seq);
+  PyBuffer_Release(&view);
+  Py_RETURN_TRUE;
+}
+
+PyObject* PyRingPop(PyObject*, PyObject* args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "w*", &view)) return nullptr;
+  RingHdr* h = RingFromBuffer(&view, false);
+  if (!h) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  const char* data = static_cast<const char*>(view.buf) + kRingHdrBytes;
+  const uint64_t cap = h->capacity;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint32_t len = 0;
+  uint64_t pos = 0;
+  for (;;) {
+    const uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head == tail) {
+      PyBuffer_Release(&view);
+      Py_RETURN_NONE;
+    }
+    pos = tail % cap;
+    const uint64_t contig = cap - pos;
+    if (contig < 4) {  // producer couldn't even fit a wrap marker
+      tail += contig;
+      h->tail.store(tail, std::memory_order_release);
+      continue;
+    }
+    memcpy(&len, data + pos, 4);
+    if (len == kWrapMarker) {
+      tail += contig;
+      h->tail.store(tail, std::memory_order_release);
+      continue;
+    }
+    break;
+  }
+  const size_t need =
+      kRecHdrBytes + ((static_cast<size_t>(len) + 7) & ~static_cast<size_t>(7));
+  const uint64_t head = h->head.load(std::memory_order_acquire);
+  if (need > cap || tail + need > head || cap - pos < need) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "corrupt ring record");
+    return nullptr;
+  }
+  uint32_t mtype;
+  uint64_t req_id;
+  memcpy(&mtype, data + pos + 4, 4);
+  memcpy(&req_id, data + pos + 8, 8);
+  PyObject* payload = PyBytes_FromStringAndSize(data + pos + kRecHdrBytes, len);
+  if (!payload) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  h->tail.store(tail + need, std::memory_order_release);
+  h->popped.fetch_add(1, std::memory_order_relaxed);
+  h->space_seq.fetch_add(1, std::memory_order_release);
+  if (h->space_waiters.load(std::memory_order_acquire))
+    FutexWakeAll(&h->space_seq);
+  PyBuffer_Release(&view);
+  PyObject* out = Py_BuildValue("(IKN)", mtype, (unsigned long long)req_id,
+                                payload);  // N steals payload
+  return out;
+}
+
+PyObject* PyRingSeq(PyObject*, PyObject* args) {
+  Py_buffer view;
+  int which;
+  if (!PyArg_ParseTuple(args, "w*i", &view, &which)) return nullptr;
+  RingHdr* h = RingFromBuffer(&view, false);
+  if (!h) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  const uint32_t seq = (which ? h->space_seq : h->data_seq)
+                           .load(std::memory_order_acquire);
+  PyBuffer_Release(&view);
+  return PyLong_FromUnsignedLong(seq);
+}
+
+// ring_wait(buf, which, expected_seq, timeout_ms) -> current seq. Blocks
+// (GIL released) until the chosen sequence word moves past expected_seq or
+// the timeout lapses. Callers capture the seq BEFORE their emptiness check:
+// a push landing in between changes the word and the wait returns at once.
+PyObject* PyRingWait(PyObject*, PyObject* args) {
+  Py_buffer view;
+  int which, timeout_ms;
+  unsigned int expected;
+  if (!PyArg_ParseTuple(args, "w*iIi", &view, &which, &expected, &timeout_ms))
+    return nullptr;
+  RingHdr* h = RingFromBuffer(&view, false);
+  if (!h) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  std::atomic<uint32_t>* seq = which ? &h->space_seq : &h->data_seq;
+  std::atomic<uint32_t>* waiters = which ? &h->space_waiters : &h->data_waiters;
+  uint32_t cur = seq->load(std::memory_order_acquire);
+  if (cur == expected) {
+    waiters->fetch_add(1, std::memory_order_acq_rel);
+    Py_BEGIN_ALLOW_THREADS
+    FutexWait(seq, expected, timeout_ms);
+    Py_END_ALLOW_THREADS
+    waiters->fetch_sub(1, std::memory_order_acq_rel);
+    cur = seq->load(std::memory_order_acquire);
+  }
+  PyBuffer_Release(&view);
+  return PyLong_FromUnsignedLong(cur);
+}
+
+// ring_wake(buf, which) — shutdown aid: bump the sequence word and wake all
+// waiters so a blocked consumer/producer re-checks its stop flag.
+PyObject* PyRingWake(PyObject*, PyObject* args) {
+  Py_buffer view;
+  int which;
+  if (!PyArg_ParseTuple(args, "w*i", &view, &which)) return nullptr;
+  RingHdr* h = RingFromBuffer(&view, false);
+  if (!h) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  std::atomic<uint32_t>* seq = which ? &h->space_seq : &h->data_seq;
+  seq->fetch_add(1, std::memory_order_release);
+  FutexWakeAll(seq);
+  PyBuffer_Release(&view);
+  Py_RETURN_NONE;
+}
+
+PyObject* PyRingStats(PyObject*, PyObject* args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "w*", &view)) return nullptr;
+  RingHdr* h = RingFromBuffer(&view, false);
+  if (!h) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  const uint64_t head = h->head.load(std::memory_order_acquire);
+  const uint64_t tail = h->tail.load(std::memory_order_acquire);
+  PyObject* out = Py_BuildValue(
+      "(KKKKK)", (unsigned long long)(head - tail),
+      (unsigned long long)h->capacity,
+      (unsigned long long)h->pushed.load(std::memory_order_relaxed),
+      (unsigned long long)h->popped.load(std::memory_order_relaxed),
+      (unsigned long long)h->full_events.load(std::memory_order_relaxed));
+  PyBuffer_Release(&view);
+  return out;
+}
+
+// -- JSON (CheckResources hot path) ------------------------------------------
+//
+// A stdlib-compatible subset: json_loads matches json.loads on the request
+// grammar (objects/arrays/strings with full escape handling, int vs float
+// number semantics, NaN/Infinity constants, strict control-char rejection);
+// json_dumps matches json.dumps defaults (ensure_ascii, ", "/": "
+// separators, repr floats). Anything either side can't express raises, and
+// cerbos_tpu/fastjson.py falls back to the stdlib.
+
+void AppendUtf8(std::string& s, uint32_t c) {
+  if (c < 0x80) {
+    s.push_back(static_cast<char>(c));
+  } else if (c < 0x800) {
+    s.push_back(static_cast<char>(0xC0 | (c >> 6)));
+    s.push_back(static_cast<char>(0x80 | (c & 0x3F)));
+  } else if (c < 0x10000) {
+    s.push_back(static_cast<char>(0xE0 | (c >> 12)));
+    s.push_back(static_cast<char>(0x80 | ((c >> 6) & 0x3F)));
+    s.push_back(static_cast<char>(0x80 | (c & 0x3F)));
+  } else {
+    s.push_back(static_cast<char>(0xF0 | (c >> 18)));
+    s.push_back(static_cast<char>(0x80 | ((c >> 12) & 0x3F)));
+    s.push_back(static_cast<char>(0x80 | ((c >> 6) & 0x3F)));
+    s.push_back(static_cast<char>(0x80 | (c & 0x3F)));
+  }
+}
+
+struct JParse {
+  const char* p;
+  const char* end;
+  const char* start;
+
+  void Err(const char* msg) {
+    PyErr_Format(PyExc_ValueError, "%s: char %zd", msg,
+                 static_cast<Py_ssize_t>(p - start));
+  }
+  void Ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+  bool Lit(const char* lit, size_t n) {
+    if (static_cast<size_t>(end - p) < n || memcmp(p, lit, n) != 0) {
+      Err("invalid JSON literal");
+      return false;
+    }
+    p += n;
+    return true;
+  }
+
+  PyObject* String() {
+    p++;  // opening quote
+    std::string out;
+    const char* run = p;
+    while (p < end) {
+      const unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '"') {
+        out.append(run, p - run);
+        p++;
+        return PyUnicode_DecodeUTF8(out.data(),
+                                    static_cast<Py_ssize_t>(out.size()),
+                                    "surrogatepass");
+      }
+      if (c < 0x20) {
+        Err("invalid control character in string");
+        return nullptr;
+      }
+      if (c != '\\') {
+        p++;
+        continue;
+      }
+      out.append(run, p - run);
+      p++;
+      if (p >= end) {
+        Err("unterminated string escape");
+        return nullptr;
+      }
+      const char e = *p++;
+      switch (e) {
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case 'n': out.push_back('\n'); break;
+        case 'r': out.push_back('\r'); break;
+        case 't': out.push_back('\t'); break;
+        case 'u': {
+          uint32_t cp;
+          if (!Hex4(&cp)) return nullptr;
+          if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[0] == '\\' &&
+              p[1] == 'u') {
+            const char* save = p;
+            p += 2;
+            uint32_t lo;
+            if (!Hex4(&lo)) return nullptr;
+            if (lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            } else {
+              p = save;  // not a low surrogate: emit the lone high one
+            }
+          }
+          AppendUtf8(out, cp);  // lone surrogates pass through surrogatepass
+          break;
+        }
+        default:
+          p--;
+          Err("invalid string escape");
+          return nullptr;
+      }
+      run = p;
+    }
+    Err("unterminated string");
+    return nullptr;
+  }
+
+  bool Hex4(uint32_t* out) {
+    if (end - p < 4) {
+      Err("truncated \\u escape");
+      return false;
+    }
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) {
+      const char c = p[i];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else {
+        Err("invalid \\u escape");
+        return false;
+      }
+    }
+    p += 4;
+    *out = v;
+    return true;
+  }
+
+  PyObject* Number() {
+    const char* tok = p;
+    bool is_float = false;
+    if (p < end && *p == '-') p++;
+    if (p < end && *p == '0') {
+      p++;
+    } else if (p < end && *p >= '1' && *p <= '9') {
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    } else {
+      Err("invalid number");
+      return nullptr;
+    }
+    if (p < end && *p == '.') {
+      is_float = true;
+      p++;
+      if (p >= end || *p < '0' || *p > '9') {
+        Err("invalid number");
+        return nullptr;
+      }
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      is_float = true;
+      p++;
+      if (p < end && (*p == '+' || *p == '-')) p++;
+      if (p >= end || *p < '0' || *p > '9') {
+        Err("invalid number");
+        return nullptr;
+      }
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    std::string s(tok, p - tok);
+    if (is_float) {
+      const double d = PyOS_string_to_double(s.c_str(), nullptr, nullptr);
+      if (d == -1.0 && PyErr_Occurred()) return nullptr;
+      return PyFloat_FromDouble(d);
+    }
+    return PyLong_FromString(s.c_str(), nullptr, 10);
+  }
+
+  PyObject* Value(int depth) {
+    if (depth > 500) {
+      PyErr_SetString(PyExc_ValueError, "JSON nesting too deep");
+      return nullptr;
+    }
+    Ws();
+    if (p >= end) {
+      Err("unexpected end of JSON");
+      return nullptr;
+    }
+    switch (*p) {
+      case '{': {
+        p++;
+        PyObject* d = PyDict_New();
+        if (!d) return nullptr;
+        Ws();
+        if (p < end && *p == '}') {
+          p++;
+          return d;
+        }
+        for (;;) {
+          Ws();
+          if (p >= end || *p != '"') {
+            Err("expecting property name in double quotes");
+            Py_DECREF(d);
+            return nullptr;
+          }
+          PyObject* k = String();
+          if (!k) {
+            Py_DECREF(d);
+            return nullptr;
+          }
+          Ws();
+          if (p >= end || *p != ':') {
+            Err("expecting ':' delimiter");
+            Py_DECREF(k);
+            Py_DECREF(d);
+            return nullptr;
+          }
+          p++;
+          PyObject* v = Value(depth + 1);
+          if (!v) {
+            Py_DECREF(k);
+            Py_DECREF(d);
+            return nullptr;
+          }
+          const int r = PyDict_SetItem(d, k, v);
+          Py_DECREF(k);
+          Py_DECREF(v);
+          if (r < 0) {
+            Py_DECREF(d);
+            return nullptr;
+          }
+          Ws();
+          if (p < end && *p == ',') {
+            p++;
+            continue;
+          }
+          if (p < end && *p == '}') {
+            p++;
+            return d;
+          }
+          Err("expecting ',' delimiter");
+          Py_DECREF(d);
+          return nullptr;
+        }
+      }
+      case '[': {
+        p++;
+        PyObject* lst = PyList_New(0);
+        if (!lst) return nullptr;
+        Ws();
+        if (p < end && *p == ']') {
+          p++;
+          return lst;
+        }
+        for (;;) {
+          PyObject* v = Value(depth + 1);
+          if (!v) {
+            Py_DECREF(lst);
+            return nullptr;
+          }
+          const int r = PyList_Append(lst, v);
+          Py_DECREF(v);
+          if (r < 0) {
+            Py_DECREF(lst);
+            return nullptr;
+          }
+          Ws();
+          if (p < end && *p == ',') {
+            p++;
+            continue;
+          }
+          if (p < end && *p == ']') {
+            p++;
+            return lst;
+          }
+          Err("expecting ',' delimiter");
+          Py_DECREF(lst);
+          return nullptr;
+        }
+      }
+      case '"':
+        return String();
+      case 't':
+        if (!Lit("true", 4)) return nullptr;
+        Py_RETURN_TRUE;
+      case 'f':
+        if (!Lit("false", 5)) return nullptr;
+        Py_RETURN_FALSE;
+      case 'n':
+        if (!Lit("null", 4)) return nullptr;
+        Py_RETURN_NONE;
+      case 'N':
+        if (!Lit("NaN", 3)) return nullptr;
+        return PyFloat_FromDouble(Py_NAN);
+      case 'I':
+        if (!Lit("Infinity", 8)) return nullptr;
+        return PyFloat_FromDouble(Py_HUGE_VAL);
+      case '-':
+        if (end - p >= 2 && p[1] == 'I') {
+          if (!Lit("-Infinity", 9)) return nullptr;
+          return PyFloat_FromDouble(-Py_HUGE_VAL);
+        }
+        return Number();
+      default:
+        if (*p >= '0' && *p <= '9') return Number();
+        Err("expecting value");
+        return nullptr;
+    }
+  }
+};
+
+PyObject* PyJsonLoads(PyObject*, PyObject* args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "s*", &view)) return nullptr;
+  JParse jp;
+  jp.start = jp.p = static_cast<const char*>(view.buf);
+  jp.end = jp.p + view.len;
+  PyObject* out = jp.Value(0);
+  if (out) {
+    jp.Ws();
+    if (jp.p != jp.end) {
+      jp.Err("extra data");
+      Py_CLEAR(out);
+    }
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+bool JsonDumpValue(std::string& out, PyObject* v, int depth) {
+  if (depth > 500) {
+    PyErr_SetString(PyExc_ValueError, "JSON nesting too deep (circular?)");
+    return false;
+  }
+  if (v == Py_None) {
+    out += "null";
+    return true;
+  }
+  if (v == Py_True) {
+    out += "true";
+    return true;
+  }
+  if (v == Py_False) {
+    out += "false";
+    return true;
+  }
+  if (PyLong_Check(v)) {
+    PyObject* s = PyObject_Str(v);
+    if (!s) return false;
+    Py_ssize_t n;
+    const char* u = PyUnicode_AsUTF8AndSize(s, &n);
+    if (!u) {
+      Py_DECREF(s);
+      return false;
+    }
+    out.append(u, n);
+    Py_DECREF(s);
+    return true;
+  }
+  if (PyFloat_Check(v)) {
+    const double d = PyFloat_AS_DOUBLE(v);
+    if (d != d) {
+      out += "NaN";
+    } else if (d == Py_HUGE_VAL) {
+      out += "Infinity";
+    } else if (d == -Py_HUGE_VAL) {
+      out += "-Infinity";
+    } else {
+      char* s = PyOS_double_to_string(d, 'r', 0, Py_DTSF_ADD_DOT_0, nullptr);
+      if (!s) return false;
+      out += s;
+      PyMem_Free(s);
+    }
+    return true;
+  }
+  if (PyUnicode_Check(v)) {
+    if (PyUnicode_READY(v) < 0) return false;
+    const int kind = PyUnicode_KIND(v);
+    const void* data = PyUnicode_DATA(v);
+    const Py_ssize_t n = PyUnicode_GET_LENGTH(v);
+    out.push_back('"');
+    char esc[16];
+    for (Py_ssize_t i = 0; i < n; i++) {
+      const Py_UCS4 c = PyUnicode_READ(kind, data, i);
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (c < 0x20 || c > 0x7E) {  // ensure_ascii
+            if (c > 0xFFFF) {
+              const Py_UCS4 x = c - 0x10000;
+              snprintf(esc, sizeof esc, "\\u%04x\\u%04x",
+                       0xD800 + (x >> 10), 0xDC00 + (x & 0x3FF));
+            } else {
+              snprintf(esc, sizeof esc, "\\u%04x", c);
+            }
+            out += esc;
+          } else {
+            out.push_back(static_cast<char>(c));
+          }
+      }
+    }
+    out.push_back('"');
+    return true;
+  }
+  if (PyList_Check(v) || PyTuple_Check(v)) {
+    PyObject* fast = PySequence_Fast(v, "sequence");
+    if (!fast) return false;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    out.push_back('[');
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (i) out += ", ";
+      if (!JsonDumpValue(out, PySequence_Fast_GET_ITEM(fast, i), depth + 1)) {
+        Py_DECREF(fast);
+        return false;
+      }
+    }
+    Py_DECREF(fast);
+    out.push_back(']');
+    return true;
+  }
+  if (PyDict_Check(v)) {
+    out.push_back('{');
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    bool first = true;
+    while (PyDict_Next(v, &pos, &key, &value)) {
+      if (!PyUnicode_Check(key)) {
+        // non-str keys (int/bool/None coercion): stdlib fallback handles it
+        PyErr_SetString(PyExc_TypeError, "JSON object keys must be str");
+        return false;
+      }
+      if (!first) out += ", ";
+      first = false;
+      if (!JsonDumpValue(out, key, depth + 1)) return false;
+      out += ": ";
+      if (!JsonDumpValue(out, value, depth + 1)) return false;
+    }
+    out.push_back('}');
+    return true;
+  }
+  PyErr_Format(PyExc_TypeError, "Object of type %s is not JSON serializable",
+               Py_TYPE(v)->tp_name);
+  return false;
+}
+
+PyObject* PyJsonDumps(PyObject*, PyObject* args) {
+  PyObject* v;
+  if (!PyArg_ParseTuple(args, "O", &v)) return nullptr;
+  std::string out;
+  out.reserve(256);
+  if (!JsonDumpValue(out, v, 0)) return nullptr;
+  return PyBytes_FromStringAndSize(out.data(),
+                                   static_cast<Py_ssize_t>(out.size()));
+}
+
 PyMethodDef kMethods[] = {
     {"glob_match", PyGlobMatch, METH_VARARGS,
      "glob_match(pattern, value) -> bool — gobwas-style glob with ':' separator"},
@@ -1659,6 +3121,37 @@ PyMethodDef kMethods[] = {
     {"stack_pad_rows", PyStackPadRows, METH_VARARGS,
      "stack_pad_rows(dst, rows) — memcpy each contiguous row into its "
      "padded slot of dst and zero the tail (fused pad+stack fill)"},
+    {"ticket_pack", PyTicketPack, METH_VARARGS,
+     "ticket_pack(inputs, deadline_rel, traceparent, carry) -> bytes — "
+     "CheckInput rows into one binary ticket frame"},
+    {"ticket_unpack", PyTicketUnpack, METH_VARARGS,
+     "ticket_unpack(data, Principal, Resource, AuxData, CheckInput) -> "
+     "(deadline_rel, traceparent, inputs, carry)"},
+    {"reply_pack", PyReplyPack, METH_VARARGS,
+     "reply_pack(outputs, spec) -> bytes — CheckOutput effect rows + reply "
+     "spec into one binary reply frame"},
+    {"reply_unpack", PyReplyUnpack, METH_VARARGS,
+     "reply_unpack(data, CheckOutput, ActionEffect, ValidationError, "
+     "OutputEntry) -> (outputs, spec)"},
+    {"ring_init", PyRingInit, METH_VARARGS,
+     "ring_init(buf) — zero the header and stamp magic/capacity"},
+    {"ring_push", PyRingPush, METH_VARARGS,
+     "ring_push(buf, mtype, req_id, payload) -> bool — False when full"},
+    {"ring_pop", PyRingPop, METH_VARARGS,
+     "ring_pop(buf) -> (mtype, req_id, payload) | None"},
+    {"ring_seq", PyRingSeq, METH_VARARGS,
+     "ring_seq(buf, which) -> int — current data(0)/space(1) sequence word"},
+    {"ring_wait", PyRingWait, METH_VARARGS,
+     "ring_wait(buf, which, expected_seq, timeout_ms) -> int — futex wait "
+     "until the sequence word moves; returns the current value"},
+    {"ring_wake", PyRingWake, METH_VARARGS,
+     "ring_wake(buf, which) — bump the sequence word and wake all waiters"},
+    {"ring_stats", PyRingStats, METH_VARARGS,
+     "ring_stats(buf) -> (used, capacity, pushed, popped, full_events)"},
+    {"json_loads", PyJsonLoads, METH_VARARGS,
+     "json_loads(bytes|str) -> obj — stdlib-compatible JSON parse"},
+    {"json_dumps", PyJsonDumps, METH_VARARGS,
+     "json_dumps(obj) -> bytes — stdlib-default-compatible JSON encode"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -1670,4 +3163,7 @@ PyModuleDef kModule = {
 
 }  // namespace
 
-PyMODINIT_FUNC PyInit_cerbos_native(void) { return PyModule_Create(&kModule); }
+PyMODINIT_FUNC PyInit_cerbos_native(void) {
+  if (!InitTransportStatics()) return nullptr;
+  return PyModule_Create(&kModule);
+}

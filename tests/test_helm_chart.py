@@ -137,6 +137,8 @@ class TestChartStatic:
             assert tpu["latencyBudget"][knob] == want["latencyBudget"][knob], knob
         for knob in ("enabled", "intervalMs", "windowSec"):
             assert tpu["pressure"][knob] == want["pressure"][knob], knob
+        for knob in ("socketPath", "transport", "ringKiB", "requestTimeoutMs", "maxOutstanding"):
+            assert tpu["sharedBatcher"][knob] == want["sharedBatcher"][knob], knob
 
     def test_readiness_probe_split_from_liveness(self):
         # a cold replica must not take traffic until warmup has compiled the
@@ -189,6 +191,11 @@ class TestChartStatic:
             "cerbos_tpu_deadline_budget_remaining_seconds_bucket",
             "cerbos_tpu_decisions_total",
             "cerbos_tpu_pressure_score",
+            # IPC transport row (PR 10)
+            "cerbos_tpu_ipc_ring_depth",
+            "cerbos_tpu_ipc_full_total",
+            "cerbos_tpu_ipc_frame_bytes_bucket",
+            "cerbos_tpu_ipc_client_rtt_seconds_bucket",
         ):
             assert needle in joined, needle
 

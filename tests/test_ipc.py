@@ -10,6 +10,7 @@ batcher's first SERVING report, degraded-but-live after a disconnect).
 """
 
 import asyncio
+import re
 import threading
 import time
 
@@ -27,6 +28,7 @@ from cerbos_tpu.engine.ipc import (
     encode_inputs,
     encode_outputs,
 )
+from cerbos_tpu import observability as obs
 from cerbos_tpu.observability import merge_metrics_texts, relabel_metrics_text
 from cerbos_tpu.policy.parser import parse_policies
 from cerbos_tpu.ruletable import build_rule_table, check_input
@@ -457,3 +459,70 @@ class TestMetricsRelabel:
             'cerbos_tpu_request_stage_seconds_bucket{worker="batcher",stage="queue_wait",shard="1",le="0.001"} 5'
             in merged
         )
+
+    def test_relabel_and_merge_cover_transport_families(self):
+        """The PR 10 transport families flow through the textual machinery
+        like any other series: transport/dir labels survive relabeling, and
+        cerbos_tpu_ipc_full_total — registered by BOTH sides of the queue —
+        dedupes its family comment when the two processes' texts merge."""
+        fe = (
+            "# TYPE cerbos_tpu_ipc_frame_bytes histogram\n"
+            'cerbos_tpu_ipc_frame_bytes_bucket{transport="shm",dir="out",le="1024"} 9\n'
+            'cerbos_tpu_ipc_frame_bytes_sum{transport="shm",dir="out"} 4096\n'
+            "# TYPE cerbos_tpu_ipc_full_total counter\n"
+            'cerbos_tpu_ipc_full_total{transport="shm"} 2\n'
+            "# TYPE cerbos_tpu_ipc_client_rtt_seconds histogram\n"
+            'cerbos_tpu_ipc_client_rtt_seconds_bucket{transport="shm",le="0.005"} 11\n'
+        )
+        batcher = (
+            "# TYPE cerbos_tpu_ipc_ring_depth gauge\n"
+            'cerbos_tpu_ipc_ring_depth{transport="shm"} 3\n'
+            "# TYPE cerbos_tpu_ipc_full_total counter\n"
+            'cerbos_tpu_ipc_full_total{transport="uds"} 1\n'
+        )
+        fe_rel = relabel_metrics_text(fe, "worker", "fe0")
+        b_rel = relabel_metrics_text(batcher, "worker", "batcher")
+        assert (
+            'cerbos_tpu_ipc_frame_bytes_bucket{worker="fe0",transport="shm",dir="out",le="1024"} 9'
+            in fe_rel
+        )
+        assert 'cerbos_tpu_ipc_client_rtt_seconds_bucket{worker="fe0",transport="shm",le="0.005"} 11' in fe_rel
+        merged = merge_metrics_texts(fe_rel, b_rel)
+        assert merged.count("# TYPE cerbos_tpu_ipc_full_total counter") == 1
+        assert 'cerbos_tpu_ipc_full_total{worker="fe0",transport="shm"} 2' in merged
+        assert 'cerbos_tpu_ipc_full_total{worker="batcher",transport="uds"} 1' in merged
+        assert 'cerbos_tpu_ipc_ring_depth{worker="batcher",transport="shm"} 3' in merged
+
+
+class TestTransportMetricsLint:
+    def test_ipc_families_register_with_transport_labels(self, tmp_path, rt):
+        """Extends the registry lint (test_tracing.TestMetricsLint) to the
+        transport families, which only register once an ipc pair exists:
+        conformant names, help text, and the transport label dimension in
+        the documented position."""
+        batcher, server, client = make_pair(tmp_path, rt)
+        try:
+            client.check([inp(0)])
+            inst = obs.metrics().instruments()
+            want = {
+                "cerbos_tpu_ipc_ring_depth": (obs.GaugeVec, "transport"),
+                "cerbos_tpu_ipc_full_total": (obs.CounterVec, "transport"),
+                "cerbos_tpu_ipc_frame_bytes": (obs.HistogramVec, ("transport", "dir")),
+                "cerbos_tpu_ipc_client_rtt_seconds": (obs.HistogramVec, "transport"),
+                "cerbos_tpu_ipc_client_reconnects_total": (obs.CounterVec, "transport"),
+            }
+            for name, (typ, label) in want.items():
+                m = inst.get(name)
+                assert isinstance(m, typ), (name, type(m))
+                assert m.label == label, (name, m.label)
+                assert re.fullmatch(r"cerbos_tpu_[a-z0-9_]+", name), name
+                assert m.help, f"metric {name!r} has no help text"
+            # rendered exposition carries the label on every child series
+            text = obs.metrics().render()
+            for line in text.splitlines():
+                if line.startswith("cerbos_tpu_ipc_client_rtt_seconds_bucket{"):
+                    assert 'transport="' in line, line
+        finally:
+            client.close()
+            server.close()
+            batcher.close()

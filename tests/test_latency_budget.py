@@ -330,18 +330,30 @@ class TestSlowRing:
 
 
 class TestFrontendsTopology:
-    def test_waterfall_crosses_ticket_queue(self, tmp_path, rt, tracker):
+    @pytest.mark.parametrize("transport", ["shm", "uds"])
+    def test_waterfall_crosses_ticket_queue(self, tmp_path, rt, tracker, transport):
+        """Attribution must hold on BOTH data planes: the shm frame rings
+        (native codec, ipc_encode marked before the carry is cut) and the
+        uds marshal fallback tile the front end's wall clock identically."""
+        from cerbos_tpu import native
         from cerbos_tpu.engine.ipc import BatcherIpcServer, RemoteBatcherClient
 
+        if transport == "shm" and native.get() is None:
+            pytest.skip("native module unavailable: shm plane cannot grant")
         batcher = BatchingEvaluator(OracleEvaluator(rt), max_wait_ms=1.0)
-        server = BatcherIpcServer(str(tmp_path / "b.sock"), batcher)
+        server = BatcherIpcServer(str(tmp_path / "b.sock"), batcher, transport=transport)
         server.start()
         client = RemoteBatcherClient(
-            server.socket_path, rt, worker_label="fe-test", status_poll_s=0.05
+            server.socket_path,
+            rt,
+            worker_label="fe-test",
+            status_poll_s=0.05,
+            transport=transport,
         )
         try:
             deadline = time.monotonic() + 10.0
             assert client._connected.wait(5.0)
+            assert client.transport == transport
             t0 = time.monotonic()
             wf = tracker.start(trace_id="t-fe", deadline=deadline)
             out = finish_like_server(

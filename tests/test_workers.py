@@ -256,6 +256,13 @@ def test_frontdoor_ready_and_worker_labeled_metrics(frontdoor):
     assert 'worker="fe' in text
     assert 'worker="batcher"' in text
     assert "cerbos_tpu_ipc_ring_depth" in text
+    # the pool's HELLO negotiation granted the shm data plane (the native
+    # module is built in this image); the SIGKILL chaos test below therefore
+    # exercises the ring transport, not the uds fallback
+    from cerbos_tpu import native
+
+    if native.get() is not None:
+        assert 'transport="shm"' in text, "front door did not grant shm"
 
 
 def test_frontdoor_batcher_sigkill_midload_loses_zero_requests(frontdoor):
