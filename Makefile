@@ -7,8 +7,9 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q -p no:cacheprovider
 
 TRANSPORT_TESTS := tests/test_shm_transport.py tests/test_ipc.py tests/test_latency_budget.py
+OVERLOAD_TESTS := tests/test_overload.py
 
-.PHONY: all native clean test test-transport
+.PHONY: all native clean test test-transport test-overload
 
 all: native
 
@@ -28,3 +29,10 @@ test: native
 test-transport: native
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(TRANSPORT_TESTS) $(PYTEST_FLAGS)
 	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(TRANSPORT_TESTS) $(PYTEST_FLAGS)
+
+# overload suite on both codec legs: admission refusals ride the ERR-frame
+# path through the native shm codec when present, and through the uds
+# marshal fallback when it is not — both must carry pclass + retry intact.
+test-overload: native
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(OVERLOAD_TESTS) $(PYTEST_FLAGS)
+	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(OVERLOAD_TESTS) $(PYTEST_FLAGS)

@@ -147,6 +147,10 @@ class AuditLog:
         self.decision_filter = decision_filter or DecisionFilter()
         self.access_logs_enabled = access_logs_enabled
         self.decision_logs_enabled = decision_logs_enabled
+        # brownout shed flag (engine/brownout.py shed_audit): while set,
+        # entries are dropped at the door — the decision still happens,
+        # only its record is lost, and each loss is counted as evidence
+        self._shed = False
         self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=4096)
         self._init_metrics()
         self._worker = threading.Thread(target=self._drain, daemon=True, name="audit-writer")
@@ -179,6 +183,19 @@ class AuditLog:
 
                 logging.getLogger("cerbos_tpu.audit").exception("audit write failed")
 
+    def set_shed(self, flag: bool) -> None:
+        """Brownout applier (stage ``shed_audit``). Reversible: clearing the
+        flag resumes writes with the queue and worker untouched."""
+        self._shed = bool(flag)
+
+    def _shedding(self) -> bool:
+        if not self._shed:
+            return False
+        from ..engine import brownout
+
+        brownout.controller().note_shed("audit")
+        return True
+
     def _submit(self, entry: dict) -> None:
         try:
             self._queue.put_nowait(entry)
@@ -188,6 +205,8 @@ class AuditLog:
 
     def write_access(self, call_id: str, method: str, peer: str = "") -> None:
         if not self.access_logs_enabled or self.backend is None:
+            return
+        if self._shedding():
             return
         self._submit({"callId": call_id, "timestamp": _now_iso(), "kind": "access", "method": method, "peer": peer})
 
@@ -201,6 +220,8 @@ class AuditLog:
     ) -> None:
         if not self.decision_logs_enabled or self.backend is None:
             return
+        if self._shedding():
+            return
         if not self.decision_filter.keep(inputs, outputs):
             return
         self._submit(_entry_from_decision(call_id, inputs, outputs, trace_id=trace_id, shard=shard))
@@ -211,6 +232,8 @@ class AuditLog:
         principal, resource}, output {filter, filterDebug}) plus
         auditTrail.effectivePolicies (engine.go:186-200)."""
         if not self.decision_logs_enabled or self.backend is None:
+            return
+        if self._shedding():
             return
         principal = getattr(plan_input, "principal", None)
         cond = getattr(plan_output, "condition", None)

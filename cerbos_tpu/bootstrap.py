@@ -180,6 +180,15 @@ def initialize(
         window_s=float(pressure_conf.get("windowSec", 30)),
         interval_s=float(pressure_conf.get("intervalMs", 500)) / 1000.0,
     )
+    # overload control: compile the admission classes (the rule-table idiom
+    # — declarative globs → compiled matchers, once) and the brownout
+    # ladder; both servers and the batcher lanes consult the compiled form
+    overload_conf = config.section("overload")
+    from .engine import admission as _admission
+    from .engine import brownout as _brownout
+
+    _admission.controller().configure(overload_conf)
+    _brownout.controller().configure(overload_conf.get("brownout") or {})
 
     tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
     tpu_evaluator = None
@@ -386,6 +395,24 @@ def initialize(
 
         mon.bind(storms=lambda: _compilestats.stats().detector.storms)
     mon.start_ticker()
+
+    # staged brownout: driven by this process's pressure samples (observer),
+    # shedding where the work lives HERE — audit/plan/admission at a front
+    # end, parity in the device-owning process — and surfacing the deepest
+    # engaged stage through readiness. Appliers are reversible by contract.
+    bctl = _brownout.controller()
+    if audit_log is not None:
+        bctl.bind_applier("shed_audit", audit_log.set_shed)
+    if sentinel is not None:
+        bctl.bind_applier("shed_parity", sentinel.set_shed)
+    bctl.bind_applier("shed_low_priority", _admission.controller().set_shed)
+    mon.add_observer(bctl.observe)
+    rstate.bind_brownout(bctl.stage_name)
+    # priority lanes: whatever owns a request queue in this process gets the
+    # compiled class layout (single batcher or every shard lane; front ends
+    # carry no queue — their tickets are prioritized in the batcher process)
+    if batcher is not None and hasattr(batcher, "configure_lanes"):
+        batcher.configure_lanes(_admission.controller().lane_confs())
 
     warm_conf = tpu_conf.get("warmup", {}) or {}
     if role == "frontend":

@@ -119,6 +119,28 @@ DEFAULTS: dict[str, Any] = {
             },
         },
     },
+    # overload control (docs/ROBUSTNESS.md, "Overload & brownout"): front-door
+    # admission (token bucket + concurrency caps per priority class, compiled
+    # once at bootstrap like the rule table) and the staged brownout ladder
+    # driven by the pressure score. classes=[] keeps a single "default" class;
+    # each class entry: {name, priority, weight, match: {principals, roles,
+    # kinds, apis}, rate, burst, maxConcurrent, queueBudget, sheddable}
+    "overload": {
+        "enabled": True,
+        "default": {},
+        "classes": [],
+        "brownout": {
+            "enabled": True,
+            "hysteresis": 0.05,
+            "holdSeconds": 2.0,
+            "stages": [
+                {"name": "shed_audit", "enterAbove": 0.85},
+                {"name": "shed_parity", "enterAbove": 0.90},
+                {"name": "shed_plan", "enterAbove": 0.95},
+                {"name": "shed_low_priority", "enterAbove": 0.98},
+            ],
+        },
+    },
     "storage": {"driver": "disk", "disk": {"directory": "policies", "watchForChanges": False}},
     "schema": {"enforcement": "none"},
     "audit": {"enabled": False, "backend": "local"},

@@ -1,0 +1,375 @@
+"""Front-door admission control: compiled priority classes, refusing early.
+
+At overload, the cheapest request is the one never admitted: today's only
+pressure valves (deadline expiry, the IPC ring filling) fire *after* the
+queue time is already spent. This module gates every request at ingress —
+before it touches the batcher, the ticket ring, or a device batch — so a
+refusal costs one dict lookup and a token-bucket update, never device work.
+
+Load-shedding is expressed as policy, like everything else this PDP
+evaluates: a small declarative ``overload:`` config block declares priority
+classes that match on principal id / roles / resource kind / API using the
+same glob machinery the rule table compiles (``cerbos_tpu.globs``, gobwas
+semantics), compiled once at bootstrap. Each class carries:
+
+- ``priority``      — lower is more important; drives the batcher's
+                      weighted priority lanes (interactive preempts bulk);
+- ``rate``/``burst`` — token-bucket admission (requests/sec, bucket depth);
+- ``maxConcurrent`` — in-flight cap at the front door;
+- ``weight``        — fair share among classes of equal priority in the
+                      batcher lanes;
+- ``queueBudget``   — max tickets queued in this class's batcher lane
+                      (enforced batcher-side, surfaced as a refusal here);
+- ``sheddable``     — the brownout ladder's ``shed_low_priority`` stage
+                      refuses this class outright (default: priority > 0).
+
+Refusals map to HTTP 429 + ``Retry-After`` / gRPC ``RESOURCE_EXHAUSTED``
+and are counted as ``decisions_total{outcome=refused}`` in the refusing
+worker process, so goodput math is topology-independent. One process-global
+controller (the flight-recorder pattern): bootstrap compiles the config,
+both servers consult it.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from ..globs import matches_glob
+from ..observability import metrics
+
+# admission outcomes (the `outcome` label on cerbos_tpu_admission_total)
+ADMITTED = "admitted"
+REFUSED_RATE = "refused_rate"
+REFUSED_CONCURRENCY = "refused_concurrency"
+REFUSED_BROWNOUT = "refused_brownout"
+
+
+class OverloadRefused(Exception):
+    """The request was refused by admission control (or a batcher lane's
+    queue budget). Maps to HTTP 429 + ``Retry-After`` / gRPC
+    RESOURCE_EXHAUSTED at the server layer — never a 5xx."""
+
+    def __init__(self, pclass: str, reason: str, retry_after: float = 1.0):
+        super().__init__(f"overloaded: {reason} (class {pclass or 'default'!r})")
+        self.pclass = pclass
+        self.reason = reason  # rate | concurrency | brownout | queue_budget
+        self.retry_after = max(0.0, float(retry_after))
+
+
+def _match_any(patterns: Sequence[str], values: Iterable[str]) -> bool:
+    for v in values:
+        for pat in patterns:
+            if matches_glob(pat, v):
+                return True
+    return False
+
+
+class PriorityClass:
+    """One compiled class from the ``overload.classes`` list. Matching is
+    first-match-wins in declaration order; within a class, every NON-empty
+    match dimension must hit (an empty dimension is a wildcard)."""
+
+    __slots__ = (
+        "name",
+        "priority",
+        "weight",
+        "rate",
+        "burst",
+        "max_concurrent",
+        "queue_budget",
+        "sheddable",
+        "m_principals",
+        "m_roles",
+        "m_kinds",
+        "m_apis",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        priority: int = 0,
+        weight: int = 1,
+        rate: float = 0.0,
+        burst: float = 0.0,
+        max_concurrent: int = 0,
+        queue_budget: int = 0,
+        sheddable: Optional[bool] = None,
+        principals: Sequence[str] = (),
+        roles: Sequence[str] = (),
+        kinds: Sequence[str] = (),
+        apis: Sequence[str] = (),
+    ):
+        self.name = str(name)
+        self.priority = int(priority)
+        self.weight = max(1, int(weight))
+        self.rate = max(0.0, float(rate))          # 0 = unlimited
+        self.burst = float(burst) if burst else max(self.rate, 1.0)
+        self.max_concurrent = max(0, int(max_concurrent))  # 0 = unlimited
+        self.queue_budget = max(0, int(queue_budget))      # 0 = unlimited
+        # brownout's shed_low_priority stage refuses sheddable classes;
+        # priority-0 classes are protected by default
+        self.sheddable = bool(sheddable) if sheddable is not None else self.priority > 0
+        self.m_principals = tuple(str(p) for p in principals)
+        self.m_roles = tuple(str(r) for r in roles)
+        self.m_kinds = tuple(str(k) for k in kinds)
+        self.m_apis = tuple(str(a) for a in apis)
+        # pre-compile every glob once (matches_glob caches by pattern, so
+        # the per-request path never pays the parse)
+        from ..globs import compile_glob
+
+        for pat in (*self.m_principals, *self.m_roles, *self.m_kinds, *self.m_apis):
+            compile_glob(pat)
+
+    @classmethod
+    def from_conf(cls, conf: dict) -> "PriorityClass":
+        match = conf.get("match") or {}
+        return cls(
+            name=conf.get("name", ""),
+            priority=conf.get("priority", 0),
+            weight=conf.get("weight", 1),
+            rate=conf.get("rate", 0.0),
+            burst=conf.get("burst", 0.0),
+            max_concurrent=conf.get("maxConcurrent", 0),
+            queue_budget=conf.get("queueBudget", 0),
+            sheddable=conf.get("sheddable"),
+            principals=match.get("principals") or (),
+            roles=match.get("roles") or (),
+            kinds=match.get("kinds") or (),
+            apis=match.get("apis") or (),
+        )
+
+    def matches(
+        self,
+        principal_id: str,
+        roles: Sequence[str],
+        kinds: Sequence[str],
+        api: str,
+    ) -> bool:
+        if self.m_principals and not _match_any(self.m_principals, (principal_id,)):
+            return False
+        if self.m_roles and not _match_any(self.m_roles, roles or ()):
+            return False
+        if self.m_kinds and not _match_any(self.m_kinds, kinds or ()):
+            return False
+        if self.m_apis and not _match_any(self.m_apis, (api,)):
+            return False
+        return True
+
+    def lane_conf(self) -> tuple[str, int, int, int]:
+        """(name, priority, weight, queue_budget) for the batcher lanes."""
+        return (self.name, self.priority, self.weight, self.queue_budget)
+
+
+class _ClassState:
+    """Runtime admission state for one class: token bucket + inflight."""
+
+    __slots__ = ("tokens", "last", "inflight", "g_inflight")
+
+    def __init__(self, burst: float, gauge_child: Any):
+        self.tokens = burst
+        self.last: Optional[float] = None
+        self.inflight = 0
+        self.g_inflight = gauge_child
+
+
+class AdmissionTicket:
+    """Release handle for an admitted request; released in the server
+    handler's ``finally`` so concurrency caps can never leak."""
+
+    __slots__ = ("_ctrl", "_cls", "_done")
+
+    def __init__(self, ctrl: "AdmissionController", cls: PriorityClass):
+        self._ctrl = ctrl
+        self._cls = cls
+        self._done = False
+
+    @property
+    def pclass(self) -> PriorityClass:
+        return self._cls
+
+    def release(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._ctrl._release(self._cls)
+
+
+# a permanently-released ticket for the disabled/no-classes fast path: the
+# server's `finally: ticket.release()` stays unconditional
+class _NullTicket(AdmissionTicket):
+    __slots__ = ()
+
+    def __init__(self, cls: PriorityClass):
+        self._ctrl = None  # type: ignore[assignment]
+        self._cls = cls
+        self._done = True
+
+
+class AdmissionController:
+    """Compiled front-door admission: classify, then token-bucket +
+    concurrency-cap per class, O(1) under one lock. ``clock`` is injectable
+    for tests."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        reg = metrics()
+        self.m_total = reg.counter_vec(
+            "cerbos_tpu_admission_total",
+            "front-door admission decisions by priority class and outcome "
+            "(admitted / refused_rate / refused_concurrency / refused_brownout)",
+            label=("pclass", "outcome"),
+        )
+        self.m_inflight = reg.gauge_vec(
+            "cerbos_tpu_admission_inflight",
+            "admitted requests currently in flight, by priority class",
+            label="pclass",
+        )
+        self.m_refusal_seconds = reg.histogram(
+            "cerbos_tpu_admission_refusal_seconds",
+            "ingress-to-refusal latency of refused requests (refusing early "
+            "must stay cheap: the acceptance bar is p99 < 5 ms)",
+            buckets=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25],
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.classes: list[PriorityClass] = []
+        self.default = PriorityClass("default", priority=1)
+        self._state: dict[str, _ClassState] = {}
+        self._null = _NullTicket(self.default)
+        # brownout's shed_low_priority stage flips this; sheddable classes
+        # are refused outright while set
+        self._shed_low_priority = False
+
+    # -- configuration (bootstrap, once) ------------------------------------
+
+    def configure(self, conf: Optional[dict]) -> None:
+        """Compile the ``overload:`` block. Safe to call again on reload."""
+        conf = conf or {}
+        classes = [PriorityClass.from_conf(c) for c in conf.get("classes") or []]
+        classes = [c for c in classes if c.name]
+        default_conf = conf.get("default") or {}
+        default = PriorityClass.from_conf({"name": "default", "priority": 1, **default_conf})
+        with self._lock:
+            self.enabled = bool(conf.get("enabled", True)) and bool(
+                classes
+                or default.rate
+                or default.max_concurrent
+            )
+            self.classes = classes
+            self.default = default
+            self._null = _NullTicket(default)
+            self._state = {
+                c.name: _ClassState(c.burst, self.m_inflight.labels(c.name))
+                for c in (*classes, default)
+            }
+            self._shed_low_priority = False
+
+    def lane_confs(self) -> list[tuple[str, int, int, int]]:
+        """Lane configs for ``BatchingEvaluator.configure_lanes`` (every
+        declared class plus the default catch-all lane)."""
+        with self._lock:
+            return [c.lane_conf() for c in (*self.classes, self.default)]
+
+    def set_shed(self, flag: bool) -> None:
+        """Brownout applier for the ``shed_low_priority`` stage."""
+        self._shed_low_priority = bool(flag)
+
+    # -- request path --------------------------------------------------------
+
+    def classify(
+        self,
+        principal_id: str,
+        roles: Sequence[str] = (),
+        kinds: Sequence[str] = (),
+        api: str = "check",
+    ) -> PriorityClass:
+        """First matching class in declaration order; the implicit default
+        class catches everything else."""
+        for c in self.classes:
+            if c.matches(principal_id, roles, kinds, api):
+                return c
+        return self.default
+
+    def try_admit(self, cls: PriorityClass, now: Optional[float] = None) -> AdmissionTicket:
+        """Admit or raise ``OverloadRefused``. The returned ticket MUST be
+        released (``finally``) when the request finishes."""
+        if not self.enabled:
+            return self._null
+        now = self._clock() if now is None else now
+        with self._lock:
+            st = self._state.get(cls.name)
+            if st is None:  # classes swapped under us: admit, never crash
+                return self._null
+            if self._shed_low_priority and cls.sheddable:
+                self.m_total.inc((cls.name, REFUSED_BROWNOUT))
+                raise OverloadRefused(cls.name, "brownout", retry_after=1.0)
+            if cls.max_concurrent and st.inflight >= cls.max_concurrent:
+                self.m_total.inc((cls.name, REFUSED_CONCURRENCY))
+                raise OverloadRefused(cls.name, "concurrency", retry_after=0.1)
+            if cls.rate > 0:
+                if st.last is not None:
+                    st.tokens = min(cls.burst, st.tokens + (now - st.last) * cls.rate)
+                st.last = now
+                if st.tokens < 1.0:
+                    self.m_total.inc((cls.name, REFUSED_RATE))
+                    raise OverloadRefused(
+                        cls.name, "rate", retry_after=(1.0 - st.tokens) / cls.rate
+                    )
+                st.tokens -= 1.0
+            st.inflight += 1
+            st.g_inflight.set(float(st.inflight))
+        self.m_total.inc((cls.name, ADMITTED))
+        return AdmissionTicket(self, cls)
+
+    def _release(self, cls: PriorityClass) -> None:
+        with self._lock:
+            st = self._state.get(cls.name)
+            if st is None:
+                return
+            st.inflight = max(0, st.inflight - 1)
+            st.g_inflight.set(float(st.inflight))
+
+    # -- observability -------------------------------------------------------
+
+    def observe_refusal(self, seconds: float) -> None:
+        self.m_refusal_seconds.observe(max(0.0, seconds))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "shed_low_priority": self._shed_low_priority,
+                "classes": [
+                    {
+                        "name": c.name,
+                        "priority": c.priority,
+                        "weight": c.weight,
+                        "rate": c.rate,
+                        "burst": c.burst,
+                        "maxConcurrent": c.max_concurrent,
+                        "queueBudget": c.queue_budget,
+                        "sheddable": c.sheddable,
+                        "inflight": self._state[c.name].inflight
+                        if c.name in self._state
+                        else 0,
+                    }
+                    for c in (*self.classes, self.default)
+                ],
+            }
+
+
+def retry_after_header(e: OverloadRefused) -> str:
+    """HTTP ``Retry-After`` delay-seconds: integral, never negative, and at
+    least 1 for anything non-trivially in the future (sub-second refusals
+    still tell the client to back off, not to hot-loop)."""
+    return str(max(1, int(math.ceil(e.retry_after))) if e.retry_after > 0.001 else 1)
+
+
+_controller = AdmissionController()
+
+
+def controller() -> AdmissionController:
+    return _controller

@@ -43,6 +43,7 @@ from typing import Any, Optional, Sequence
 from ..observability import SpanContext, current_span_context, export_span, start_span
 from ..ruletable import check_input
 from . import types as T
+from .admission import OverloadRefused
 from .budget import (
     POINT_DEVICE_SUBMIT,
     POINT_ENQUEUE,
@@ -96,6 +97,142 @@ class _Pending:
     # migrates with the request across the thread hop, and the drain thread
     # books queue_wait/pack/device/collect/settle into it at settle time
     wf: Optional[Waterfall] = None
+    # admission priority class ('' = unclassified → the default lane);
+    # selects the weighted priority lane this request queues in
+    pclass: str = ""
+
+
+class _Lane:
+    """One priority lane: a FIFO deque plus its scheduling parameters."""
+
+    __slots__ = ("name", "priority", "weight", "budget", "q", "credit")
+
+    def __init__(self, name: str, priority: int = 0, weight: int = 1, budget: int = 0):
+        self.name = name
+        self.priority = int(priority)          # lower preempts
+        self.weight = max(1, int(weight))      # fair share within a band
+        self.budget = max(0, int(budget))      # max queued; 0 = unlimited
+        self.q: deque[_Pending] = deque()
+        self.credit = 0.0                      # smooth-WRR accumulator
+
+
+class _PriorityLanes:
+    """Weighted priority lanes over the pending queue.
+
+    Selection is strict priority across bands (the lowest ``priority``
+    value with work wins — interactive traffic preempts bulk outright at
+    overload, which is the point) and smooth weighted round-robin within a
+    band (deterministic nginx-style credit counters, no RNG). Per-class
+    queue budgets bound each lane so one class's backlog cannot starve the
+    ring for everyone else.
+
+    Unconfigured, everything rides one default lane — byte-for-byte the
+    old FIFO behavior. Every method runs under the batcher lock; ``peek``
+    and ``popleft`` agree because ``_pick`` is pure and nothing interleaves
+    between them.
+    """
+
+    __slots__ = ("_lanes", "_order", "_default", "_len")
+
+    def __init__(self):
+        self._default = _Lane("default")
+        self._lanes: dict[str, _Lane] = {"default": self._default}
+        self._order: list[_Lane] = [self._default]
+        self._len = 0
+
+    def configure(self, lane_confs) -> None:
+        """Rebuild lanes from (name, priority, weight, budget) tuples;
+        anything already queued migrates into the new lanes."""
+        queued = list(self)
+        lanes: dict[str, _Lane] = {}
+        order: list[_Lane] = []
+        default: Optional[_Lane] = None
+        for name, priority, weight, budget in lane_confs or ():
+            lane = _Lane(str(name), priority, weight, budget)
+            lanes[lane.name] = lane
+            order.append(lane)
+            if lane.name == "default":
+                default = lane
+        if default is None:
+            default = _Lane("default", priority=1)
+            lanes["default"] = default
+            order.append(default)
+        self._lanes, self._order, self._default = lanes, order, default
+        self._len = 0
+        for p in queued:
+            self.append(p)
+
+    def _lane(self, pclass: str) -> _Lane:
+        return self._lanes.get(pclass or "default", self._default)
+
+    def over_budget(self, pclass: str) -> bool:
+        lane = self._lane(pclass)
+        return lane.budget > 0 and len(lane.q) >= lane.budget
+
+    def append(self, p: _Pending) -> None:
+        self._lane(p.pclass).q.append(p)
+        self._len += 1
+
+    def _pick(self) -> Optional[_Lane]:
+        band_prio: Optional[int] = None
+        band: list[_Lane] = []
+        for lane in self._order:
+            if not lane.q:
+                continue
+            if band_prio is None or lane.priority < band_prio:
+                band_prio, band = lane.priority, [lane]
+            elif lane.priority == band_prio:
+                band.append(lane)
+        if not band:
+            return None
+        if len(band) == 1:
+            return band[0]
+        # max() is stable: ties resolve to declaration order
+        return max(band, key=lambda ln: ln.credit + ln.weight)
+
+    def peek(self) -> _Pending:
+        lane = self._pick()
+        if lane is None:
+            raise IndexError("peek from empty lanes")
+        return lane.q[0]
+
+    def popleft(self) -> _Pending:
+        lane = self._pick()
+        if lane is None:
+            raise IndexError("pop from empty lanes")
+        band = [ln for ln in self._order if ln.q and ln.priority == lane.priority]
+        if len(band) > 1:
+            # smooth WRR advance: credit += weight for the whole band, the
+            # winner pays back the band's total
+            total = 0
+            for ln in band:
+                ln.credit += ln.weight
+                total += ln.weight
+            lane.credit -= total
+        self._len -= 1
+        return lane.q.popleft()
+
+    def remove(self, p: _Pending) -> None:
+        self._lane(p.pclass).q.remove(p)  # ValueError if absent, like deque
+        self._len -= 1
+
+    def clear(self) -> None:
+        for lane in self._order:
+            lane.q.clear()
+        self._len = 0
+
+    def __iter__(self):
+        for lane in self._order:
+            yield from lane.q
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __bool__(self) -> bool:
+        return self._len > 0
+
+    def depths(self) -> dict[str, int]:
+        return {lane.name: len(lane.q) for lane in self._order if lane.q}
 
 
 @dataclass
@@ -171,6 +308,9 @@ class BatchingEvaluator:
     # Engine forwards latency-budget waterfalls only to evaluators that
     # book their own stages (admission/queue/pack/device/collect/settle).
     supports_waterfall = True
+    # Engine forwards the admission priority class only to evaluators with
+    # priority lanes (engine/admission.py classifies at ingress).
+    supports_pclass = True
 
     def __init__(
         self,
@@ -204,7 +344,7 @@ class BatchingEvaluator:
         self.sentinel: Optional[Any] = None
         self.quarantine_max = max(1, int(quarantine_max))
         self.bisect_budget = max(3, int(bisect_budget))
-        self._queue: deque[_Pending] = deque()
+        self._queue = _PriorityLanes()
         self._lock = threading.Lock()
         self._wakeup = threading.Condition(self._lock)
         self._stop = False
@@ -221,6 +361,7 @@ class BatchingEvaluator:
             "batch_errors": 0,
             "deadline_drops": 0,
             "quarantined": 0,
+            "lane_refusals": 0,
         }
         self._init_metrics()
         tname = "check-batcher" if shard_id is None else f"check-batcher-s{shard_id}"
@@ -278,6 +419,11 @@ class BatchingEvaluator:
             "padded device rows that carried no real input, by shard",
             label="shard",
         )
+        self.m_queue_budget = reg.counter_vec(
+            "cerbos_tpu_admission_queue_budget_total",
+            "requests refused because their priority class's lane queue budget was full, by class",
+            label="pclass",
+        )
         self._m_stage_vec = reg.histogram_vec(
             "cerbos_tpu_batch_stage_seconds",
             "device-batch pipeline stage latency (pack/submit/device/collect/settle), by shard",
@@ -310,12 +456,36 @@ class BatchingEvaluator:
 
     # -- request path -------------------------------------------------------
 
+    def configure_lanes(self, lane_confs) -> None:
+        """Install the weighted priority lanes (one per admission class,
+        plus the default catch-all) from (name, priority, weight,
+        queue_budget) tuples — ``AdmissionController.lane_confs()``."""
+        with self._wakeup:
+            self._queue.configure(lane_confs)
+
+    def lane_depths(self) -> dict[str, int]:
+        with self._lock:
+            return self._queue.depths()
+
+    def _enqueue(self, pending: _Pending) -> bool:
+        """Enqueue under the lane's queue budget; False = budget full (the
+        caller refuses — per-class backlog must not starve the ring)."""
+        with self._wakeup:
+            if self._queue.over_budget(pending.pclass):
+                self.stats["lane_refusals"] += 1
+                self.m_queue_budget.inc(pending.pclass or "default")
+                return False
+            self._queue.append(pending)
+            self._wakeup.notify()
+            return True
+
     def check(
         self,
         inputs: Sequence[T.CheckInput],
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         wf: Optional[Waterfall] = None,
+        pclass: Optional[str] = None,
     ) -> list[T.CheckOutput]:
         T.set_current_shard(self.shard_id if self.shard_id is not None else 0)
         if wf is not None:
@@ -341,12 +511,13 @@ class BatchingEvaluator:
             # the span context crosses the batcher thread hop in _Pending so
             # the device batch's spans land in this request's trace
             pending = _Pending(
-                list(inputs), params, fut, deadline=deadline, ctx=span.context, wf=wf
+                list(inputs), params, fut, deadline=deadline, ctx=span.context, wf=wf,
+                pclass=pclass or "",
             )
             self._admit_wf(wf, deadline)
-            with self._wakeup:
-                self._queue.append(pending)
-                self._wakeup.notify()
+            if not self._enqueue(pending):
+                span.set_attribute("outcome", "queue_budget")
+                raise OverloadRefused(pending.pclass, "queue_budget", retry_after=0.1)
             wait = self.request_timeout
             if deadline is not None:
                 wait = min(wait, max(0.0, deadline - time.monotonic()))
@@ -386,6 +557,7 @@ class BatchingEvaluator:
         deadline: Optional[float] = None,
         ctx: Optional[SpanContext] = None,
         wf: Optional[Waterfall] = None,
+        pclass: Optional[str] = None,
     ) -> Future:
         """Non-blocking enqueue for callers that hold many tickets at once
         (the IPC server fronting N worker processes cannot burn a thread per
@@ -415,11 +587,15 @@ class BatchingEvaluator:
         if self._stop or self._dead is not None or not self._thread.is_alive():
             _settle(fut, error=_BatchFailed(self._dead, "batcher_dead"))
             return fut
-        pending = _Pending(list(inputs), params, fut, deadline=deadline, ctx=ctx, wf=wf)
+        pending = _Pending(
+            list(inputs), params, fut, deadline=deadline, ctx=ctx, wf=wf,
+            pclass=pclass or "",
+        )
         self._admit_wf(wf, deadline)
-        with self._wakeup:
-            self._queue.append(pending)
-            self._wakeup.notify()
+        if not self._enqueue(pending):
+            # rides the existing ERR-frame path: the front end turns this
+            # into HTTP 429 / RESOURCE_EXHAUSTED, costing the batcher nothing
+            _settle(fut, error=_BatchFailed(None, "queue_budget"))
         return fut
 
     def _admit_wf(self, wf: Optional[Waterfall], deadline: Optional[float]) -> None:
@@ -510,7 +686,7 @@ class BatchingEvaluator:
                 total = 0
                 now = time.monotonic()
                 while self._queue and total < self.max_batch:
-                    p = self._queue[0]
+                    p = self._queue.peek()
                     if pending and total + len(p.inputs) > self.max_batch:
                         break
                     self._queue.popleft()

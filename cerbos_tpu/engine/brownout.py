@@ -1,0 +1,270 @@
+"""Staged brownout: shed optional work as pressure rises, restore as it falls.
+
+Driven by ``PressureMonitor.sample()`` (an observer registered at
+bootstrap), the controller walks a declared ladder of stages as the
+pressure score crosses each stage's threshold — one stage per observation,
+with hysteresis and a hold time so breaker blips and scrape jitter cannot
+flap the ladder:
+
+- ``shed_audit``         — stop audit log writes (cheapest loss first:
+                           the decision still happens, only its record is
+                           dropped);
+- ``shed_parity``        — pause parity-sentinel shadow sampling (frees
+                           the CPU oracle for degraded-path traffic);
+- ``shed_plan``          — refuse plan queries (analytical traffic yields
+                           to interactive checks);
+- ``shed_low_priority``  — refuse sheddable admission classes outright.
+
+A stage ENGAGES after the score holds at/above its ``enterAbove`` for
+``holdSeconds``; it DISENGAGES after the score holds below
+``enterAbove - hysteresis`` for the same hold. Every transition is
+edge-logged, flight-recorded (``brownout_enter`` / ``brownout_exit``),
+counted, and surfaced in readiness (``reason: "brownout"`` + the deepest
+engaged stage) so operators see shed state where they already look.
+
+Effects are applied two ways: push appliers bound at bootstrap (the audit
+log's and parity sentinel's shed flags — restored to their configured
+behavior on exit) and pull checks (``active("shed_plan")`` from the plan
+handlers, the admission controller's low-priority shed flag). Each process
+in a ``--frontends`` topology runs its own controller on its own pressure
+monitor — sheds happen where the work lives (audit/plan at the front ends,
+parity in the batcher), and the batcher's stage reaches front-end readiness
+through the existing status-poll snapshot. One process-global instance
+(the flight-recorder pattern); ``clock`` injectable for tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..observability import metrics
+from . import flight
+
+_log = logging.getLogger("cerbos_tpu.engine.brownout")
+
+# the default ladder: cheapest loss first, refusals last
+DEFAULT_STAGES = [
+    {"name": "shed_audit", "enterAbove": 0.85},
+    {"name": "shed_parity", "enterAbove": 0.90},
+    {"name": "shed_plan", "enterAbove": 0.95},
+    {"name": "shed_low_priority", "enterAbove": 0.98},
+]
+DEFAULT_HYSTERESIS = 0.05
+DEFAULT_HOLD_S = 2.0
+
+
+class BrownoutStage:
+    __slots__ = ("name", "enter", "exit")
+
+    def __init__(self, name: str, enter: float, hysteresis: float):
+        self.name = str(name)
+        self.enter = max(0.0, min(1.0, float(enter)))
+        self.exit = max(0.0, self.enter - max(0.0, float(hysteresis)))
+
+
+class BrownoutController:
+    """Walks the stage ladder one step per pressure observation."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        reg = metrics()
+        self.m_stage = reg.gauge(
+            "cerbos_tpu_brownout_stage",
+            "engaged brownout stages (0 = none, N = the first N stages of the declared ladder)",
+        )
+        self.m_transitions = reg.counter_vec(
+            "cerbos_tpu_brownout_transitions_total",
+            "brownout stage transitions by stage and direction (enter/exit)",
+            label=("stage", "direction"),
+        )
+        self.m_shed = reg.counter_vec(
+            "cerbos_tpu_brownout_shed_total",
+            "work shed while a brownout stage was engaged, by target "
+            "(audit / parity / plan / class)",
+            label="target",
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.hold_s = DEFAULT_HOLD_S
+        self.stages: list[BrownoutStage] = []
+        self._level = 0
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+        # stage name -> applier(engaged: bool); bound by bootstrap
+        self._appliers: dict[str, Callable[[bool], None]] = {}
+
+    # -- configuration (bootstrap, once) ------------------------------------
+
+    def configure(self, conf: Optional[dict]) -> None:
+        """Compile the ``overload.brownout`` block; resets to level 0 (any
+        engaged appliers are released first so a reload never leaves work
+        shed)."""
+        conf = conf or {}
+        hysteresis = float(conf.get("hysteresis", DEFAULT_HYSTERESIS))
+        raw = conf.get("stages")
+        if raw is None:
+            raw = DEFAULT_STAGES
+        stages = [
+            BrownoutStage(s.get("name", ""), s.get("enterAbove", 1.0), hysteresis)
+            for s in raw
+            if s.get("name")
+        ]
+        with self._lock:
+            self._disengage_all_locked()
+            self.enabled = bool(conf.get("enabled", True)) and bool(stages)
+            self.hold_s = max(0.0, float(conf.get("holdSeconds", DEFAULT_HOLD_S)))
+            self.stages = stages
+            self._above_since = self._below_since = None
+
+    def bind_applier(self, stage_name: str, fn: Callable[[bool], None]) -> None:
+        """Register the side effect of one stage (e.g. the audit log's shed
+        flag). Called with True on enter, False on exit; exceptions are
+        swallowed — a broken applier must not wedge the control loop."""
+        self._appliers[str(stage_name)] = fn
+
+    def reset(self) -> None:
+        """Release every engaged stage (tests, re-initialization)."""
+        with self._lock:
+            self._disengage_all_locked()
+            self._above_since = self._below_since = None
+
+    # -- control loop (pressure observer) -----------------------------------
+
+    def observe(
+        self,
+        score: float,
+        components: Optional[dict] = None,
+        now: Optional[float] = None,
+    ) -> None:
+        """One pressure observation. Never raises: this runs inside the
+        pressure monitor's sampling path."""
+        try:
+            self._observe(float(score), now)
+        except Exception:  # noqa: BLE001
+            _log.exception("brownout controller observation failed")
+
+    def _observe(self, score: float, now: Optional[float]) -> None:
+        with self._lock:
+            if not self.enabled or not self.stages:
+                return
+            now = self._clock() if now is None else now
+            entered = exited = None
+            # ascend: next stage's enter threshold held for hold_s
+            if self._level < len(self.stages) and score >= self.stages[self._level].enter:
+                if self._above_since is None:
+                    self._above_since = now
+                if now - self._above_since >= self.hold_s:
+                    entered = self.stages[self._level]
+                    self._level += 1
+                    # a deeper stage needs a fresh hold of ITS threshold
+                    self._above_since = None
+            else:
+                self._above_since = None
+            # descend: current stage's exit threshold held for hold_s
+            if (
+                entered is None
+                and self._level > 0
+                and score < self.stages[self._level - 1].exit
+            ):
+                if self._below_since is None:
+                    self._below_since = now
+                if now - self._below_since >= self.hold_s:
+                    self._level -= 1
+                    exited = self.stages[self._level]
+                    self._below_since = None
+            else:
+                self._below_since = None
+            level = self._level
+        if entered is not None:
+            self._transition(entered, True, score, level)
+        if exited is not None:
+            self._transition(exited, False, score, level)
+
+    def _transition(self, stage: BrownoutStage, engaged: bool, score: float, level: int) -> None:
+        direction = "enter" if engaged else "exit"
+        self.m_stage.set(float(level))
+        self.m_transitions.inc((stage.name, direction))
+        flight.recorder().record_event(
+            f"brownout_{direction}",
+            stage=stage.name,
+            score=round(score, 4),
+            level=level,
+        )
+        log = _log.warning if engaged else _log.info
+        log(
+            "brownout %s: %s (pressure %.3f, %d/%d stages engaged)",
+            direction,
+            stage.name,
+            score,
+            level,
+            len(self.stages),
+        )
+        self._apply(stage.name, engaged)
+
+    def _apply(self, stage_name: str, engaged: bool) -> None:
+        fn = self._appliers.get(stage_name)
+        if fn is None:
+            return
+        try:
+            fn(engaged)
+        except Exception:  # noqa: BLE001
+            _log.exception("brownout applier for %s failed", stage_name)
+
+    def _disengage_all_locked(self) -> None:
+        while self._level > 0:
+            self._level -= 1
+            stage = self.stages[self._level]
+            self.m_transitions.inc((stage.name, "exit"))
+            self._apply(stage.name, False)
+        self.m_stage.set(0.0)
+
+    # -- reads (servers, readiness, admission) ------------------------------
+
+    def level(self) -> int:
+        return self._level
+
+    def active(self, stage_name: str) -> bool:
+        """Is the named stage currently engaged? (pull-side shed checks)"""
+        with self._lock:
+            for i in range(self._level):
+                if self.stages[i].name == stage_name:
+                    return True
+        return False
+
+    def stage_name(self) -> str:
+        """Deepest engaged stage name, or '' — the readiness provider."""
+        with self._lock:
+            return self.stages[self._level - 1].name if self._level > 0 else ""
+
+    def note_shed(self, target: str) -> None:
+        """Count one unit of shed work (an audit entry dropped, a plan
+        refused, ...) against the brownout evidence trail."""
+        self.m_shed.inc(target)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "level": self._level,
+                "stage": self.stages[self._level - 1].name if self._level > 0 else "",
+                "hold_seconds": self.hold_s,
+                "stages": [
+                    {
+                        "name": s.name,
+                        "enter": s.enter,
+                        "exit": s.exit,
+                        "engaged": i < self._level,
+                    }
+                    for i, s in enumerate(self.stages)
+                ],
+            }
+
+
+_controller = BrownoutController()
+
+
+def controller() -> BrownoutController:
+    return _controller

@@ -176,7 +176,10 @@ class Waterfall:
         the carried age (the deadline re-anchoring idiom) and book the
         unattributed remainder — encode, socket, frame decode — as
         ``transit``."""
-        age, attributed = spec
+        # index reads, not unpacking: a newer front end may append carry
+        # elements (the admission class rides as spec[2]) that this record
+        # does not consume
+        age, attributed = spec[0], spec[1]
         now = time.monotonic()
         wf = cls(t0=now - max(0.0, float(age)), trace_id=trace_id, deadline=deadline)
         wf._last = wf.t0 + min(max(0.0, float(attributed)), wf.age(now))

@@ -46,6 +46,7 @@ class Engine:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         wf: Optional[Any] = None,
+        pclass: Optional[str] = None,
     ) -> list[T.CheckOutput]:
         from ..observability import start_span
 
@@ -60,6 +61,10 @@ class Engine:
                     kwargs["deadline"] = deadline
                 if wf is not None and getattr(self.tpu_evaluator, "supports_waterfall", False):
                     kwargs["wf"] = wf
+                if pclass is not None and getattr(self.tpu_evaluator, "supports_pclass", False):
+                    # admission class rides down to the batcher's priority
+                    # lanes (queue budget + weighted scheduling)
+                    kwargs["pclass"] = pclass
                 outputs = self.tpu_evaluator.check(list(inputs), params, **kwargs)
                 if wf is not None and "wf" not in kwargs:
                     # evaluator without stage bookkeeping: the whole device
@@ -89,6 +94,7 @@ class Engine:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         wf: Optional[Any] = None,
+        pclass: Optional[str] = None,
     ) -> list[T.CheckOutput]:
         """Event-loop-native check: awaits the evaluator's reply future with
         no executor hop. Small batches below the device threshold still take
@@ -107,6 +113,8 @@ class Engine:
                 kwargs = {}
                 if wf is not None and getattr(self.tpu_evaluator, "supports_waterfall", False):
                     kwargs["wf"] = wf
+                if pclass is not None and getattr(self.tpu_evaluator, "supports_pclass", False):
+                    kwargs["pclass"] = pclass
                 outputs = await self.tpu_evaluator.check_await(
                     list(inputs), params, deadline=deadline, **kwargs
                 )

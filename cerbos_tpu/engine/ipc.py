@@ -67,6 +67,7 @@ from .. import native
 from ..observability import current_span_context, parse_traceparent
 from ..ruletable import check_input
 from . import types as T
+from .admission import OverloadRefused
 from .batcher import DeadlineExceeded, _BatchFailed
 from .budget import STAGE_IPC_ENCODE, STAGE_ORACLE, Waterfall
 from .budget import tracker as budget_tracker
@@ -735,20 +736,32 @@ class BatcherIpcServer:
                 self._out_by[transport] += 1
                 depth = self._out_by[transport]
         if full:
+            # counted ONCE per pool, in the front end that receives this ERR
+            # (RemoteBatcherClient incs its m_full on the remote-origin
+            # reason): a merged scrape across the worker pool must not see
+            # the same refusal from both sides of the socket
             self.stats["rejected_full"] += 1
-            self.m_full.inc(transport)
             writer.send(T_ERR, req_id, err("ipc_full"))
             return
         self._g_depth[transport].set(depth)
         deadline = time.monotonic() + deadline_rel if deadline_rel is not None else None
         ctx = parse_traceparent(traceparent) if traceparent else None
+        # 3rd carry element: the admission priority class (absent from
+        # pre-overload front ends; (None, None, pclass) when the waterfall
+        # is off but a class rides along)
+        pclass = None
+        if carry is not None and len(carry) > 2:
+            pclass = str(carry[2]) if carry[2] else None
+            carry = carry[:2] if carry[0] is not None else None
         # rebuild the waterfall from the carried relative spec; the
         # unattributed remainder (encode + ring/socket + decode) books as
         # transit
         wf = budget_tracker().resume(
             carry, trace_id=getattr(ctx, "trace_id", "") or "", deadline=deadline
         )
-        fut = self.batcher.check_async(inputs, deadline=deadline, ctx=ctx, wf=wf)
+        fut = self.batcher.check_async(
+            inputs, deadline=deadline, ctx=ctx, wf=wf, pclass=pclass
+        )
         self.m_enqueue.observe(worker, time.perf_counter() - t0)
 
         def settle(f: Future) -> None:
@@ -867,6 +880,7 @@ class RemoteBatcherClient:
 
     supports_deadline = True
     supports_waterfall = True
+    supports_pclass = True
 
     def __init__(
         self,
@@ -945,9 +959,11 @@ class RemoteBatcherClient:
             "times the front end (re)attached to the shared batcher, by granted transport",
             label="transport",
         )
-        # shares the server's family: a ring-full refusal surfaces here (the
-        # push fails in THIS process) while a queue-full refusal surfaces in
-        # the batcher; dashboards read one family either way
+        # shares the server's family name, but ALL full refusals are counted
+        # here: local ring-full pushes directly, and batcher queue-full
+        # refusals when their remote-origin "ipc_full" ERR lands. One
+        # decisions view per worker — a merged scrape never double-counts a
+        # refusal that crossed the socket
         self.m_full = reg.counter_vec(
             "cerbos_tpu_ipc_full_total",
             "tickets refused because the shared batcher queue or ring was full (front end served its oracle)",
@@ -1201,12 +1217,26 @@ class RemoteBatcherClient:
 
     # -- check surface ------------------------------------------------------
 
+    @staticmethod
+    def _carry_spec(
+        wf: Optional[Waterfall], pclass: Optional[str]
+    ) -> Optional[tuple]:
+        """The ticket's carry: (age, attributed) from the waterfall, plus
+        the admission priority class as an optional 3rd element. A class
+        with no waterfall ships ``(None, None, pclass)`` — the batcher reads
+        the class and resumes no budget record."""
+        carry = wf.carry() if wf is not None else None
+        if pclass:
+            return (carry[0], carry[1], pclass) if carry is not None else (None, None, pclass)
+        return carry
+
     def _encode_check(
         self,
         inputs: Sequence[T.CheckInput],
         deadline: Optional[float],
         wf: Optional[Waterfall] = None,
         transport: str = "uds",
+        pclass: Optional[str] = None,
     ) -> Optional[bytes]:
         deadline_rel = None
         if deadline is not None:
@@ -1222,7 +1252,7 @@ class RemoteBatcherClient:
                 # shrinks to the admission bookkeeping above it
                 if wf is not None:
                     wf.mark(STAGE_IPC_ENCODE)
-                carry = wf.carry() if wf is not None else None
+                carry = self._carry_spec(wf, pclass)
                 t0 = time.perf_counter_ns()
                 frame = native.get().ticket_pack(inputs, deadline_rel, traceparent, carry)
                 self.stats["enc_ns"] += time.perf_counter_ns() - t0
@@ -1236,7 +1266,7 @@ class RemoteBatcherClient:
             # never double-counts the encode
             if wf is not None:
                 wf.mark(STAGE_IPC_ENCODE)
-            carry = wf.carry() if wf is not None else None
+            carry = self._carry_spec(wf, pclass)
             frame = marshal.dumps((deadline_rel, traceparent, rows, carry))
             self.stats["enc_ns"] += time.perf_counter_ns() - t0
             self.stats["enc_frames"] += 1
@@ -1309,6 +1339,23 @@ class RemoteBatcherClient:
             return payload.decode("utf-8", "replace")
         return str(marshal.loads(payload))
 
+    def _remote_err(
+        self, reason: str, transport: str, pclass: Optional[str]
+    ) -> None:
+        """Shared handling for remote-origin ERR reasons that do NOT fall
+        back to the oracle. ``queue_budget`` is a true refusal — the lane's
+        queue budget said no — raised to the server layer, which maps it to
+        429/RESOURCE_EXHAUSTED and books ``outcome=refused`` in THIS
+        worker's decisions view. A remote ``ipc_full`` counts against the
+        shared family here (the batcher only tallies its internal
+        ``rejected_full`` stat)."""
+        if reason == "deadline":
+            raise DeadlineExceeded("request deadline expired in the shared batcher")
+        if reason == "queue_budget":
+            raise OverloadRefused(pclass or "default", "queue_budget", retry_after=0.1)
+        if reason == "ipc_full":
+            self.m_full.inc(transport)
+
     def _settle_reply(
         self,
         mtype: int,
@@ -1317,13 +1364,13 @@ class RemoteBatcherClient:
         params: Optional[T.EvalParams],
         wf: Optional[Waterfall] = None,
         transport: str = "uds",
+        pclass: Optional[str] = None,
     ) -> list[T.CheckOutput]:
         if mtype == T_RESULT:
             return self._decode_result(payload, wf, transport)
         if mtype == T_ERR:
             reason = self._err_reason(payload, transport)
-            if reason == "deadline":
-                raise DeadlineExceeded("request deadline expired in the shared batcher")
+            self._remote_err(reason, transport, pclass)
             return self._serve_oracle(inputs, params, reason, wf=wf)
         return self._serve_oracle(inputs, params, "protocol", wf=wf)
 
@@ -1333,6 +1380,7 @@ class RemoteBatcherClient:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         wf: Optional[Waterfall] = None,
+        pclass: Optional[str] = None,
     ) -> list[T.CheckOutput]:
         if deadline is not None and time.monotonic() >= deadline:
             raise DeadlineExceeded("request deadline expired before evaluation")
@@ -1343,7 +1391,7 @@ class RemoteBatcherClient:
         # renegotiate, but reconnects also fail every pending future, so a
         # reply never arrives encoded for a different transport than pinned
         tr = self._transport_active
-        payload = self._encode_check(inputs, deadline, wf=wf, transport=tr)
+        payload = self._encode_check(inputs, deadline, wf=wf, transport=tr, pclass=pclass)
         if payload is None:
             return self._serve_oracle(inputs, params, "codec", wf=wf)
         t0 = time.perf_counter()
@@ -1363,7 +1411,9 @@ class RemoteBatcherClient:
             return self._serve_oracle(inputs, params, "ipc_timeout", wf=wf)
         self._unregister(req_id)
         self.m_rtt.observe(tr, time.perf_counter() - t0)
-        return self._settle_reply(mtype, data, inputs, params, wf=wf, transport=tr)
+        return self._settle_reply(
+            mtype, data, inputs, params, wf=wf, transport=tr, pclass=pclass
+        )
 
     async def check_await(
         self,
@@ -1371,6 +1421,7 @@ class RemoteBatcherClient:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         wf: Optional[Waterfall] = None,
+        pclass: Optional[str] = None,
     ) -> list[T.CheckOutput]:
         """Event-loop-native check: awaits the reply future with zero
         thread-pool hops; only degraded-path oracle work leaves the loop."""
@@ -1387,7 +1438,7 @@ class RemoteBatcherClient:
         if not self._connected.is_set():
             return await oracle("batcher_down")
         tr = self._transport_active
-        payload = self._encode_check(inputs, deadline, wf=wf, transport=tr)
+        payload = self._encode_check(inputs, deadline, wf=wf, transport=tr, pclass=pclass)
         if payload is None:
             return await oracle("codec")
         t0 = time.perf_counter()
@@ -1413,8 +1464,7 @@ class RemoteBatcherClient:
             return self._decode_result(data, wf, tr)
         if mtype == T_ERR:
             reason = self._err_reason(data, tr)
-            if reason == "deadline":
-                raise DeadlineExceeded("request deadline expired in the shared batcher")
+            self._remote_err(reason, tr, pclass)
             return await oracle(reason)
         return await oracle("protocol")
 

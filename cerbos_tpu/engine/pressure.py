@@ -104,6 +104,9 @@ class PressureMonitor:
         self._high = False
         self._ticker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # observers fired after every sample with (score, components, now) —
+        # the brownout controller's drive shaft (engine/brownout.py)
+        self._observers: list[Callable] = []
         # signal sources; all optional, bound by bootstrap per role
         self._queue_fn: Optional[Callable] = None      # -> (depth, capacity)
         self._inflight_fn: Optional[Callable] = None   # -> (inflight, depth limit)
@@ -156,6 +159,19 @@ class PressureMonitor:
         if storms is not None:
             self._storms_fn = storms
 
+    def add_observer(self, fn: Callable) -> None:
+        """Register ``fn(score, components, now)`` to run after every
+        sample. Idempotent by identity (bound methods compare equal), so
+        repeated bootstrap wiring never double-drives an observer."""
+        if fn not in self._observers:
+            self._observers.append(fn)
+
+    def remove_observer(self, fn: Callable) -> None:
+        try:
+            self._observers.remove(fn)
+        except ValueError:
+            pass
+
     def unbind(self) -> None:
         """Drop every source and rolling window (re-initialization, tests)."""
         with self._lock:
@@ -165,6 +181,7 @@ class PressureMonitor:
             self._queue_samples.clear()
             self._counter_samples.clear()
             self._high = False
+            self._observers.clear()
 
     # -- sampling -----------------------------------------------------------
 
@@ -239,8 +256,22 @@ class PressureMonitor:
                 score=round(score, 4),
                 components=components,
             )
-        elif score < HIGH_WATER:
+        elif score < HIGH_WATER and self._high:
+            # the matching falling edge: one pressure_recovered per
+            # excursion, so forensics see the full red window, not just
+            # its start
             self._high = False
+            flight.recorder().record_event(
+                "pressure_recovered",
+                score=round(score, 4),
+                components=components,
+            )
+
+        for fn in tuple(self._observers):
+            try:
+                fn(score, components, now)
+            except Exception:  # noqa: BLE001 — observers never break sampling
+                pass
 
         return {
             "score": round(score, 4),

@@ -59,6 +59,7 @@ class ReadinessState:
         self._health: Optional[Callable[[], str]] = None
         self._remote: Optional[Callable[[], dict]] = None
         self._parity: Optional[Callable[[], list]] = None
+        self._brownout: Optional[Callable[[], str]] = None
         self.m_state.set(_STATUS_CODE["ready"])
 
     # -- transitions (driven by bootstrap / the warmup driver) -------------
@@ -101,6 +102,13 @@ class ReadinessState:
         serving — the tripped lane rides the CPU oracle, which is correct by
         definition). ``provider`` returns the storming shard ids."""
         self._parity = provider
+
+    def bind_brownout(self, provider: Optional[Callable[[], str]]) -> None:
+        """Wire the brownout controller's stage in: while any shed stage is
+        engaged the snapshot carries ``reason: "brownout"`` + the deepest
+        stage name (still serving — shedding optional work IS how the
+        service stays live). ``provider`` returns the stage name or ''."""
+        self._brownout = provider
 
     def bind_remote(self, provider: Optional[Callable[[], dict]]) -> None:
         """Front-end mode: this process has no device of its own — readiness
@@ -151,6 +159,15 @@ class ReadinessState:
         except Exception:
             return []
 
+    def _brownout_stage(self) -> str:
+        provider = getattr(self, "_brownout", None)
+        if provider is None:
+            return ""
+        try:
+            return str(provider() or "")
+        except Exception:
+            return ""
+
     def serving(self) -> bool:
         """Gate decision: warming withholds traffic; degraded is live."""
         return self.status() != "warming"
@@ -167,10 +184,18 @@ class ReadinessState:
             snap["status"] = st if st in _STATUS_CODE else "degraded"
             snap.setdefault("attached", False)
             snap["topology"] = "frontend"
+            # the front end runs its OWN brownout ladder (admission-side
+            # sheds happen here); the batcher's stage arrives inside the
+            # remote snapshot and the deeper of the two wins
+            local_stage = self._brownout_stage()
+            if local_stage and not snap.get("brownout_stage"):
+                snap["brownout_stage"] = local_stage
+                snap.setdefault("reason", "brownout")
             self.m_state.set(_STATUS_CODE[snap["status"]])
             return snap
         st = self.status()
         parity_shards = self._parity_shards()
+        brownout_stage = self._brownout_stage()
         with self._lock:
             out = {
                 "status": st,
@@ -182,6 +207,11 @@ class ReadinessState:
         if parity_shards:
             out["reason"] = "parity"
             out["parity_shards"] = parity_shards
+        if brownout_stage:
+            # parity keeps the reason slot if both fire (it signals possible
+            # wrong answers; brownout only signals shed work)
+            out.setdefault("reason", "brownout")
+            out["brownout_stage"] = brownout_stage
         return out
 
 

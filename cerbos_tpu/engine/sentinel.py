@@ -263,6 +263,9 @@ class ParitySentinel:
         self._wakeup = threading.Condition(self._lock)
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # brownout shed flag (engine/brownout.py shed_parity): sampling
+        # pauses while set, the worker and backlog stay intact
+        self._shed = False
         self.stats = {
             "seen": 0,
             "sampled": 0,
@@ -348,6 +351,16 @@ class ParitySentinel:
         if t is not None:
             t.join(timeout=5)
 
+    def set_shed(self, flag: bool) -> None:
+        """Brownout applier (stage ``shed_parity``): pause shadow sampling
+        while engaged — the CPU oracle's cycles go to degraded-path traffic
+        instead of replays. Fully reversible: the exported sample-rate gauge
+        reads 0 while shed and restores the configured rate on release."""
+        self._shed = bool(flag)
+        self.m_rate.set(
+            0.0 if self._shed or not self.enabled else self.sample_rate
+        )
+
     # -- hot path (batcher drain thread) ------------------------------------
 
     def should_sample(self, shard: int) -> bool:
@@ -355,7 +368,7 @@ class ParitySentinel:
         ``acc += rate`` per completed batch, sample when it crosses 1.0. No
         RNG — the sampled sequence is a pure function of the batch count, so
         tests and incident replays see identical pick patterns."""
-        if not self.enabled:
+        if not self.enabled or self._shed:
             return False
         with self._lock:
             st = self._lanes.setdefault(shard, _LaneState())
@@ -583,6 +596,7 @@ class ParitySentinel:
         return {
             "enabled": self.enabled,
             "sample_rate": self.sample_rate,
+            "shed": self._shed,
             "window_sec": self.window_sec,
             "storm_threshold": self.storm_threshold,
             "checks": stats["checks"],

@@ -54,6 +54,7 @@ class ShardedBatchingEvaluator:
 
     supports_deadline = True
     supports_waterfall = True
+    supports_pclass = True
 
     def __init__(
         self,
@@ -126,8 +127,11 @@ class ShardedBatchingEvaluator:
         params: Optional[T.EvalParams] = None,
         deadline: Optional[float] = None,
         wf: Optional[Any] = None,
+        pclass: Optional[str] = None,
     ) -> list[T.CheckOutput]:
-        return self.route(inputs).check(inputs, params, deadline=deadline, wf=wf)
+        return self.route(inputs).check(
+            inputs, params, deadline=deadline, wf=wf, pclass=pclass
+        )
 
     def check_async(
         self,
@@ -136,10 +140,26 @@ class ShardedBatchingEvaluator:
         deadline: Optional[float] = None,
         ctx: Optional[SpanContext] = None,
         wf: Optional[Any] = None,
+        pclass: Optional[str] = None,
     ) -> Future:
         return self.route(inputs).check_async(
-            inputs, params, deadline=deadline, ctx=ctx, wf=wf
+            inputs, params, deadline=deadline, ctx=ctx, wf=wf, pclass=pclass
         )
+
+    def configure_lanes(self, lane_confs: Sequence[tuple]) -> None:
+        """Install the admission controller's priority-lane layout on every
+        shard lane: each shard schedules its own queue, but the class →
+        (priority, weight, budget) map is pool-wide."""
+        for lane in self.shards:
+            lane.configure_lanes(lane_confs)
+
+    def lane_depths(self) -> dict:
+        """Pool-wide queued depth per priority lane (debug/overload view)."""
+        out: dict = {}
+        for lane in self.shards:
+            for name, depth in lane.lane_depths().items():
+                out[name] = out.get(name, 0) + depth
+        return out
 
     def close(self) -> None:
         for lane in self.shards:

@@ -22,7 +22,10 @@ from typing import Any, Optional
 import grpc
 from aiohttp import web
 
+from ..engine import brownout as brownout_ctl
 from ..engine import types as T
+from ..engine.admission import OverloadRefused, retry_after_header
+from ..engine.admission import controller as admission_controller
 from ..engine.batcher import DeadlineExceeded
 from ..engine.budget import (
     OUTCOME_EXPIRED,
@@ -288,16 +291,32 @@ def _grpc_rpcs(svc: CerbosService):
         # waterfall starts when the request BYTES arrived, so protobuf
         # decode cost is a visible stage instead of unattributed time
         stamp = _GRPC_STAMPS.pop(id(req))
+        t_raw = stamp[0] if stamp is not None else time.monotonic()
         verr = wire_validate.check_resources_proto(req)
         if verr:
             budget_tracker().count(OUTCOME_REFUSED)
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, verr)
         wf = None
+        ticket = None
+        pclass = None
         try:
             aux = None
             if req.HasField("aux_data") and req.aux_data.jwt.token:
                 aux = svc._extract_aux_data(req.aux_data.jwt.token, req.aux_data.jwt.key_set_id)
             inputs = convert.check_resources_request_to_inputs(req, aux)
+            # front-door admission (see the HTTP handler): refuse with
+            # RESOURCE_EXHAUSTED before the batcher sees the request
+            adm = admission_controller()
+            if adm.enabled:
+                first = inputs[0] if inputs else None
+                cls = adm.classify(
+                    first.principal.id if first is not None else "",
+                    first.principal.roles if first is not None else (),
+                    [i.resource.kind for i in inputs],
+                    api="check",
+                )
+                pclass = cls.name
+                ticket = adm.try_admit(cls)
             # propagate the client's gRPC deadline down the device path so
             # already-expired requests are dropped instead of evaluated
             deadline = None
@@ -317,7 +336,7 @@ def _grpc_rpcs(svc: CerbosService):
                 dict(meta_fn() or ()).get("traceparent") if meta_fn is not None else None
             )
             outputs, call_id = svc.check_resources(
-                inputs, deadline=deadline, trace_ctx=trace_ctx, wf=wf
+                inputs, deadline=deadline, trace_ctx=trace_ctx, wf=wf, pclass=pclass
             )
             if trace_ctx is not None:
                 with contextlib.suppress(Exception):  # shim contexts may lack it
@@ -326,6 +345,10 @@ def _grpc_rpcs(svc: CerbosService):
             outcome = OUTCOME_ORACLE if wf is not None and wf.served_by == "oracle" else OUTCOME_MET
             budget_tracker().finish(wf, outcome, final_stage=STAGE_REPLY_ENCODE)
             return resp
+        except OverloadRefused as e:
+            admission_controller().observe_refusal(time.monotonic() - t_raw)
+            budget_tracker().finish(wf, OUTCOME_REFUSED)
+            ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except RequestLimitExceeded as e:
             budget_tracker().finish(wf, OUTCOME_REFUSED)
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -334,8 +357,19 @@ def _grpc_rpcs(svc: CerbosService):
             ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:  # noqa: BLE001
             ctx.abort(grpc.StatusCode.INTERNAL, f"check failed: {e}")
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     def plan_resources(req: request_pb2.PlanResourcesRequest, ctx: grpc.ServicerContext):
+        if brownout_ctl.controller().active("shed_plan"):
+            # staged brownout: plan queries yield to interactive checks
+            brownout_ctl.controller().note_shed("plan")
+            budget_tracker().count(OUTCOME_REFUSED)
+            ctx.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "overloaded: plan queries are shed (brownout)",
+            )
         verr = wire_validate.plan_resources_proto(req)
         if verr:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, verr)
@@ -696,6 +730,7 @@ class Server:
         app.router.add_get("/_cerbos/debug/slow", self._h_slow)
         app.router.add_get("/_cerbos/debug/pressure", self._h_pressure)
         app.router.add_get("/_cerbos/debug/transport", self._h_transport)
+        app.router.add_get("/_cerbos/debug/overload", self._h_overload)
         app.router.add_get("/_cerbos/debug/profile", self._h_profile)
         app.router.add_get("/api/server_info", self._h_server_info)
         # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
@@ -846,6 +881,22 @@ class Server:
                 pass
         return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
 
+    async def _h_overload(self, request: web.Request) -> web.Response:
+        """Overload-control state for THIS process: the compiled admission
+        classes with live token/inflight state, and the brownout ladder with
+        per-stage thresholds and engagement. The operator's first stop when
+        429s appear — it answers 'which class, which stage, and why'."""
+        body = {
+            "admission": admission_controller().snapshot(),
+            "brownout": brownout_ctl.controller().snapshot(),
+        }
+        ev = getattr(self.svc.engine, "tpu_evaluator", None)
+        lane_depths = getattr(ev, "lane_depths", None)
+        if callable(lane_depths):
+            with contextlib.suppress(Exception):
+                body["lanes"] = lane_depths()
+        return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+
     async def _h_transport(self, request: web.Request) -> web.Response:
         """Ticket-queue data-plane stats for THIS front end: the active
         plane (shm ring / uds socket), requested vs granted transport, frame
@@ -968,26 +1019,47 @@ class Server:
         wf = budget_tracker().start(t0=t_raw)
         if wf is not None:
             wf.mark(STAGE_INGRESS_PARSE)
+        ticket = None
+        pclass = None
         try:
             aux = None
             aux_j = (body.get("auxData") or {}).get("jwt") or {}
             if aux_j.get("token"):
                 aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
             inputs, request_id, include_meta = convert.json_to_check_inputs(body, aux)
+            # front-door admission: classify and gate BEFORE any dispatch —
+            # a refusal costs parse + one bucket update and never reaches
+            # the batcher, the ticket ring, or a device batch
+            adm = admission_controller()
+            if adm.enabled:
+                first = inputs[0] if inputs else None
+                cls = adm.classify(
+                    first.principal.id if first is not None else "",
+                    first.principal.roles if first is not None else (),
+                    [i.resource.kind for i in inputs],
+                    api="check",
+                )
+                pclass = cls.name
+                ticket = adm.try_admit(cls)
             trace_ctx = parse_traceparent(request.headers.get("traceparent"))
             if getattr(self.svc.engine, "supports_async", False):
                 # front-end mode: the evaluator settles on this event loop
                 # (RemoteBatcherClient futures) — awaiting directly skips the
                 # per-request thread-pool hop entirely
                 outputs, call_id = await self.svc.check_resources_async(
-                    inputs, trace_ctx=trace_ctx, wf=wf
+                    inputs, trace_ctx=trace_ctx, wf=wf, pclass=pclass
                 )
             elif self.config.direct_dispatch:
-                outputs, call_id = self.svc.check_resources(inputs, trace_ctx=trace_ctx, wf=wf)
+                outputs, call_id = self.svc.check_resources(
+                    inputs, trace_ctx=trace_ctx, wf=wf, pclass=pclass
+                )
             else:
                 loop = asyncio.get_running_loop()
                 outputs, call_id = await loop.run_in_executor(
-                    None, lambda: self.svc.check_resources(inputs, trace_ctx=trace_ctx, wf=wf)
+                    None,
+                    lambda: self.svc.check_resources(
+                        inputs, trace_ctx=trace_ctx, wf=wf, pclass=pclass
+                    ),
                 )
             resp = web.Response(
                 body=fastjson.dumps(
@@ -1001,6 +1073,16 @@ class Server:
             outcome = OUTCOME_ORACLE if wf is not None and wf.served_by == "oracle" else OUTCOME_MET
             budget_tracker().finish(wf, outcome, final_stage=STAGE_REPLY_ENCODE)
             return resp
+        except OverloadRefused as e:
+            # 429 + Retry-After, counted as a refused decision in THIS
+            # worker; refusal latency is the ingress-to-refusal wall time
+            admission_controller().observe_refusal(time.monotonic() - t_raw)
+            budget_tracker().finish(wf, OUTCOME_REFUSED)
+            return web.json_response(
+                {"code": 8, "message": str(e)},
+                status=429,
+                headers={"Retry-After": retry_after_header(e)},
+            )
         except RequestLimitExceeded as e:
             budget_tracker().finish(wf, OUTCOME_REFUSED)
             return web.json_response({"code": 3, "message": str(e)}, status=400)
@@ -1009,6 +1091,9 @@ class Server:
             return web.json_response({"code": 4, "message": str(e)}, status=504)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"code": 13, "message": f"check failed: {e}"}, status=500)
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     async def _h_check_resource_set(self, request: web.Request) -> web.Response:
         """Deprecated CheckResourceSet: one resource kind, instance map."""
@@ -1119,6 +1204,16 @@ class Server:
             return web.json_response({"code": 13, "message": f"check failed: {e}"}, status=500)
 
     async def _h_plan_resources(self, request: web.Request) -> web.Response:
+        if brownout_ctl.controller().active("shed_plan"):
+            # staged brownout: analytical plan traffic yields to interactive
+            # checks while the ladder is at shed_plan or deeper
+            brownout_ctl.controller().note_shed("plan")
+            budget_tracker().count(OUTCOME_REFUSED)
+            return web.json_response(
+                {"code": 8, "message": "overloaded: plan queries are shed (brownout)"},
+                status=429,
+                headers={"Retry-After": "1"},
+            )
         try:
             body = await request.json()
         except json.JSONDecodeError:

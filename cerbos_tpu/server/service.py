@@ -92,6 +92,7 @@ class CerbosService:
         deadline: Optional[float] = None,
         trace_ctx: Optional[SpanContext] = None,
         wf: Optional[Any] = None,
+        pclass: Optional[str] = None,
     ) -> tuple[list[T.CheckOutput], str]:
         self._validate_check(inputs)
         call_id = uuid.uuid4().hex
@@ -107,7 +108,9 @@ class CerbosService:
             T.set_current_shard(None)
             if wf is not None and not wf.trace_id:
                 wf.trace_id = span.context.trace_id
-            outputs = self.engine.check(inputs, params=params, deadline=deadline, wf=wf)
+            outputs = self.engine.check(
+                inputs, params=params, deadline=deadline, wf=wf, pclass=pclass
+            )
             trace_id = span.context.trace_id
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
@@ -136,6 +139,7 @@ class CerbosService:
         deadline: Optional[float] = None,
         trace_ctx: Optional[SpanContext] = None,
         wf: Optional[Any] = None,
+        pclass: Optional[str] = None,
     ) -> tuple[list[T.CheckOutput], str]:
         """``check_resources`` for evaluators that settle on the event loop
         (front-end mode): the handler coroutine awaits the batcher ticket
@@ -151,7 +155,7 @@ class CerbosService:
             if wf is not None and not wf.trace_id:
                 wf.trace_id = span.context.trace_id
             outputs = await self.engine.check_await(
-                inputs, params=params, deadline=deadline, wf=wf
+                inputs, params=params, deadline=deadline, wf=wf, pclass=pclass
             )
             trace_id = span.context.trace_id
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))

@@ -99,6 +99,30 @@ def _make_bodies(n_mods: int, n: int = 512, unique: bool = False) -> list[bytes]
     return bodies
 
 
+_GOLD_ROLE = "loadtest:gold"
+
+
+def _parse_priority_mix(spec: str) -> tuple[int, int]:
+    """``a:b`` → (gold_parts, default_parts); empty spec = no mix."""
+    if not spec:
+        return (0, 1)
+    a, _, b = spec.partition(":")
+    return (max(0, int(a)), max(1, int(b or "1")))
+
+
+def _tag_gold(body: bytes) -> bytes:
+    """Append the gold marker role to a request body's principal. The role
+    matches no rule in the corpus (rule tables name employee/manager/admin/
+    user and derived-role parents), so admission sees the class marker while
+    the decision is byte-identical to the untagged request."""
+    d = json.loads(body)
+    roles = list(d.get("principal", {}).get("roles") or [])
+    if _GOLD_ROLE not in roles:
+        roles.append(_GOLD_ROLE)
+    d.setdefault("principal", {})["roles"] = roles
+    return json.dumps(d).encode()
+
+
 def spawn_server(
     policy_dir: str,
     workers: int,
@@ -106,6 +130,7 @@ def spawn_server(
     frontends: int = 0,
     shards: int = 0,
     budget: bool = True,
+    overload: dict | None = None,
 ) -> tuple[subprocess.Popen, int, int]:
     import base64
 
@@ -121,9 +146,16 @@ def spawn_server(
         tpu_cfg["latencyBudget"] = {"enabled": False}
         tpu_cfg["pressure"] = {"enabled": False}
     cfg_path = os.path.join(policy_dir, ".cerbos.yaml")
+    doc: dict = {}
+    if overload:
+        # front-door admission + priority lanes for the overload drill
+        # (engine/admission.py); absent, the server runs with admission
+        # disabled and a single default lane
+        doc["overload"] = overload
     with open(cfg_path, "w") as f:
         yaml.safe_dump(
             {
+                **doc,
                 "server": {
                     "httpListenAddr": "127.0.0.1:0",
                     "grpcListenAddr": "127.0.0.1:0",
@@ -431,6 +463,65 @@ def _pressure_block(text: str) -> dict:
     return {"score": score, "components": components}
 
 
+def _admission_block(text: str) -> dict:
+    """Fold the front-door admission + brownout series: per-class decision
+    counts by outcome, server-side refusal p99 (the <5 ms acceptance bar),
+    queue-budget refusals from the batcher lanes, and the brownout stage at
+    scrape time. Workers merge by summing; the stage gauge takes the max."""
+    by_class: dict[str, dict[str, float]] = {}
+    queue_budget: dict[str, float] = {}
+    shed: dict[str, float] = {}
+    ref_count = 0.0
+    ref_buckets: dict[float, float] = {}
+    stage = 0.0
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        if series.startswith("cerbos_tpu_admission_total"):
+            at = series.find('pclass="')
+            ot = series.find('outcome="')
+            if at < 0 or ot < 0:
+                continue
+            pclass = series[at + 8 : series.index('"', at + 8)]
+            outcome = series[ot + 9 : series.index('"', ot + 9)]
+            d = by_class.setdefault(pclass, {})
+            d[outcome] = d.get(outcome, 0.0) + v
+        elif series.startswith("cerbos_tpu_admission_refusal_seconds_count"):
+            ref_count += v
+        elif series.startswith("cerbos_tpu_admission_refusal_seconds_bucket"):
+            at = series.find('le="')
+            if at >= 0:
+                le = series[at + 4 : series.index('"', at + 4)]
+                b = float("inf") if le == "+Inf" else float(le)
+                ref_buckets[b] = ref_buckets.get(b, 0.0) + v
+        elif series.startswith("cerbos_tpu_admission_queue_budget_total"):
+            at = series.find('pclass="')
+            if at >= 0:
+                pclass = series[at + 8 : series.index('"', at + 8)]
+                queue_budget[pclass] = queue_budget.get(pclass, 0.0) + v
+        elif series.startswith("cerbos_tpu_brownout_stage"):
+            stage = max(stage, v)
+        elif series.startswith("cerbos_tpu_brownout_shed_total"):
+            at = series.find('target="')
+            if at >= 0:
+                target = series[at + 8 : series.index('"', at + 8)]
+                shed[target] = shed.get(target, 0.0) + v
+    return {
+        "by_class": {
+            k: {o: int(n) for o, n in sorted(d.items())} for k, d in sorted(by_class.items())
+        },
+        "refusal_p99_ms": round(_bucket_p99(ref_buckets, ref_count) * 1000, 3),
+        "queue_budget_refusals": {k: int(v) for k, v in sorted(queue_budget.items())},
+        "brownout_stage": int(stage),
+        "brownout_shed": {k: int(v) for k, v in sorted(shed.items())},
+    }
+
+
 def _fetch_transport(http_port: int) -> dict:
     """GET /_cerbos/debug/transport: the answering front end's data-plane
     stats (transport=local when there is no ticket queue)."""
@@ -484,11 +575,33 @@ def _transport_block(text: str, http_port: int, elapsed: float) -> dict:
     return block
 
 
-def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0, budget: bool = True) -> dict:
+def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0, budget: bool = True, rate: float = 0.0, priority_mix: str = "", admit_rate: float = 0.0) -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
+    gold_parts, default_parts = _parse_priority_mix(priority_mix)
+    overload_conf: dict | None = None
+    if admit_rate or gold_parts:
+        # overload drill config: a protected gold class (priority 0, heavier
+        # WRR weight) over a capped default class — the shape the ROBUSTNESS
+        # doc's 3x-saturation drill uses
+        overload_conf = {"enabled": True, "classes": []}
+        if gold_parts:
+            overload_conf["classes"].append(
+                {
+                    "name": "gold",
+                    "priority": 0,
+                    "weight": 4,
+                    "match": {"roles": [_GOLD_ROLE]},
+                }
+            )
+        if admit_rate:
+            overload_conf["default"] = {
+                "rate": float(admit_rate),
+                "burst": float(max(1.0, admit_rate)),
+            }
     proc, http_port, grpc_port = spawn_server(
-        tmp, workers, use_tpu, frontends=frontends, shards=shards, budget=budget
+        tmp, workers, use_tpu, frontends=frontends, shards=shards, budget=budget,
+        overload=overload_conf,
     )
     # --cold: a large pool of per-request-unique bodies (unique attr values
     # and principal ids) so the server's value/shape/assembly memos miss;
@@ -513,25 +626,63 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     latencies: list[float] = []
     counts = [0] * connections
     errors = [0] * connections
+    refused = [0] * connections
     stop = threading.Event()
     lock = threading.Lock()
+    lat_by_class: dict[str, list[float]] = {"gold": [], "default": []}
+    sched_lag_ms = [0.0] * connections
+
+    # request list tagged with its priority class: slot i is gold when
+    # i mod (a+b) < a for --priority-mix a:b (deterministic, so the offered
+    # mix is exact over any window that covers the cycle)
+    cycle = gold_parts + default_parts
+    tagged: list[tuple[bytes, str]] = []
+    for j, body in enumerate(bodies):
+        if gold_parts and (j % cycle) < gold_parts:
+            tagged.append((_http_request_bytes([_tag_gold(body)])[0], "gold"))
+        else:
+            tagged.append((_http_request_bytes([body])[0], "default"))
+
+    import itertools
+
+    slots = itertools.count()  # shared open-loop arrival counter (GIL-atomic)
+
+    def _record(resp: bytes, wid: int, cls: str, lat_ms: float, local: dict) -> None:
+        head = resp[:16]
+        if b" 200 " in head:
+            local[cls].append(lat_ms)
+        elif b" 429 " in head:
+            refused[wid] += 1  # admission refusal, not an error
+        else:
+            errors[wid] += 1
 
     def http_worker(wid: int) -> None:
-        reqs = _http_request_bytes(bodies)
-        local_lat = []
+        local: dict[str, list[float]] = {"gold": [], "default": []}
         n = 0
         try:
             sock = socket.create_connection(("127.0.0.1", http_port))
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             buf = bytearray()
             while not stop.is_set():
-                req = reqs[(wid + n) % len(reqs)]
+                if rate > 0:
+                    # open loop: slot i fires at t_start + i/rate no matter
+                    # how the previous request fared — offered load does not
+                    # slow down when the server does (no coordinated omission)
+                    i = next(slots)
+                    t_fire = t_start + i / rate
+                    delay = t_fire - time.perf_counter()
+                    if delay > 0 and stop.wait(delay):
+                        break
+                    sched_lag_ms[wid] = max(
+                        sched_lag_ms[wid], (time.perf_counter() - t_fire) * 1000
+                    )
+                    req, cls = tagged[i % len(tagged)]
+                else:
+                    req, cls = tagged[(wid + n) % len(tagged)]
                 t0 = time.perf_counter()
                 sock.sendall(req)
                 resp = _read_http_response(sock, buf)
-                local_lat.append((time.perf_counter() - t0) * 1000)
-                if b" 200 " not in resp[:16]:
-                    errors[wid] += 1
+                _record(resp, wid, cls, (time.perf_counter() - t0) * 1000, local)
                 n += 1
             sock.close()
         except Exception as e:  # noqa: BLE001  (a dead worker must not vanish silently)
@@ -539,7 +690,10 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
             print(f"http worker {wid} died after {n} requests: {e}", file=sys.stderr)
         counts[wid] = n
         with lock:
-            latencies.extend(local_lat)
+            for cls, vals in local.items():
+                lat_by_class[cls].extend(vals)
+            latencies.extend(local["gold"])
+            latencies.extend(local["default"])
 
     def grpc_worker(wid: int) -> None:
         import grpc
@@ -590,6 +744,7 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     waterfall = _waterfall_block(metrics_text)
     goodput = _goodput_block(metrics_text, elapsed)
     pressure = _pressure_block(metrics_text)
+    admission = _admission_block(metrics_text)
     ipc_transport = _transport_block(metrics_text, http_port, elapsed)
     proc.terminate()
     try:
@@ -603,6 +758,22 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     def pct(p: float) -> float:
         return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
 
+    def cls_pcts(vals: list[float]) -> dict:
+        v = sorted(vals)
+
+        def cp(p: float) -> float:
+            return v[min(len(v) - 1, int(p * len(v)))] if v else 0.0
+
+        return {
+            "count": len(v),
+            "p50_ms": round(cp(0.50), 2),
+            "p95_ms": round(cp(0.95), 2),
+            "p99_ms": round(cp(0.99), 2),
+        }
+
+    accepted = len(latencies)
+    refused_total = sum(refused)
+    offered = total
     return {
         "transport": "grpc" if use_grpc else "http",
         "requests": total,
@@ -642,6 +813,31 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         "goodput": goodput,
         # saturation pressure at scrape time (engine/pressure.py)
         "pressure": pressure,
+        # overload drill accounting: offered load (requests the client put on
+        # the wire) vs what the server accepted (200) vs refused early (429
+        # from admission / queue budgets / brownout). In open-loop mode the
+        # offered rate is the --rate schedule; closed-loop it is whatever the
+        # connections sustained. The admission sub-block folds the server's
+        # cerbos_tpu_admission_* / brownout series for the same window.
+        "offered_vs_accepted": {
+            "mode": "open-loop" if rate > 0 else "closed-loop",
+            "target_rate": rate,
+            "priority_mix": priority_mix,
+            "offered": offered,
+            "accepted": accepted,
+            "refused": refused_total,
+            "errors": sum(errors),
+            "offered_per_sec": round(offered / elapsed, 1) if elapsed else 0.0,
+            "accepted_per_sec": round(accepted / elapsed, 1) if elapsed else 0.0,
+            "refused_frac": round(refused_total / offered, 4) if offered else 0.0,
+            "max_sched_lag_ms": round(max(sched_lag_ms), 2) if sched_lag_ms else 0.0,
+            "admission": admission,
+        },
+        # accepted-request latency split by priority class (gold carries the
+        # top-priority p99 <= 1.5x-unloaded acceptance figure)
+        "latency_by_class": {
+            cls: cls_pcts(vals) for cls, vals in lat_by_class.items() if vals
+        },
         # ticket-queue data plane (engine/ipc.py): negotiated transport
         # (shm frame rings vs uds marshal), frames/s, codec ns/frame,
         # ring-full sheds — transport=local outside the front-door topology
@@ -673,6 +869,30 @@ def main() -> None:
     )
     ap.add_argument("--cold", action="store_true", help="per-request-unique bodies (memo-cold)")
     ap.add_argument(
+        "--rate",
+        type=float,
+        default=0.0,
+        help="open-loop offered load in req/s across all connections (slot i "
+        "fires at start + i/rate regardless of server latency); 0 = the "
+        "classic closed loop. HTTP only.",
+    )
+    ap.add_argument(
+        "--priority-mix",
+        default="",
+        metavar="A:B",
+        help="tag A of every A+B requests with the gold priority-class role "
+        "and declare the matching overload class on the server under test "
+        "(e.g. 1:4 = 20%% gold)",
+    )
+    ap.add_argument(
+        "--admit-rate",
+        type=float,
+        default=0.0,
+        help="server-side admission token-bucket rate (req/s) for the default "
+        "class; 0 = uncapped. Combine with --rate above this cap for the "
+        "overload drill.",
+    )
+    ap.add_argument(
         "--no-budget",
         action="store_true",
         help="disable the latency-budget waterfall + pressure monitor in the "
@@ -691,10 +911,13 @@ def main() -> None:
         # this the pool crash-loops and the readiness poll times out
         print("--frontends implies the TPU engine path; enabling --tpu", file=sys.stderr)
         args.tpu = True
+    if args.grpc and (args.rate or args.priority_mix):
+        ap.error("--rate / --priority-mix drive the raw-socket HTTP path; drop --grpc")
     result = run(
         args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers,
         cold=args.cold, frontends=args.frontends, shards=args.shards,
         budget=not args.no_budget,
+        rate=args.rate, priority_mix=args.priority_mix, admit_rate=args.admit_rate,
     )
     print(json.dumps(result))
     if args.json:

@@ -547,11 +547,13 @@ class TestWatchdogAndShutdown:
         assert fallbacks.get("shutdown") >= 1
 
     def test_queue_is_a_deque(self):
-        """Satellite perf nit: O(1) popleft instead of list.pop(0)."""
+        """Satellite perf nit: O(1) popleft instead of list.pop(0) — the
+        priority lanes keep one deque per lane."""
         rt = table()
         batcher = BatchingEvaluator(OracleEvaluator(rt))
         try:
-            assert isinstance(batcher._queue, deque)
+            assert all(isinstance(lane.q, deque) for lane in batcher._queue._order)
+            assert batcher._queue.depths() == {}
         finally:
             batcher.close()
 

@@ -139,6 +139,13 @@ class TestChartStatic:
             assert tpu["pressure"][knob] == want["pressure"][knob], knob
         for knob in ("socketPath", "transport", "ringKiB", "requestTimeoutMs", "maxOutstanding"):
             assert tpu["sharedBatcher"][knob] == want["sharedBatcher"][knob], knob
+        # overload control block (docs/ROBUSTNESS.md, "Overload & brownout")
+        overload = values["cerbos"]["config"]["overload"]
+        want_ov = DEFAULTS["overload"]
+        assert overload["enabled"] == want_ov["enabled"]
+        assert overload["classes"] == want_ov["classes"]
+        for knob in ("enabled", "hysteresis", "holdSeconds", "stages"):
+            assert overload["brownout"][knob] == want_ov["brownout"][knob], knob
 
     def test_readiness_probe_split_from_liveness(self):
         # a cold replica must not take traffic until warmup has compiled the
@@ -196,6 +203,14 @@ class TestChartStatic:
             "cerbos_tpu_ipc_full_total",
             "cerbos_tpu_ipc_frame_bytes_bucket",
             "cerbos_tpu_ipc_client_rtt_seconds_bucket",
+            # overload row (admission + brownout)
+            "cerbos_tpu_admission_total",
+            "cerbos_tpu_admission_inflight",
+            "cerbos_tpu_admission_refusal_seconds_bucket",
+            "cerbos_tpu_admission_queue_budget_total",
+            "cerbos_tpu_brownout_stage",
+            "cerbos_tpu_brownout_shed_total",
+            "cerbos_tpu_brownout_transitions_total",
         ):
             assert needle in joined, needle
 
