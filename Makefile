@@ -8,8 +8,11 @@ PYTEST_FLAGS ?= -q -p no:cacheprovider
 
 TRANSPORT_TESTS := tests/test_shm_transport.py tests/test_ipc.py tests/test_latency_budget.py
 OVERLOAD_TESTS := tests/test_overload.py
+# the native-touching suites: codec round-trips, frame rings, truncation fuzz
+ASAN_TESTS := tests/test_native.py tests/test_shm_transport.py
 
-.PHONY: all native clean test test-transport test-overload
+.PHONY: all native native-asan clean test test-transport test-overload \
+	test-native-asan lint
 
 all: native
 
@@ -36,3 +39,24 @@ test-transport: native
 test-overload: native
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(OVERLOAD_TESTS) $(PYTEST_FLAGS)
 	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(OVERLOAD_TESTS) $(PYTEST_FLAGS)
+
+# ASan/UBSan leg: rebuild the native module instrumented, run the suites
+# that exercise the C++ codec/ring paths (incl. the truncation fuzzers),
+# then drop the instrumented .so so ordinary runs don't need the preload.
+# python itself isn't ASan-built, so libasan must be preloaded; interpreter-
+# level allocations are out of scope, hence detect_leaks=0.
+ASAN_LIB := $(shell gcc -print-file-name=libasan.so)
+
+native-asan:
+	$(MAKE) -C native asan PYTHON=$(PYTHON)
+
+test-native-asan: native-asan
+	JAX_PLATFORMS=cpu LD_PRELOAD=$(ASAN_LIB) \
+		ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+		$(PYTHON) -m pytest $(ASAN_TESTS) $(PYTEST_FLAGS)
+	$(MAKE) -C native clean
+
+# repo-wide static hygiene (satellite of the analyzer PR): ruff config
+# lives in pyproject.toml so editors and CI agree on one rule set.
+lint:
+	ruff check .

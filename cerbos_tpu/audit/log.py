@@ -11,7 +11,6 @@ from __future__ import annotations
 import datetime
 import queue
 import threading
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 

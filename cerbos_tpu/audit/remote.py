@@ -16,7 +16,6 @@ import threading
 import urllib.error
 import urllib.request
 from collections import deque
-from typing import Optional
 
 from .log import register_backend
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import base64
 import json
 import os
-from typing import Any, Optional
+from typing import Any
 
 _core = None
 

@@ -504,6 +504,32 @@ def initialize(
 
     manager.on_swap = with_planner
 
+    # static policy analysis: published at boot and republished on every
+    # swap so cerbos_tpu_policy_analysis_total and /_cerbos/debug/analysis
+    # always describe the table currently serving. The device-owning roles
+    # reuse the evaluator's lowering (already refreshed by its swap hook,
+    # chained above); other roles lower an audit copy.
+    from .tpu import analyze as _analyze
+
+    engine_globals = dict(engine_conf.get("globals", {}) or {})
+
+    def publish_analysis(rt) -> None:
+        try:
+            lowered = tpu_evaluator.lowered if tpu_evaluator is not None else None
+            _analyze.publish(_analyze.analyze_table(rt, engine_globals, lowered=lowered))
+        except Exception:
+            _log.exception("policy analysis failed; keeping previous report")
+
+    publish_analysis(manager.rule_table)
+    _prev_analysis = manager.on_swap
+
+    def with_analysis(rt) -> None:
+        if _prev_analysis is not None:
+            _prev_analysis(rt)
+        publish_analysis(rt)
+
+    manager.on_swap = with_analysis
+
     service = CerbosService(
         engine,
         aux_data_mgr=aux_mgr,

@@ -35,10 +35,9 @@ import hashlib
 import hmac
 import io
 import json
-import os
 import tarfile
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import yaml

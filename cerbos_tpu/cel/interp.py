@@ -20,7 +20,6 @@ from .values import (
     Duration,
     Timestamp,
     UInt,
-    celtype_name,
     check_int,
     check_uint,
     compare,

@@ -551,3 +551,35 @@ def _rewrite_namespaced(node: Node) -> Node:
 def parse(src: str) -> Node:
     """Parse a CEL expression into an AST."""
     return _rewrite_namespaced(_Parser(src).parse())
+
+
+def token_offset(
+    src: str, anchor: str, nth: int = 0, kinds: Optional[tuple[str, ...]] = None
+) -> int:
+    """Character offset of the ``nth`` token whose text equals ``anchor``.
+
+    The static analyzer (tpu/analyze.py) anchors findings to real token
+    positions in a condition's original source instead of substring matches,
+    so an operator name inside a string literal never misleads a report:
+    by default STRING/BYTES tokens are skipped; pass ``kinds=("STRING",)``
+    to anchor on a string literal instead. Returns -1 when the anchor is
+    absent or the source does not tokenize.
+    """
+    try:
+        toks = _tokenize(src)
+    except CelParseError:
+        return -1
+    seen = 0
+    for t in toks:
+        if t.kind == "EOF":
+            break
+        if kinds is None:
+            if t.kind in ("STRING", "BYTES"):
+                continue
+        elif t.kind not in kinds:
+            continue
+        if str(t.value) == anchor:
+            if seen == nth:
+                return t.pos
+            seen += 1
+    return -1

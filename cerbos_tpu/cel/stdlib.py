@@ -16,7 +16,7 @@ import math as _math
 import re as _re
 from typing import Any, Callable
 
-from .errors import CelError, no_such_key, no_such_overload
+from .errors import CelError, no_such_overload
 from .values import (
     CelType,
     Duration,
@@ -27,7 +27,6 @@ from .values import (
     check_uint,
     compare,
     is_number,
-    keys_equal,
     values_equal,
 )
 

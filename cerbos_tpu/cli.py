@@ -283,6 +283,14 @@ def cmd_compilestore(args: argparse.Namespace) -> int:
         f"{manifest.schema_count} schemas, checksum {manifest.checksum[:16]}…",
         file=sys.stderr,
     )
+    # build-time static analysis summary: the same verdicts the PDP exports
+    # as cerbos_tpu_policy_analysis_total after swapping this bundle in
+    try:
+        from .tpu.analyze import analyze_policies
+
+        print(analyze_policies(store.get_all()).summary_line(), file=sys.stderr)
+    except Exception as e:  # analysis is advisory; never fail the build
+        print(f"policy analysis skipped: {e}", file=sys.stderr)
     return 0
 
 

@@ -309,6 +309,58 @@ def _replay_divergences(args, client) -> int:
     return 0 if reproduced == total else 1
 
 
+def _load_policies_arg(path: str) -> list:
+    """Policy set from a YAML file, a policy directory, or a .crbp bundle."""
+    import os
+
+    if os.path.isfile(path) and path.endswith(".crbp"):
+        from .bundle import BundleStore
+
+        return BundleStore(path).get_all()
+    if os.path.isdir(path):
+        # the disk store skips testdata/, _schemas/ and *_test.yaml for us,
+        # and stamps each policy with its source file for report provenance
+        from .storage.disk import DiskStore
+
+        policies = DiskStore(path).get_all()
+    else:
+        from .policy import model
+        from .policy.parser import parse_policies
+
+        with open(path, encoding="utf-8") as f:
+            policies = list(parse_policies(f.read()))
+        for p in policies:
+            if p.metadata is None:
+                p.metadata = model.Metadata()
+            p.metadata.source_attributes.setdefault("source", os.path.basename(path))
+    if not policies:
+        raise SystemExit(f"error: no policies found at {path}")
+    return policies
+
+
+def _analyze_cmd(args) -> int:
+    """Static policy analysis for CI gating: device-eligibility classes,
+    divergence-risk lints, and policy-graph findings, offline (no server)."""
+    from .compile import CompileError
+    from .tpu.analyze import analyze_policies, render_text
+
+    globals_ = json.loads(args.globals) if args.globals else {}
+    try:
+        report = analyze_policies(_load_policies_arg(args.path), globals_)
+    except (CompileError, OSError) as e:
+        for err in getattr(e, "errors", None) or [str(e)]:
+            print(f"ERROR: {err}", file=sys.stderr)
+        return 3
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(render_text(report))
+    if args.fail_on and report.failed(args.fail_on):
+        print(f"\nanalysis failed --fail-on {args.fail_on}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="cerbos-tpuctl", description="Admin client for cerbos-tpu PDPs")
     parser.add_argument("--server", default="127.0.0.1:3592")
@@ -363,7 +415,26 @@ def main(argv: list[str] | None = None) -> int:
         help="policy YAML file or directory: replay on a local CPU oracle (bit-exact) instead of the server API",
     )
 
+    p_an = sub.add_parser(
+        "analyze",
+        help="static policy analysis: device-eligibility, divergence-risk, dead rules (offline)",
+    )
+    p_an.add_argument("path", help="policy YAML file, policy directory, or .crbp bundle")
+    p_an.add_argument("--json", action="store_true", help="emit the full report as JSON")
+    p_an.add_argument(
+        "--fail-on",
+        choices=["oracle-only", "divergence-risk"],
+        default="",
+        help="exit non-zero when the report contains the given class/finding kind",
+    )
+    p_an.add_argument(
+        "--globals", default="", help="engine globals as JSON (mirrors engine.globals config)"
+    )
+
     args = parser.parse_args(argv)
+    if args.command == "analyze":
+        # pure-local static analysis; no server or credentials involved
+        return _analyze_cmd(args)
     if args.command == "replay-divergences":
         # local-oracle replay needs no server at all; the API fallback uses
         # the plain HTTP client (check endpoint, not the admin surface)

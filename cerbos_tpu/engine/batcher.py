@@ -40,7 +40,7 @@ from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
-from ..observability import SpanContext, current_span_context, export_span, start_span
+from ..observability import SpanContext, export_span, start_span
 from ..ruletable import check_input
 from . import types as T
 from .admission import OverloadRefused

@@ -8,8 +8,7 @@ offending path.
 
 from __future__ import annotations
 
-import io
-from typing import Any, Iterator, Optional
+from typing import Any, Iterator
 
 import yaml
 

@@ -12,7 +12,7 @@ rules) and message-level CEL rules carry their custom messages verbatim.
 from __future__ import annotations
 
 from dataclasses import dataclass, field as dc_field
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 # Field kinds
 STR = "str"

@@ -19,7 +19,7 @@ from .. import namer
 from ..cel.errors import CelError
 from ..cel.interp import Activation, LazyVal, Message, evaluate
 from ..cel.values import Timestamp
-from ..compile import CompiledCondition, CompiledExpr, CompiledOutput, PolicyParams
+from ..compile import CompiledCondition, CompiledExpr, PolicyParams
 from ..engine import types as T
 from .rows import KIND_PRINCIPAL, KIND_RESOURCE, RuleRow
 from .table import RuleTable

@@ -40,7 +40,6 @@ from .. import globs, namer
 from ..compile import CompiledCondition
 from .rows import (
     EFFECT_DENY,
-    EFFECT_UNSPECIFIED,
     KIND_PRINCIPAL,
     KIND_RESOURCE,
     RuleRow,

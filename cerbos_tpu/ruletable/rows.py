@@ -9,8 +9,8 @@ REQUIRE_PARENTAL_CONSENT allow→DENY(none(condition)) rewrite.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Optional
+from dataclasses import dataclass
+from typing import Optional
 
 from .. import namer
 from ..compile import (

@@ -9,7 +9,7 @@ without any listeners (the ePDP pattern).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Optional
 
 from .bootstrap import Core, initialize
 from .config import Config

@@ -16,7 +16,7 @@ import json
 import threading
 import time
 from concurrent import futures
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional
 
 import grpc
@@ -731,6 +731,7 @@ class Server:
         app.router.add_get("/_cerbos/debug/pressure", self._h_pressure)
         app.router.add_get("/_cerbos/debug/transport", self._h_transport)
         app.router.add_get("/_cerbos/debug/overload", self._h_overload)
+        app.router.add_get("/_cerbos/debug/analysis", self._h_analysis)
         app.router.add_get("/_cerbos/debug/profile", self._h_profile)
         app.router.add_get("/api/server_info", self._h_server_info)
         # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
@@ -895,6 +896,26 @@ class Server:
         if callable(lane_depths):
             with contextlib.suppress(Exception):
                 body["lanes"] = lane_depths()
+        return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _h_analysis(self, request: web.Request) -> web.Response:
+        """Static policy-analysis report for the table currently serving:
+        per-rule device-eligibility classes (device / tagged-fallback /
+        oracle-only with stable reason codes), divergence-risk lints, and
+        policy-graph findings. Recomputed by the bootstrap swap hook, so
+        this is always the verdict on the live bundle. ``?summary=1``
+        returns just the rollup."""
+        from ..tpu import analyze as analyze_mod
+
+        report = analyze_mod.latest()
+        if report is None:
+            return web.json_response(
+                {"error": "no analysis published (core not bootstrapped)"}, status=404
+            )
+        if request.query.get("summary"):
+            return web.json_response(report.summary())
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(None, report.to_dict)
         return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
 
     async def _h_transport(self, request: web.Request) -> web.Response:

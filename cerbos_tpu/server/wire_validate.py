@@ -13,7 +13,7 @@ Returns an error message (str) or None.
 from __future__ import annotations
 
 import re
-from typing import Any, Optional
+from typing import Optional
 
 _VERSION_RE = re.compile(r"^[\w]*$")
 _SCOPE_RE = re.compile(r"^(^$|\.|[0-9a-zA-Z][\w\-]*(\.\w[\w\-]*)*)$")

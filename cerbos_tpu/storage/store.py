@@ -9,8 +9,8 @@ rule-table manager to recompile affected policies and re-lower device tables.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
-from typing import Any, Callable, Iterable, Optional
+from dataclasses import dataclass
+from typing import Callable, Optional
 
 from ..policy import model
 

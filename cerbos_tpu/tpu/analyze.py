@@ -1,0 +1,883 @@
+"""Static policy analyzer: compile-time device-eligibility and divergence audit.
+
+Walks every rule in a built :class:`RuleTable` and the lowered CEL kernels
+(reusing the condition compiler in audit mode — nothing here traces or
+executes device code) and produces a structured report answering, before any
+request arrives, the questions the runtime otherwise answers the hard way:
+
+* **Device eligibility** per rule: ``device`` (fully batchable), ``tagged-
+  fallback`` (batchable, but specific attribute paths carry runtime type
+  tags that divert matching requests to the CPU oracle), or ``oracle-only``
+  (the condition references runtime values the device cannot see and every
+  evaluation goes to the oracle). Reasons are the stable codes from
+  :data:`condcompile.REASONS` / :data:`condcompile.FALLBACK_REASONS`, not
+  free-text strings.
+* **Divergence-risk lints**: construct classes the parity sentinel (PR 8)
+  catches only after a batch has diverged — float equality, NaN constants,
+  mixed timestamp comparisons, string-ordering constants, deep variable
+  inlining chains.
+* **Policy-graph findings**: dead rules shadowed by unconditional DENYs in
+  the same match cell, derived roles imported but never referenced, and
+  undefined variable/constant/global references.
+
+The report is surfaced three ways: ``cerbos-tpuctl analyze`` (CI gating),
+``cerbos_tpu_policy_analysis_total{class,reason}`` gauges republished on
+every bundle build/swap, and the ``/_cerbos/debug/analysis`` endpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+from .. import namer
+from ..cel import ast as A
+from ..cel.parser import token_offset
+from ..compile import CompiledCondition, PolicyParams
+from ..ruletable.rows import RuleRow
+from ..ruletable.table import PolicyMeta, RuleTable
+from .columns import TAG_BOOL, TAG_MISSING, TAG_NULL, TAG_NUM, TAG_OTHER, TAG_STR
+from .condcompile import REASONS, CondKernel
+from .lowering import LoweredTable, lower_table
+
+CLASS_DEVICE = "device"
+CLASS_TAGGED = "tagged-fallback"
+CLASS_ORACLE = "oracle-only"
+
+KIND_ELIGIBILITY = "eligibility"
+KIND_DIVERGENCE = "divergence-risk"
+KIND_GRAPH = "policy-graph"
+
+# divergence-risk lint codes -> description (the analyzer's own vocabulary,
+# disjoint from condcompile.REASONS which describes compiler rejections)
+LINTS: dict[str, str] = {
+    "float_equality": "equality against a non-integral float constant",
+    "nan_constant": "NaN literal in a comparison",
+    "mixed_timestamp_comparison": "timestamp compared against a non-timestamp operand",
+    "string_ordering": "lexicographic ordering against a string constant",
+    "deep_inlining": "variable inlining chain near the compiler depth bound",
+}
+
+GRAPH_FINDINGS: dict[str, str] = {
+    "dead_rule": "ALLOW shadowed by an unconditional DENY in the same match cell",
+    "unreachable_derived_role": "derived role imported but referenced by no rule",
+    "undefined_reference": "condition references an undefined variable/constant/global",
+}
+
+# a variable chain this deep is legal (hard bound is 32) but every extra
+# level multiplies re-inlined subtrees and the odds of float re-association
+DEEP_INLINE_WARN = 8
+
+_TAG_NAMES = {
+    TAG_MISSING: "missing",
+    TAG_NULL: "null",
+    TAG_BOOL: "bool",
+    TAG_NUM: "num",
+    TAG_STR: "str",
+    TAG_OTHER: "other",
+}
+
+_OP_TOKENS = {
+    "_&&_": "&&",
+    "_||_": "||",
+    "!_": "!",
+    "_==_": "==",
+    "_!=_": "!=",
+    "_<_": "<",
+    "_<=_": "<=",
+    "_>_": ">",
+    "_>=_": ">=",
+    "_in_": "in",
+    "_?_:_": "?",
+    "_[_]": "[",
+}
+
+_EQ_OPS = ("_==_", "_!=_")
+_ORD_OPS = ("_<_", "_<=_", "_>_", "_>=_")
+
+
+def _node_anchor(node: A.Node) -> tuple[Optional[str], Optional[tuple[str, ...]]]:
+    """Map an AST node to (token text, token-kind filter) for offset lookup."""
+    if isinstance(node, A.Call):
+        return _OP_TOKENS.get(node.fn, node.fn), None
+    if isinstance(node, (A.Select, A.Present)):
+        return node.field, None
+    if isinstance(node, A.Ident):
+        return node.name, None
+    if isinstance(node, A.Lit):
+        v = node.value
+        if isinstance(v, str):
+            return v, ("STRING",)
+        if isinstance(v, bool):
+            return ("true" if v else "false"), None
+        if v is None:
+            return "null", None
+        return str(v), None
+    return None, None
+
+
+def expr_offset(src: str, node: Optional[A.Node]) -> int:
+    """Character offset of ``node``'s anchor token in ``src``; -1 if unknown."""
+    if node is None:
+        return -1
+    anchor, kinds = _node_anchor(node)
+    if not anchor:
+        return -1
+    return token_offset(src, anchor, kinds=kinds)
+
+
+@dataclass
+class Finding:
+    """One analyzer diagnostic, addressable down to the expression token."""
+
+    kind: str  # eligibility | divergence-risk | policy-graph
+    code: str
+    severity: str  # info | warning | error
+    message: str
+    policy: str = ""  # origin fqn
+    file: str = ""  # source file (disk-store relpath) when known
+    rule_index: int = -1  # row ordinal within the policy
+    rule_name: str = ""
+    expr: str = ""  # offending CEL source
+    offset: int = -1  # char offset of the anchor token in expr
+    path: str = ""  # dotted attribute path (fallback findings)
+    tags: tuple[str, ...] = ()
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "kind": self.kind,
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "policy": self.policy,
+        }
+        if self.file:
+            d["file"] = self.file
+        if self.rule_index >= 0:
+            d["rule_index"] = self.rule_index
+        if self.rule_name:
+            d["rule_name"] = self.rule_name
+        if self.expr:
+            d["expr"] = self.expr
+            d["offset"] = self.offset
+        if self.path:
+            d["path"] = self.path
+        if self.tags:
+            d["tags"] = list(self.tags)
+        return d
+
+    def dedupe_key(self) -> tuple:
+        return (self.kind, self.code, self.policy, self.rule_index, self.expr, self.offset, self.path)
+
+
+@dataclass
+class RuleReport:
+    """Per-rule device-eligibility verdict with machine-readable reasons."""
+
+    policy: str
+    file: str
+    rule_index: int
+    rule_name: str
+    evaluation_key: str
+    row_id: int
+    eligibility: str = CLASS_DEVICE
+    # oracle-only reasons: [{code, reason, message, expr, offset}]
+    reasons: list[dict[str, Any]] = field(default_factory=list)
+    # tagged-fallback triggers: [{path, tags, reasons}]
+    fallbacks: list[dict[str, Any]] = field(default_factory=list)
+    # host-predicate columns (still device-classed): [{code, message, expr, offset}]
+    predicates: list[dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "policy": self.policy,
+            "file": self.file,
+            "rule_index": self.rule_index,
+            "rule_name": self.rule_name,
+            "evaluation_key": self.evaluation_key,
+            "eligibility": self.eligibility,
+            "reasons": self.reasons,
+            "fallbacks": self.fallbacks,
+            "predicates": self.predicates,
+        }
+
+    def primary_reason(self) -> str:
+        if self.eligibility == CLASS_ORACLE and self.reasons:
+            return self.reasons[0]["code"]
+        if self.eligibility == CLASS_TAGGED and self.fallbacks:
+            for fb in self.fallbacks:
+                if fb["reasons"]:
+                    return fb["reasons"][0]
+            return "tagged"
+        return "ok"
+
+
+@dataclass
+class AnalysisReport:
+    rules: list[RuleReport] = field(default_factory=list)
+    findings: list[Finding] = field(default_factory=list)
+
+    def class_counts(self) -> dict[str, int]:
+        out = {CLASS_DEVICE: 0, CLASS_TAGGED: 0, CLASS_ORACLE: 0}
+        for r in self.rules:
+            out[r.eligibility] = out.get(r.eligibility, 0) + 1
+        return out
+
+    def finding_counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.kind] = out.get(f.kind, 0) + 1
+        return out
+
+    def metric_counts(self) -> dict[tuple[str, str], int]:
+        """(class, reason) -> count for the policy_analysis gauge family."""
+        out: dict[tuple[str, str], int] = {}
+        for r in self.rules:
+            key = (r.eligibility, r.primary_reason())
+            out[key] = out.get(key, 0) + 1
+        for f in self.findings:
+            if f.kind == KIND_ELIGIBILITY:
+                continue  # already counted through the rule classes
+            key = (f.kind, f.code)
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    def summary(self) -> dict[str, Any]:
+        return {
+            "rules": len(self.rules),
+            "classes": self.class_counts(),
+            "findings": self.finding_counts(),
+        }
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "summary": self.summary(),
+            "rules": [r.to_dict() for r in self.rules],
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def summary_line(self) -> str:
+        c = self.class_counts()
+        fc = self.finding_counts()
+        return (
+            f"policy analysis: {len(self.rules)} rules "
+            f"({c[CLASS_DEVICE]} device, {c[CLASS_TAGGED]} tagged-fallback, "
+            f"{c[CLASS_ORACLE]} oracle-only), "
+            f"{fc.get(KIND_DIVERGENCE, 0)} divergence-risk, "
+            f"{fc.get(KIND_GRAPH, 0)} policy-graph findings"
+        )
+
+    def failed(self, fail_on: str) -> bool:
+        if fail_on == "oracle-only":
+            return self.class_counts()[CLASS_ORACLE] > 0
+        if fail_on == "divergence-risk":
+            return self.finding_counts().get(KIND_DIVERGENCE, 0) > 0
+        raise ValueError(f"unknown --fail-on criterion {fail_on!r}")
+
+
+# ---------------------------------------------------------------------------
+# condition-tree helpers
+
+
+def _iter_exprs(cond: Optional[CompiledCondition]):
+    if cond is None:
+        return
+    if cond.kind == "expr" and cond.expr is not None:
+        yield cond.expr
+    for ch in cond.children:
+        yield from _iter_exprs(ch)
+
+
+def _locate(
+    node: Optional[A.Node],
+    conds: Iterable[Optional[CompiledCondition]],
+    params: Iterable[Optional[PolicyParams]],
+) -> tuple[str, int]:
+    """Best-effort (source, offset) for a node that may have been inlined.
+
+    The compiler hands back AST nodes from *inlined* trees, so the node may
+    originate in the rule's own expression or in a variable definition it
+    pulled in. Try the rule expressions first, then variable defs.
+    """
+    if node is None:
+        return "", -1
+    srcs: list[str] = []
+    for c in conds:
+        for e in _iter_exprs(c):
+            srcs.append(e.original)
+    for p in params:
+        if p is None:
+            continue
+        for v in p.ordered_variables:
+            srcs.append(v.expr.original)
+    first = srcs[0] if srcs else ""
+    for src in srcs:
+        off = expr_offset(src, node)
+        if off >= 0:
+            return src, off
+    return first, -1
+
+
+def _path_str(path: tuple[str, ...]) -> str:
+    return ".".join(path)
+
+
+def _tag_names(tags: frozenset[int]) -> tuple[str, ...]:
+    return tuple(sorted(_TAG_NAMES.get(t, str(t)) for t in tags))
+
+
+# ---------------------------------------------------------------------------
+# eligibility
+
+
+def _rule_kernels(lt: LoweredTable, row_id: int) -> list[CondKernel]:
+    lr = lt.rows[row_id]
+    ids = [lr.cond_id, lr.drcond_id, lr.negated_cond_id]
+    return [lt.compiler.kernels[c] for c in ids if c >= 0]
+
+
+def _classify_rule(rep: RuleReport, row: RuleRow, kernels: list[CondKernel]) -> None:
+    conds = (row.condition, row.derived_role_condition)
+    params = (row.params, row.derived_role_params)
+    seen_fb: set[tuple[str, ...]] = set()
+    for k in kernels:
+        if k.oracle_reason is not None:
+            code, msg, node = k.oracle_reason
+            src, off = _locate(node, conds, params)
+            rep.reasons.append(
+                {
+                    "code": code,
+                    "reason": REASONS.get(code, code),
+                    "message": msg,
+                    "expr": src,
+                    "offset": off,
+                }
+            )
+        for path, tags in k.fallback_tags.items():
+            if path in seen_fb:
+                continue
+            seen_fb.add(path)
+            rcodes = sorted(k.fallback_reasons.get(path, frozenset()))
+            rep.fallbacks.append(
+                {
+                    "path": _path_str(path),
+                    "tags": list(_tag_names(tags)),
+                    "reasons": rcodes,
+                }
+            )
+        for code, msg, node in k.pred_reasons:
+            src, off = _locate(node, conds, params)
+            rep.predicates.append(
+                {"code": code, "message": msg, "expr": src, "offset": off}
+            )
+    if any(k.emit is None for k in kernels):
+        rep.eligibility = CLASS_ORACLE
+    elif any(k.fallback_tags for k in kernels):
+        rep.eligibility = CLASS_TAGGED
+    else:
+        rep.eligibility = CLASS_DEVICE
+
+
+# ---------------------------------------------------------------------------
+# divergence-risk lints
+
+
+def _is_timestamp_node(n: A.Node) -> bool:
+    return isinstance(n, A.Call) and n.target is None and (
+        (n.fn == "now" and not n.args) or (n.fn == "timestamp" and len(n.args) == 1)
+    )
+
+
+def _lint_expr(src: str, node: A.Node, add) -> None:
+    for n in A.walk(node):
+        if isinstance(n, A.Lit) and isinstance(n.value, float) and n.value != n.value:
+            add("nan_constant", "NaN literal in expression", src, n)
+        if not (isinstance(n, A.Call) and n.target is None):
+            continue
+        if n.fn in _EQ_OPS + _ORD_OPS and len(n.args) == 2:
+            lhs, rhs = n.args
+            if _is_timestamp_node(lhs) != _is_timestamp_node(rhs):
+                add(
+                    "mixed_timestamp_comparison",
+                    "timestamp compared against a non-timestamp operand; host and "
+                    "device coerce differently",
+                    src,
+                    n,
+                )
+            if n.fn in _EQ_OPS:
+                for side in (lhs, rhs):
+                    if (
+                        isinstance(side, A.Lit)
+                        and isinstance(side.value, float)
+                        and not isinstance(side.value, bool)
+                        and side.value == side.value
+                        and not float(side.value).is_integer()
+                    ):
+                        add(
+                            "float_equality",
+                            f"equality against float constant {side.value!r}; "
+                            "bit-inexact attribute encodings diverge here",
+                            src,
+                            n,
+                        )
+                        break
+            else:
+                for side in (lhs, rhs):
+                    if isinstance(side, A.Lit) and isinstance(side.value, str):
+                        add(
+                            "string_ordering",
+                            f"lexicographic ordering against {side.value!r}; device "
+                            "string ordering uses interned ranks, not full collation",
+                            src,
+                            n,
+                        )
+                        break
+
+
+def _var_refs(node: A.Node) -> list[str]:
+    out = []
+    for n in A.walk(node):
+        if (
+            isinstance(n, A.Select)
+            and isinstance(n.operand, A.Ident)
+            and n.operand.name in ("V", "variables")
+        ):
+            out.append(n.field)
+    return out
+
+
+def _var_depths(params: Optional[PolicyParams]) -> dict[str, int]:
+    """Inlining depth of each variable (1 = no nested variable references)."""
+    if params is None:
+        return {}
+    defs = {v.name: v.expr.node for v in params.ordered_variables}
+    depths: dict[str, int] = {}
+
+    def depth_of(name: str, stack: tuple[str, ...]) -> int:
+        if name in depths:
+            return depths[name]
+        if name in stack:
+            return 99  # cycle: the compiler's depth bound will reject it
+        n = defs.get(name)
+        if n is None:
+            return 0
+        d = 1 + max([depth_of(r, stack + (name,)) for r in _var_refs(n)] or [0])
+        depths[name] = d
+        return d
+
+    for name in defs:
+        depth_of(name, ())
+    return depths
+
+
+# ---------------------------------------------------------------------------
+# policy-graph audit
+
+
+def _covers(pattern: str, value: Optional[str]) -> bool:
+    return pattern == "*" or pattern == (value or "")
+
+
+def _graph_audit(
+    rt: RuleTable,
+    rows_by_policy: dict[str, list[RuleRow]],
+    file_of: dict[str, str],
+    add_finding,
+) -> None:
+    # dead rules: an ALLOW whose whole match cell is covered by an
+    # unconditional DENY of the same policy (DENY always wins within a cell,
+    # so the ALLOW can never change an outcome). Conservative on purpose:
+    # exact scope/version/resource/principal, glob-or-equal role+action,
+    # plain DENY rows only (no derived-role origin, no role-policy rows).
+    for fqn, rows in rows_by_policy.items():
+        denies = [
+            r
+            for r in rows
+            if r.effect == "EFFECT_DENY"
+            and r.condition is None
+            and r.derived_role_condition is None
+            and not r.origin_derived_role
+            and not r.from_role_policy
+            and not r.no_match_for_scope_permissions
+            and r.action is not None
+        ]
+        if not denies:
+            continue
+        for idx, r in enumerate(rows):
+            if r.effect != "EFFECT_ALLOW" or r.action is None:
+                continue
+            for d in denies:
+                if (
+                    d.scope == r.scope
+                    and d.version == r.version
+                    and d.resource == r.resource
+                    and d.principal == r.principal
+                    and _covers(d.role, r.role)
+                    and _covers(d.action, r.action)
+                ):
+                    add_finding(
+                        Finding(
+                            kind=KIND_GRAPH,
+                            code="dead_rule",
+                            severity="warning",
+                            message=(
+                                f"ALLOW rule for action {r.action!r} role "
+                                f"{r.role or '*'!r} is dead: unconditional DENY "
+                                f"{d.name or d.evaluation_key!r} shadows the same cell"
+                            ),
+                            policy=fqn,
+                            file=file_of.get(fqn, ""),
+                            rule_index=idx,
+                            rule_name=r.name,
+                        )
+                    )
+                    break
+
+    # unreachable derived roles: imported by a policy but referenced by none
+    # of its rows (origin_derived_role is set per expanded parent-role row)
+    used: dict[int, set[str]] = {}
+    for rows in rows_by_policy.values():
+        for r in rows:
+            if r.origin_derived_role:
+                used.setdefault(namer.module_id(r.origin_fqn), set()).add(
+                    r.origin_derived_role
+                )
+    for mod_id, drs in rt.policy_derived_roles.items():
+        meta: Optional[PolicyMeta] = rt.meta.get(mod_id)
+        pol_fqn = meta.fqn if meta else ""
+        for name, dr in drs.items():
+            if name not in used.get(mod_id, set()):
+                add_finding(
+                    Finding(
+                        kind=KIND_GRAPH,
+                        code="unreachable_derived_role",
+                        severity="info",
+                        message=(
+                            f"derived role {name!r} (from {dr.origin_fqn}) is "
+                            "imported but referenced by no rule"
+                        ),
+                        policy=pol_fqn,
+                        file=file_of.get(pol_fqn, ""),
+                    )
+                )
+
+
+def _undefined_refs(
+    node: A.Node,
+    params: Optional[PolicyParams],
+    globals_: dict[str, Any],
+) -> list[tuple[str, str]]:
+    """(root-kind, name) for V/C/G selects that resolve to nothing."""
+    var_names = (
+        {v.name for v in params.ordered_variables} if params is not None else set()
+    )
+    consts = params.constants if params is not None else {}
+    out: list[tuple[str, str]] = []
+    for n in A.walk(node):
+        if not (isinstance(n, A.Select) and isinstance(n.operand, A.Ident)):
+            continue
+        root = n.operand.name
+        if root in ("V", "variables") and n.field not in var_names:
+            out.append(("variable", n.field))
+        elif root in ("C", "constants") and n.field not in consts:
+            out.append(("constant", n.field))
+        elif root in ("G", "globals") and n.field not in globals_:
+            out.append(("global", n.field))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+
+def analyze_table(
+    rt: RuleTable,
+    globals_: Optional[dict[str, Any]] = None,
+    lowered: Optional[LoweredTable] = None,
+) -> AnalysisReport:
+    """Analyze a built rule table. Pass ``lowered`` to reuse an existing
+    lowering (swap-time hook) instead of compiling a fresh audit copy."""
+    globals_ = globals_ or {}
+    lt = lowered if lowered is not None else lower_table(rt, globals_)
+    report = AnalysisReport()
+    seen_findings: set[tuple] = set()
+
+    def add_finding(f: Finding) -> None:
+        key = f.dedupe_key()
+        if key not in seen_findings:
+            seen_findings.add(key)
+            report.findings.append(f)
+
+    file_of: dict[str, str] = {}
+    for meta in rt.meta.values():
+        src = meta.source_attributes.get("source")
+        if isinstance(src, str):
+            file_of[meta.fqn] = src
+
+    rows_by_policy: dict[str, list[RuleRow]] = {}
+    for row in rt.idx.get_all_rows():
+        rows_by_policy.setdefault(row.origin_fqn, []).append(row)
+
+    for fqn, rows in sorted(rows_by_policy.items()):
+        fname = file_of.get(fqn, "")
+        linted_params: set[int] = set()
+        for idx, row in enumerate(rows):
+            rep = RuleReport(
+                policy=fqn,
+                file=fname,
+                rule_index=idx,
+                rule_name=row.name,
+                evaluation_key=row.evaluation_key,
+                row_id=row.id,
+            )
+            _classify_rule(rep, row, _rule_kernels(lt, row.id))
+            report.rules.append(rep)
+            if rep.eligibility == CLASS_ORACLE:
+                for r in rep.reasons:
+                    add_finding(
+                        Finding(
+                            kind=KIND_ELIGIBILITY,
+                            code=r["code"],
+                            severity="warning",
+                            message=r["message"],
+                            policy=fqn,
+                            file=fname,
+                            rule_index=idx,
+                            rule_name=row.name,
+                            expr=r["expr"],
+                            offset=r["offset"],
+                        )
+                    )
+            elif rep.eligibility == CLASS_TAGGED:
+                for fb in rep.fallbacks:
+                    add_finding(
+                        Finding(
+                            kind=KIND_ELIGIBILITY,
+                            code=(fb["reasons"][0] if fb["reasons"] else "tagged"),
+                            severity="info",
+                            message=(
+                                f"requests where {fb['path']} carries a "
+                                f"{'/'.join(fb['tags'])} tag fall back to the oracle"
+                            ),
+                            policy=fqn,
+                            file=fname,
+                            rule_index=idx,
+                            rule_name=row.name,
+                            path=fb["path"],
+                            tags=tuple(fb["tags"]),
+                        )
+                    )
+
+            # lints + undefined references over the rule's own expressions
+            # and (once per params object) its variable definitions
+            def lint_add(code, msg, src, n, idx=idx, row=row, fname=fname, fqn=fqn):
+                add_finding(
+                    Finding(
+                        kind=KIND_DIVERGENCE,
+                        code=code,
+                        severity="warning",
+                        message=msg,
+                        policy=fqn,
+                        file=fname,
+                        rule_index=idx,
+                        rule_name=row.name,
+                        expr=src,
+                        offset=expr_offset(src, n),
+                    )
+                )
+
+            for cond, params in (
+                (row.condition, row.params),
+                (row.derived_role_condition, row.derived_role_params),
+            ):
+                if cond is None:
+                    continue
+                for e in _iter_exprs(cond):
+                    _lint_expr(e.original, e.node, lint_add)
+                    for kind_, name_ in _undefined_refs(e.node, params, globals_):
+                        add_finding(
+                            Finding(
+                                kind=KIND_GRAPH,
+                                code="undefined_reference",
+                                severity="error",
+                                message=f"condition references undefined {kind_} {name_!r}",
+                                policy=fqn,
+                                file=fname,
+                                rule_index=idx,
+                                rule_name=row.name,
+                                expr=e.original,
+                                offset=token_offset(e.original, name_),
+                            )
+                        )
+                if params is not None and id(params) not in linted_params:
+                    linted_params.add(id(params))
+                    for v in params.ordered_variables:
+                        _lint_expr(v.expr.original, v.expr.node, lint_add)
+                        for kind_, name_ in _undefined_refs(v.expr.node, params, globals_):
+                            add_finding(
+                                Finding(
+                                    kind=KIND_GRAPH,
+                                    code="undefined_reference",
+                                    severity="error",
+                                    message=(
+                                        f"variable {v.name!r} references undefined "
+                                        f"{kind_} {name_!r}"
+                                    ),
+                                    policy=fqn,
+                                    file=fname,
+                                    expr=v.expr.original,
+                                    offset=token_offset(v.expr.original, name_),
+                                )
+                            )
+                    depths = _var_depths(params)
+                    for vname, d in depths.items():
+                        if d >= DEEP_INLINE_WARN:
+                            vdef = next(
+                                ve for ve in params.ordered_variables if ve.name == vname
+                            )
+                            add_finding(
+                                Finding(
+                                    kind=KIND_DIVERGENCE,
+                                    code="deep_inlining",
+                                    severity="warning",
+                                    message=(
+                                        f"variable {vname!r} inlines {d} levels deep "
+                                        "(compiler bound is 32); deep chains amplify "
+                                        "float re-association divergence"
+                                    ),
+                                    policy=fqn,
+                                    file=fname,
+                                    expr=vdef.expr.original,
+                                )
+                            )
+
+    _graph_audit(rt, rows_by_policy, file_of, add_finding)
+    report.findings.sort(
+        key=lambda f: ({"error": 0, "warning": 1, "info": 2}.get(f.severity, 3), f.policy, f.rule_index)
+    )
+    return report
+
+
+def analyze_policies(
+    policies: Iterable[Any], globals_: Optional[dict[str, Any]] = None
+) -> AnalysisReport:
+    """Compile a raw policy set (storage Policy objects) and analyze it."""
+    from ..compile import compile_policy_set
+    from ..ruletable.table import build_rule_table
+
+    policies = list(policies)
+    cps = compile_policy_set(policies)
+    rt = build_rule_table(cps)
+    report = analyze_table(rt, globals_)
+    _audit_unused_derived_roles(policies, report)
+    return report
+
+
+def _audit_unused_derived_roles(policies: list[Any], report: AnalysisReport) -> None:
+    """Flag derived-role definitions no importing rule ever references.
+
+    The compiler prunes unreferenced definitions before they reach the rule
+    table, so this is only detectable while the raw policy objects are in
+    hand — table-level analysis (swap-time hook) cannot see them."""
+    defs: dict[str, list[tuple[str, str]]] = {}  # set name -> [(role, file)]
+    referenced: set[str] = set()
+    imported: set[str] = set()
+    for p in policies:
+        dr = getattr(p, "derived_roles", None)
+        if dr is not None:
+            meta = getattr(p, "metadata", None)
+            src = (meta.source_attributes.get("source", "") if meta else "") or ""
+            defs[dr.name] = [(d.name, src) for d in dr.definitions]
+        rp = getattr(p, "resource_policy", None)
+        if rp is not None:
+            imported.update(rp.import_derived_roles)
+            for r in rp.rules:
+                referenced.update(r.derived_roles)
+    existing = {f.dedupe_key() for f in report.findings}
+    for set_name, roles in sorted(defs.items()):
+        if set_name not in imported:
+            continue  # never imported: dangling set, not a per-role finding
+        for role, src in roles:
+            if role in referenced:
+                continue
+            f = Finding(
+                kind=KIND_GRAPH,
+                code="unreachable_derived_role",
+                severity="info",
+                message=(
+                    f"derived role {role!r} (derived-roles set {set_name!r}) "
+                    "is defined but referenced by no rule"
+                ),
+                policy=namer.derived_roles_fqn(set_name),
+                file=src,
+            )
+            if f.dedupe_key() not in existing:
+                report.findings.append(f)
+
+
+# ---------------------------------------------------------------------------
+# publication (gauges + latest-report singleton for the debug endpoint)
+
+_latest: Optional[AnalysisReport] = None
+_published_keys: set[tuple[str, str]] = set()
+
+
+def publish(report: AnalysisReport) -> AnalysisReport:
+    """Export ``cerbos_tpu_policy_analysis_total{class,reason}`` gauges and
+    retain the report for ``/_cerbos/debug/analysis``. Keys published by a
+    previous bundle that vanished in this one are zeroed, not dropped, so
+    scrapes never see a stale non-zero sample."""
+    global _latest, _published_keys
+    from ..observability import metrics
+
+    vec = metrics().gauge_vec(
+        "cerbos_tpu_policy_analysis_total",
+        "Static policy-analysis verdicts by eligibility class / finding kind and stable reason code",
+        label=("class", "reason"),
+    )
+    counts = report.metric_counts()
+    for key in _published_keys - set(counts):
+        vec.set(key, 0.0)
+    for key, n in counts.items():
+        vec.set(key, float(n))
+    _published_keys = set(counts)
+    _latest = report
+    return report
+
+
+def latest() -> Optional[AnalysisReport]:
+    return _latest
+
+
+# ---------------------------------------------------------------------------
+# CLI rendering
+
+
+def render_text(report: AnalysisReport) -> str:
+    lines = [report.summary_line()]
+    nondevice = [r for r in report.rules if r.eligibility != CLASS_DEVICE]
+    if nondevice:
+        lines.append("")
+        lines.append("non-device rules:")
+        for r in nondevice:
+            loc = r.file or r.policy
+            lines.append(f"  [{r.eligibility}] {loc} rule#{r.rule_index} {r.evaluation_key}")
+            for reason in r.reasons:
+                lines.append(
+                    f"      {reason['code']}: {reason['message']}"
+                    + (f"  ({reason['expr']!r} @{reason['offset']})" if reason["expr"] else "")
+                )
+            for fb in r.fallbacks:
+                rs = f" [{', '.join(fb['reasons'])}]" if fb["reasons"] else ""
+                lines.append(f"      fallback {fb['path']} tags={'/'.join(fb['tags'])}{rs}")
+    shown = [f for f in report.findings if f.kind != KIND_ELIGIBILITY]
+    if shown:
+        lines.append("")
+        lines.append("findings:")
+        for f in shown:
+            loc = f.file or f.policy
+            at = f" rule#{f.rule_index}" if f.rule_index >= 0 else ""
+            lines.append(f"  {f.severity}: [{f.code}] {loc}{at}: {f.message}")
+            if f.expr:
+                lines.append(f"      {f.expr!r} @{f.offset}")
+    return "\n".join(lines)

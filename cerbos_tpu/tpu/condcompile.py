@@ -60,8 +60,48 @@ _ROOT_ALIASES = {
 }
 
 
+# Stable machine-readable reason codes for every Unsupported raise site.
+# These are the analyzer's (tpu/analyze.py) and the runtime counter's
+# (cond_compile_unsupported_total{reason}) shared vocabulary: messages may
+# be reworded freely, codes may not. tests/test_condcompile_analysis.py
+# exercises each site and fails the suite on a raise without a code.
+REASONS: dict[str, str] = {
+    "inline_too_deep": "variable inlining exceeded the depth bound",
+    "undefined_variable": "condition references an undefined variable",
+    "undefined_constant": "condition references an undefined constant",
+    "undefined_global": "condition references an undefined global",
+    "non_literal_list_element": "list literal with a non-literal element",
+    "operand_unsupported": "operand is neither a literal nor an attribute path",
+    "unsupported_function": "function outside the native device op set",
+    "non_bool_literal": "non-boolean literal in boolean position",
+    "unsupported_bool_expr": "boolean expression shape outside the device op set",
+    "has_on_non_path": "has() over a non-attribute-path operand",
+    "bad_timestamp_constant": "timestamp() constant failed to convert",
+    "mixed_timestamp_equality": "equality between a timestamp and an untyped operand",
+    "const_const_equality": "constant == constant (host constant folding)",
+    "list_equality": "equality against a list constant",
+    "unsupported_equality_constant": "equality against an unsupported constant type",
+    "mixed_timestamp_ordering": "ordering between a timestamp and an untyped operand",
+    "const_const_ordering": "constant-vs-constant ordering (host constant folding)",
+    "string_ordering_constant": "string ordering against a constant",
+    "non_numeric_ordering_constant": "ordering against a non-numeric constant",
+    "nan_ordering_constant": "ordering against a NaN constant",
+    "unsupported_membership": "membership test shape outside the device op set",
+}
+
+
 class Unsupported(Exception):
-    """Raised during compilation when a fragment needs a predicate column."""
+    """Raised during compilation when a fragment needs a predicate column.
+
+    Carries a stable reason ``code`` (a key of :data:`REASONS`) and the
+    offending AST ``node`` so the static analyzer and the runtime fallback
+    counter speak the same vocabulary as this free-text message.
+    """
+
+    def __init__(self, msg: str, code: str = "unsupported", node: Optional[A.Node] = None):
+        super().__init__(msg)
+        self.code = code
+        self.node = node
 
 
 @dataclass
@@ -98,6 +138,12 @@ class CondKernel:
     template_sig: Optional[tuple] = None
     slot_kinds: tuple[str, ...] = ()
     slot_values: tuple[Any, ...] = ()
+    # compile audit trail (tpu/analyze.py): expr-level Unsupported codes
+    # that became predicate columns, the tree-level rejection that nulled
+    # emit, and the per-path reason behind each fallback tag registration
+    pred_reasons: list[tuple[str, str, Optional[A.Node]]] = field(default_factory=list)
+    oracle_reason: Optional[tuple[str, str, Optional[A.Node]]] = None
+    fallback_reasons: dict[tuple[str, ...], frozenset[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -270,21 +316,21 @@ class _Compiler:
 
     def inline(self, node: A.Node, depth: int = 0) -> A.Node:
         if depth > 32:
-            raise Unsupported("variable inlining too deep")
+            raise Unsupported("variable inlining too deep", code="inline_too_deep", node=node)
         if isinstance(node, A.Select) and isinstance(node.operand, A.Ident):
             root = node.operand.name
             if root in ("V", "variables"):
                 if node.field in self.var_defs:
                     return self.inline(self.var_defs[node.field], depth + 1)
-                raise Unsupported(f"undefined variable {node.field}")
+                raise Unsupported(f"undefined variable {node.field}", code="undefined_variable", node=node)
             if root in ("C", "constants"):
                 if node.field in self.params.constants:
                     return A.Lit(self.params.constants[node.field])
-                raise Unsupported(f"undefined constant {node.field}")
+                raise Unsupported(f"undefined constant {node.field}", code="undefined_constant", node=node)
             if root in ("G", "globals"):
                 if node.field in self.globals:
                     return A.Lit(self.globals[node.field])
-                raise Unsupported(f"undefined global {node.field}")
+                raise Unsupported(f"undefined global {node.field}", code="undefined_global", node=node)
         # recurse
         if isinstance(node, A.Select):
             return A.Select(self.inline(node.operand, depth), node.field)
@@ -324,14 +370,14 @@ class _Compiler:
             vals = []
             for item in node.items:
                 if not isinstance(item, A.Lit):
-                    raise Unsupported("non-literal list element")
+                    raise Unsupported("non-literal list element", code="non_literal_list_element", node=item)
                 vals.append(item.value)
             return ConstOp(vals)
         path = self.path_of(node)
         if path is not None:
             self.k.paths.add(path)
             return PathOp(path)
-        raise Unsupported("operand is not a literal or attribute path")
+        raise Unsupported("operand is not a literal or attribute path", code="operand_unsupported", node=node)
 
     def path_of(self, node: A.Node) -> Optional[tuple[str, ...]]:
         """Select/Index chain rooted at request/R/P → canonical path."""
@@ -389,7 +435,7 @@ class _Compiler:
                 return self._ordering(fn, node.args[0], node.args[1])
             if fn == "_in_":
                 return self._in(node.args[0], node.args[1])
-            raise Unsupported(f"function {fn}")
+            raise Unsupported(f"function {fn}", code="unsupported_function", node=node)
         if isinstance(node, A.Present):
             return self._has(node)
         if isinstance(node, A.Lit):
@@ -404,7 +450,7 @@ class _Compiler:
                     return val, xp.zeros((B, gc.size), dtype=bool)
 
                 return BoolExpr(emit_lit)
-            raise Unsupported("non-bool literal in boolean position")
+            raise Unsupported("non-bool literal in boolean position", code="non_bool_literal", node=node)
         # bare attribute path in boolean position: true iff value is bool true
         path = self.path_of(node)
         if path is not None:
@@ -418,7 +464,7 @@ class _Compiler:
                 return val & ~err, err
 
             return BoolExpr(emit_path)
-        raise Unsupported("unsupported boolean expression")
+        raise Unsupported("unsupported boolean expression", code="unsupported_bool_expr", node=node)
 
     def _logic(self, args, is_and: bool) -> BoolExpr:
         parts = [self.compile_bool(a) for a in args]
@@ -454,7 +500,7 @@ class _Compiler:
     def _has(self, node: A.Present) -> BoolExpr:
         path = self.path_of(A.Select(node.operand, node.field))
         if path is None:
-            raise Unsupported("has() on non-path")
+            raise Unsupported("has() on non-path", code="has_on_non_path", node=node)
         self.k.paths.add(path)
         self.tok("has", path)
 
@@ -486,7 +532,7 @@ class _Compiler:
                 try:
                     hi, lo = timestamp_key(arg.value)
                 except Exception:  # noqa: BLE001 — invalid constant: host evaluates (errors)
-                    raise Unsupported("unconvertible timestamp constant") from None
+                    raise Unsupported("unconvertible timestamp constant", code="bad_timestamp_constant", node=node) from None
                 return ("rawconst", (hi, lo))
             path = self.path_of(arg)
             if path is not None:
@@ -554,19 +600,19 @@ class _Compiler:
         ls, rs = self._ts_side(lhs_n), self._ts_side(rhs_n)
         if ls is not None or rs is not None:
             if ls is None or rs is None:
-                raise Unsupported("mixed timestamp equality")
+                raise Unsupported("mixed timestamp equality", code="mixed_timestamp_equality", node=lhs_n if ls is None else rhs_n)
             ls, rs = self._ts_commit(ls), self._ts_commit(rs)
             return self._ts_compare("_!=_" if negate else "_==_", ls, rs)
         lhs, rhs = self.as_operand(lhs_n), self.as_operand(rhs_n)
         if isinstance(lhs, ConstOp) and isinstance(rhs, PathOp):
             lhs, rhs = rhs, lhs
         if isinstance(lhs, ConstOp):
-            raise Unsupported("constant == constant")  # let constant folding live on host
+            raise Unsupported("constant == constant", code="const_const_equality", node=lhs_n)  # let constant folding live on host
         assert isinstance(lhs, PathOp)
         # lists/dicts at an eq path can't be compared on device
-        self._add_fallback(lhs.path, {TAG_OTHER})
+        self._add_fallback(lhs.path, {TAG_OTHER}, "eq_collection_operand")
         if isinstance(rhs, PathOp):
-            self._add_fallback(rhs.path, {TAG_OTHER})
+            self._add_fallback(rhs.path, {TAG_OTHER}, "eq_collection_operand")
             self.tok("eqpp", lhs.path, rhs.path, negate)
 
             def emit_pp(refs, gc, a=lhs.path, b=rhs.path, negate=negate):
@@ -590,7 +636,7 @@ class _Compiler:
 
         cval = rhs.value
         if isinstance(cval, list):
-            raise Unsupported("list equality")
+            raise Unsupported("list equality", code="list_equality", node=rhs_n)
         if isinstance(cval, bool):
             s = self.slot("bool", 1 if cval else 0)
             self.tok("eqpb", lhs.path, negate)
@@ -661,14 +707,14 @@ class _Compiler:
                 return val & ~err, err
 
             return BoolExpr(emit_ps)
-        raise Unsupported(f"equality against {type(cval).__name__} constant")
+        raise Unsupported(f"equality against {type(cval).__name__} constant", code="unsupported_equality_constant", node=rhs_n)
 
     def _ordering(self, fn: str, lhs_n: A.Node, rhs_n: A.Node) -> BoolExpr:
         ls, rs = self._ts_side(lhs_n), self._ts_side(rhs_n)
         if ls is not None or rs is not None:
             if ls is None or rs is None:
                 # mixed timestamp vs untyped operand: host evaluates
-                raise Unsupported("mixed timestamp ordering")
+                raise Unsupported("mixed timestamp ordering", code="mixed_timestamp_ordering", node=lhs_n if ls is None else rhs_n)
             ls, rs = self._ts_commit(ls), self._ts_commit(rs)
             return self._ts_compare(fn, ls, rs)
         lhs, rhs = self.as_operand(lhs_n), self.as_operand(rhs_n)
@@ -677,7 +723,7 @@ class _Compiler:
             lhs, rhs = rhs, lhs
             fn = flip[fn]
         if isinstance(lhs, ConstOp):
-            raise Unsupported("constant ordering")
+            raise Unsupported("constant ordering", code="const_const_ordering", node=lhs_n)
         assert isinstance(lhs, PathOp)
 
         def cmp(ahi, alo, bhi, blo, fn):
@@ -697,8 +743,8 @@ class _Compiler:
             # device → route those inputs to the oracle. Every other
             # non-numeric pairing is a CEL type error, which the device err
             # bit reproduces.
-            self._add_fallback(lhs.path, {TAG_STR, TAG_OTHER})
-            self._add_fallback(rhs.path, {TAG_STR, TAG_OTHER})
+            self._add_fallback(lhs.path, {TAG_STR, TAG_OTHER}, "ord_string_pair")
+            self._add_fallback(rhs.path, {TAG_STR, TAG_OTHER}, "ord_string_pair")
             self.tok("ordpp", lhs.path, rhs.path, fn)
 
             def emit_pp(refs, gc, a=lhs.path, b=rhs.path, fn=fn):
@@ -718,12 +764,12 @@ class _Compiler:
             # string ordering against a constant: a predicate column (host
             # CEL, value-cached) — NOT an oracle fallback; strings at the
             # path stay device-served
-            raise Unsupported("string ordering constant")
+            raise Unsupported("string ordering constant", code="string_ordering_constant", node=rhs_n)
         if isinstance(cval, bool) or not isinstance(cval, (int, float)):
-            raise Unsupported("non-numeric ordering constant")
+            raise Unsupported("non-numeric ordering constant", code="non_numeric_ordering_constant", node=rhs_n)
         f = float(cval)
         if f != f:
-            raise Unsupported("NaN ordering constant")
+            raise Unsupported("NaN ordering constant", code="nan_ordering_constant", node=rhs_n)
         s = self.slot("key", split_key(double_key(f)))
         self.tok("ordpc", lhs.path, fn)
 
@@ -790,11 +836,13 @@ class _Compiler:
                 return val & ~err, err
 
             return BoolExpr(emit_in_list)
-        raise Unsupported("in over attribute lists")
+        raise Unsupported("in over attribute lists", code="unsupported_membership", node=rhs_n)
 
-    def _add_fallback(self, path: tuple[str, ...], tags: set[int]) -> None:
+    def _add_fallback(self, path: tuple[str, ...], tags: set[int], reason: str) -> None:
         cur = self.k.fallback_tags.get(path, frozenset())
         self.k.fallback_tags[path] = cur | frozenset(tags)
+        cur_r = self.k.fallback_reasons.get(path, frozenset())
+        self.k.fallback_reasons[path] = cur_r | frozenset((reason,))
 
     interner: StringInterner  # set by compile_condition
 
@@ -900,12 +948,43 @@ def _pred_refs(node: A.Node) -> tuple[set[tuple[str, ...]], bool, bool]:
     return paths, refs_runtime, time_dep
 
 
+# Reason codes for fallback-tag registrations: unlike :data:`REASONS` these
+# fragments DO compile to device kernels, but specific runtime value shapes
+# at the tagged path (lists/dicts under ==, strings under path-vs-path <)
+# route the affected inputs to the CPU oracle. The analyzer reports them as
+# the `tagged-fallback` eligibility class.
+FALLBACK_REASONS: dict[str, str] = {
+    "eq_collection_operand": "equality over a path that may hold a list/dict at runtime",
+    "ord_string_pair": "path-vs-path ordering that is string-comparable at runtime",
+}
+
+
+def _unsupported_counter():
+    from ..observability import metrics
+
+    return metrics().counter_vec(
+        "cerbos_tpu_cond_compile_unsupported_total",
+        "Condition fragments rejected by the device compiler, by stable reason code",
+    )
+
+
+def _count_unsupported(code: str) -> None:
+    """Runtime condition-compile rejection accounting, by stable reason
+    code — the live counterpart of the static analyzer's predictions
+    (docs/ANALYSIS.md). Incremented wherever lowering runs: process boot,
+    bundle swap, and admin-API policy reloads."""
+    _unsupported_counter().inc(code)
+
+
 class ConditionSetCompiler:
     """Compiles the distinct (condition, params) pairs of a rule table."""
 
     def __init__(self, globals_: dict[str, Any], interner: StringInterner):
         self.globals = globals_
         self.interner = interner
+        # register the rejection counter eagerly so the family scrapes as 0
+        # (and passes the registry lint) even on a fully device-clean table
+        _unsupported_counter()
         self.kernels: list[CondKernel] = []
         self._by_key: dict[tuple[int, int], int] = {}
         self.preds: list[PredSpec] = []
@@ -975,9 +1054,11 @@ class ConditionSetCompiler:
                         return v & ~e
 
                     return emit_expr
-                except Unsupported:
+                except Unsupported as u:
                     if kernel.references_runtime:
                         raise
+                    _count_unsupported(u.code)
+                    kernel.pred_reasons.append((u.code, str(u), u.node))
                     spec = self._alloc_pred(node, params)
                     kernel.preds.append(spec)
                     s = comp.slot("pred", spec.pred_id)
@@ -1021,8 +1102,10 @@ class ConditionSetCompiler:
 
         try:
             template = compile_tree(cond)
-        except Unsupported:
+        except Unsupported as u:
             # runtime-referencing conditions can't be batched at all
+            _count_unsupported(u.code)
+            kernel.oracle_reason = (u.code, str(u), u.node)
             kernel.emit = None
             return kernel
 

@@ -15,7 +15,6 @@ tensors are batch-aligned so the same jit works single-chip or multi-chip
 
 from __future__ import annotations
 
-import functools
 import threading
 import time
 from typing import Any, Optional

@@ -33,7 +33,7 @@ reports as (False, True).
 from __future__ import annotations
 
 import ipaddress
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 from ..cel import ast as A
 from ..cel.values import values_equal

@@ -12,7 +12,7 @@ correctness property.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional
 
 import numpy as np
@@ -28,7 +28,6 @@ from .columns import (
 )
 from .condcompile import evaluate_pred_host
 from .lowering import (
-    EFFECT_ALLOW_CODE,
     EFFECT_DENY_CODE,
     EFFECT_NONE,
     LoweredTable,
@@ -1019,7 +1018,7 @@ class Packer:
                         memoryview(t), memoryview(h), memoryview(l),
                         memoryview(s), memoryview(nn), memoryview(st),
                     )
-                    groupable &= t != 5  # TAG_OTHER: containers don't key
+                    groupable &= t != TAG_OTHER  # containers don't key
                     # ints the double key can't represent exactly never
                     # group; the subtype column keeps int 1 and double 1.0
                     # (CEL-distinct) in separate groups

@@ -20,7 +20,6 @@ import shutil
 import tempfile
 import threading
 import time
-from typing import Optional
 
 _log = logging.getLogger("cerbos_tpu.profiler")
 
@@ -83,7 +82,7 @@ def _prune(base: str, keep: int) -> None:
 def _run_trace(path: str, seconds: float) -> None:
     """Separated for testability: the actual jax capture."""
     try:
-        import jax
+        import jax  # noqa: F401  (availability probe: surface ImportError here)
         from jax import profiler as jprof
     except Exception as e:  # pragma: no cover - jax is a hard dep in practice
         raise ProfilerUnavailable(f"jax profiler unavailable: {e}") from e

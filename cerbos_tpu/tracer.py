@@ -8,7 +8,7 @@ a check and renders them as the wire-format trace list."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional
 
 from .engine import types as T

@@ -5,7 +5,7 @@ import pytest
 from cerbos_tpu.cel import CelError, parse, evaluate, check
 from cerbos_tpu.cel.checker import CheckError
 from cerbos_tpu.cel.interp import Activation, Message
-from cerbos_tpu.cel.values import Duration, Timestamp, UInt
+from cerbos_tpu.cel.values import Timestamp, UInt
 
 
 def ev(src, vars=None, now=None):

@@ -1,0 +1,197 @@
+"""The Unsupported taxonomy: every raise site carries a stable reason code.
+
+Two enforcement layers:
+
+1. A parametrized case per reason code in :data:`condcompile.REASONS`,
+   driving the condition compiler with a minimal expression that hits the
+   corresponding raise site and asserting the kernel's audit trail
+   (pred_reasons / oracle_reason) records exactly that code.
+2. A source scan asserting every ``raise Unsupported(`` in condcompile.py
+   passes ``code=`` with a key of REASONS — a new raise site added without
+   a registered code fails here before it ships free-text-only.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+
+import pytest
+
+from cerbos_tpu.cel.parser import parse
+from cerbos_tpu.compile import (
+    CompiledCondition,
+    CompiledExpr,
+    CompiledVariable,
+    PolicyParams,
+)
+from cerbos_tpu.tpu import condcompile
+from cerbos_tpu.tpu.columns import StringInterner
+from cerbos_tpu.tpu.condcompile import FALLBACK_REASONS, REASONS, ConditionSetCompiler
+
+EMPTY = PolicyParams()
+
+
+def _cond(src: str) -> CompiledCondition:
+    return CompiledCondition(kind="expr", expr=CompiledExpr(original=src, node=parse(src)))
+
+
+def _params(variables: dict[str, str] | None = None, constants: dict | None = None) -> PolicyParams:
+    return PolicyParams(
+        constants=dict(constants or {}),
+        ordered_variables=tuple(
+            CompiledVariable(name=n, expr=CompiledExpr(original=s, node=parse(s)))
+            for n, s in (variables or {}).items()
+        ),
+    )
+
+
+# code -> (expression, params, expect_oracle_only). Each expression is the
+# smallest condition that reaches the raise site tagged with that code.
+CASES: dict[str, tuple[str, PolicyParams, bool]] = {
+    # inlining failures fire before the expr-level catch can allocate a
+    # predicate column (the predicate would reference the same undefined
+    # name), so these four class the whole kernel oracle-only
+    "inline_too_deep": ("V.loop", _params(variables={"loop": "V.loop"}), True),
+    "undefined_variable": ("V.nope", EMPTY, True),
+    "undefined_constant": ("C.nope", EMPTY, True),
+    "undefined_global": ("G.nope", EMPTY, True),
+    "non_literal_list_element": ("R.attr.x in [R.attr.y]", EMPTY, False),
+    "operand_unsupported": ("size(R.attr.x) == 1", EMPTY, False),
+    "unsupported_function": ('startsWith(R.attr.x, "a")', EMPTY, False),
+    "non_bool_literal": ("1", EMPTY, False),
+    "unsupported_bool_expr": ("[1, 2]", EMPTY, False),
+    "has_on_non_path": ("has(V.obj.foo)", _params(variables={"obj": "[1]"}), False),
+    "bad_timestamp_constant": (
+        'timestamp(R.attr.t) < timestamp("garbage")',
+        EMPTY,
+        False,
+    ),
+    "mixed_timestamp_equality": ("timestamp(R.attr.t) == R.attr.x", EMPTY, False),
+    "const_const_equality": ("1 == 2", EMPTY, False),
+    "list_equality": ('R.attr.x == ["a"]', EMPTY, False),
+    "unsupported_equality_constant": ('R.attr.x == b"ab"', EMPTY, False),
+    "mixed_timestamp_ordering": ("timestamp(R.attr.t) < R.attr.x", EMPTY, False),
+    "const_const_ordering": ("1 < 2", EMPTY, False),
+    "string_ordering_constant": ('R.attr.x < "m"', EMPTY, False),
+    "non_numeric_ordering_constant": ("R.attr.x < true", EMPTY, False),
+    "nan_ordering_constant": (
+        "R.attr.x < C.nanval",
+        _params(constants={"nanval": math.nan}),
+        False,
+    ),
+    "unsupported_membership": ("1 in R.attr.y", EMPTY, False),
+    # runtime-referencing conditions can't even become predicate columns:
+    # the whole kernel goes oracle-only and the code lands in oracle_reason
+    "operand_unsupported@runtime": (
+        '"admin" in runtime.effectiveDerivedRoles',
+        EMPTY,
+        True,
+    ),
+}
+
+
+def _kernel_codes(src: str, params: PolicyParams):
+    comp = ConditionSetCompiler({}, StringInterner())
+    cid = comp.cond_id(_cond(src), params)
+    k = comp.kernels[cid]
+    pred_codes = {c for c, _msg, _node in k.pred_reasons}
+    oracle_code = k.oracle_reason[0] if k.oracle_reason is not None else None
+    return k, pred_codes, oracle_code
+
+
+@pytest.mark.parametrize("case", sorted(CASES), ids=sorted(CASES))
+def test_reason_code_assigned(case):
+    code = case.split("@", 1)[0]
+    src, params, oracle_only = CASES[case]
+    k, pred_codes, oracle_code = _kernel_codes(src, params)
+    if oracle_only:
+        assert k.emit is None, f"{src!r} should be oracle-only"
+        assert oracle_code == code
+    else:
+        assert k.emit is not None, f"{src!r} should fall back to a predicate column"
+        assert code in pred_codes, f"{src!r} recorded {pred_codes}, wanted {code}"
+        # the audit trail carries the offending node for source positions
+        assert any(c == code and node is not None for c, _m, node in k.pred_reasons)
+
+
+def test_every_reason_code_exercised():
+    exercised = {c.split("@", 1)[0] for c in CASES}
+    assert exercised == set(REASONS), (
+        "REASONS and the case table drifted apart: "
+        f"missing={set(REASONS) - exercised} extra={exercised - set(REASONS)}"
+    )
+
+
+def test_pred_reasons_counted_in_metrics():
+    from cerbos_tpu.observability import metrics
+
+    vec = metrics().counter_vec(
+        "cerbos_tpu_cond_compile_unsupported_total",
+        "Condition fragments rejected by the device compiler, by stable reason code",
+    )
+    before = vec.get("const_const_equality")
+    _kernel_codes("1 == 2", EMPTY)
+    assert vec.get("const_const_equality") == before + 1
+
+
+SRC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "cerbos_tpu",
+    "tpu",
+    "condcompile.py",
+)
+
+
+def _raise_statements(text: str) -> list[str]:
+    """Every ``raise Unsupported(...)`` statement, joined across lines."""
+    out = []
+    for m in re.finditer(r"raise Unsupported\(", text):
+        depth = 0
+        for i in range(m.end() - 1, len(text)):
+            if text[i] == "(":
+                depth += 1
+            elif text[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    out.append(text[m.start() : i + 1])
+                    break
+    return out
+
+
+def test_every_raise_site_has_registered_code():
+    with open(SRC_PATH, encoding="utf-8") as f:
+        text = f.read()
+    sites = _raise_statements(text)
+    assert sites, "no raise sites found — scan is broken"
+    codes_seen = set()
+    for stmt in sites:
+        m = re.search(r"code=\"([a-z_]+)\"", stmt)
+        assert m, f"raise site without a stable code=: {stmt}"
+        assert m.group(1) in REASONS, f"code {m.group(1)!r} not registered in REASONS"
+        codes_seen.add(m.group(1))
+        assert "node=" in stmt, f"raise site without node= (source positions): {stmt}"
+    assert codes_seen == set(REASONS), (
+        f"REASONS drift: unraised={set(REASONS) - codes_seen} "
+        f"unregistered={codes_seen - set(REASONS)}"
+    )
+
+
+def test_fallback_reasons_registered():
+    # the fallback-tag audit trail uses its own registry; every reason the
+    # compiler records must be documented there
+    comp = ConditionSetCompiler({}, StringInterner())
+    cid = comp.cond_id(_cond("R.attr.x == R.attr.y"), EMPTY)
+    k = comp.kernels[cid]
+    assert k.fallback_tags, "path==path equality should register fallback tags"
+    for path, reasons in k.fallback_reasons.items():
+        assert path in k.fallback_tags
+        for r in reasons:
+            assert r in FALLBACK_REASONS
+
+
+def test_unsupported_carries_code_and_node_defaults():
+    err = condcompile.Unsupported("boom")
+    assert err.code == "unsupported"
+    assert err.node is None

@@ -4,8 +4,6 @@ covering the same behaviors: RBAC, ABAC conditions, derived roles, principal
 policy precedence, scope hierarchies, scope permissions, role policies with
 synthetic denies, wildcards, outputs, and default-deny."""
 
-import yaml
-import pytest
 
 from cerbos_tpu.compile import compile_policy_set
 from cerbos_tpu.engine import CheckInput, Engine, EvalParams, Principal, Resource

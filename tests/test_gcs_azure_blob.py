@@ -16,7 +16,7 @@ import urllib.parse
 
 import pytest
 
-from cerbos_tpu.storage.azure_blob import AzureBlobClient, shared_key_signature
+from cerbos_tpu.storage.azure_blob import shared_key_signature
 from cerbos_tpu.storage.blob import BlobStore
 from cerbos_tpu.storage.gcs import GCSClient
 

@@ -196,7 +196,6 @@ class TestGoldenDecisionLogs:
         ids=_id,
     )
     def test_decision_logs(self, strict_engine, case_tuple):
-        from cerbos_tpu.audit import InMemoryTransport, KafkaBackend
         from cerbos_tpu.audit.log import AuditLog
 
         from golden_loader import parse_input

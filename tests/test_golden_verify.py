@@ -15,7 +15,6 @@ import re
 import pytest
 import yaml
 
-from cerbos_tpu import namer
 from cerbos_tpu.verify.results import Config, verify
 from golden_loader import golden_engine
 

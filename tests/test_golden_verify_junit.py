@@ -8,11 +8,9 @@ from the txtar archive against the golden store engine, build JUnit XML
 import os
 
 import pytest
-import yaml
 
 from cerbos_tpu.verify.junit import build
 from cerbos_tpu.verify.results import Config, verify
-from golden_loader import golden_engine
 from test_golden_verify import expand_txtar
 
 CORPUS = os.path.join(os.path.dirname(__file__), "golden", "verify_junit", "cases")

@@ -498,6 +498,41 @@ class TestMetricsRelabel:
         assert 'cerbos_tpu_ipc_full_total{worker="batcher",transport="uds"} 1' in merged
         assert 'cerbos_tpu_ipc_ring_depth{worker="batcher",transport="shm"} 3' in merged
 
+    def test_relabel_and_merge_cover_policy_analysis_families(self):
+        """The PR 14 static-analysis families are multi-label gauges and
+        reason-coded counters; both processes publish them (the batcher
+        owns the live table, a front end may analyze a candidate bundle),
+        so the merged scrape must keep each worker's verdicts distinct."""
+        batcher = (
+            "# TYPE cerbos_tpu_policy_analysis_total gauge\n"
+            'cerbos_tpu_policy_analysis_total{class="device",reason="ok"} 75\n'
+            'cerbos_tpu_policy_analysis_total{class="oracle-only",reason="operand_unsupported"} 3\n'
+            "# TYPE cerbos_tpu_cond_compile_unsupported_total counter\n"
+            'cerbos_tpu_cond_compile_unsupported_total{reason="unsupported_membership"} 3\n'
+        )
+        fe = (
+            "# TYPE cerbos_tpu_policy_analysis_total gauge\n"
+            'cerbos_tpu_policy_analysis_total{class="tagged-fallback",reason="eq_collection_operand"} 49\n'
+            "# TYPE cerbos_tpu_cond_compile_unsupported_total counter\n"
+            'cerbos_tpu_cond_compile_unsupported_total{reason="undefined_global"} 1\n'
+        )
+        b_rel = relabel_metrics_text(batcher, "worker", "batcher")
+        fe_rel = relabel_metrics_text(fe, "worker", "fe0")
+        assert (
+            'cerbos_tpu_policy_analysis_total{worker="batcher",class="oracle-only",reason="operand_unsupported"} 3'
+            in b_rel
+        )
+        merged = merge_metrics_texts(b_rel, fe_rel)
+        assert merged.count("# TYPE cerbos_tpu_policy_analysis_total gauge") == 1
+        assert merged.count("# TYPE cerbos_tpu_cond_compile_unsupported_total counter") == 1
+        assert 'cerbos_tpu_policy_analysis_total{worker="batcher",class="device",reason="ok"} 75' in merged
+        assert (
+            'cerbos_tpu_policy_analysis_total{worker="fe0",class="tagged-fallback",reason="eq_collection_operand"} 49'
+            in merged
+        )
+        assert 'cerbos_tpu_cond_compile_unsupported_total{worker="batcher",reason="unsupported_membership"} 3' in merged
+        assert 'cerbos_tpu_cond_compile_unsupported_total{worker="fe0",reason="undefined_global"} 1' in merged
+
 
 class TestTransportMetricsLint:
     def test_ipc_families_register_with_transport_labels(self, tmp_path, rt):

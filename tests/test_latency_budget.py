@@ -28,7 +28,6 @@ from cerbos_tpu.compile import compile_policy_set
 from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
 from cerbos_tpu.engine import budget as budget_mod
 from cerbos_tpu.engine import flight
-from cerbos_tpu.engine import pressure as pressure_mod
 from cerbos_tpu.engine.batcher import BatchingEvaluator, DeadlineExceeded
 from cerbos_tpu.engine.budget import (
     OUTCOME_EXPIRED,

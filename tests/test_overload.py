@@ -16,7 +16,7 @@ import pytest
 
 from cerbos_tpu import observability as obs
 from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
-from cerbos_tpu.engine import brownout, flight, pressure
+from cerbos_tpu.engine import flight
 from cerbos_tpu.engine.admission import (
     AdmissionController,
     OverloadRefused,

@@ -7,7 +7,6 @@ JSON), :vars, :load of a policy dir, :rules, :exec with concrete results,
 :exec producing a RESIDUAL for missing attributes, and :reset.
 """
 
-import os
 
 import pytest
 

@@ -10,7 +10,6 @@ must agree.
 
 import random
 
-import numpy as np
 import pytest
 
 import cerbos_tpu.ruletable.index as index_mod

@@ -2,7 +2,6 @@
 server with a disk store (modeled on internal/server/tests.go)."""
 
 import json
-import os
 import time
 import urllib.request
 

@@ -14,11 +14,7 @@ import pytest
 from cerbos_tpu.compile import compile_policy_set
 from cerbos_tpu.engine import CheckInput, EvalParams, Principal, Resource
 from cerbos_tpu.engine.faults import FaultInjector
-from cerbos_tpu.engine.health import DeviceHealth
-from cerbos_tpu.engine.shards import (
-    ShardedBatchingEvaluator,
-    build_shard_pool,
-)
+from cerbos_tpu.engine.shards import build_shard_pool
 from cerbos_tpu.observability import metrics
 from cerbos_tpu.policy.parser import parse_policies
 from cerbos_tpu.ruletable import build_rule_table, check_input

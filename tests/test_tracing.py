@@ -214,6 +214,12 @@ class TestMetricsLint:
                 "cerbos_tpu_pressure_fallback",
                 "cerbos_tpu_pressure_degraded",
                 "cerbos_tpu_pressure_compile",
+                # static policy analysis family (tpu/analyze.py): bootstrap
+                # publishes a report for the boot table and re-publishes on
+                # every swap; the compile-rejection counter registers with
+                # the condition compiler itself
+                "cerbos_tpu_policy_analysis_total",
+                "cerbos_tpu_cond_compile_unsupported_total",
             ):
                 assert name in inst, name
             known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.GaugeVec, obs.Histogram, obs.HistogramVec)
