@@ -8,11 +8,12 @@ PYTEST_FLAGS ?= -q -p no:cacheprovider
 
 TRANSPORT_TESTS := tests/test_shm_transport.py tests/test_ipc.py tests/test_latency_budget.py
 OVERLOAD_TESTS := tests/test_overload.py
+PLAN_TESTS := tests/test_plan_batch.py
 # the native-touching suites: codec round-trips, frame rings, truncation fuzz
 ASAN_TESTS := tests/test_native.py tests/test_shm_transport.py
 
 .PHONY: all native native-asan clean test test-transport test-overload \
-	test-native-asan lint
+	test-plan test-native-asan lint
 
 all: native
 
@@ -39,6 +40,14 @@ test-transport: native
 test-overload: native
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(OVERLOAD_TESTS) $(PYTEST_FLAGS)
 	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(OVERLOAD_TESTS) $(PYTEST_FLAGS)
+
+# batched PlanResources suite (-m plan_batch) on both codec legs: plan
+# refusals surface through the same reply codec as check refusals, so the
+# chaos leg (plan shed loses zero check requests) must hold with the
+# native shm codec present and with the uds marshal fallback.
+test-plan: native
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(PLAN_TESTS) $(PYTEST_FLAGS) -m plan_batch
+	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(PLAN_TESTS) $(PYTEST_FLAGS) -m plan_batch
 
 # ASan/UBSan leg: rebuild the native module instrumented, run the suites
 # that exercise the C++ codec/ring paths (incl. the truncation fuzzers),

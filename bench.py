@@ -206,6 +206,130 @@ def index_only_main(smoke: bool) -> int:
     return 0 if parity_ok else 1
 
 
+def _plan_queries(n: int) -> list:
+    """PlanResources sweep derived from the classic check workload: every
+    CheckInput becomes a PlanInput whose resource attributes are all KNOWN
+    (a list-endpoint pre-filter planning against concrete rows), so the
+    ternary device path should settle most (query, condition) cells and
+    only time-dependent / analyzer-refused conditions stay symbolic."""
+    from cerbos_tpu.plan.types import PlanInput
+
+    out = []
+    for inp in bench_corpus.requests(n, N_MODS):
+        out.append(
+            PlanInput(
+                request_id=inp.request_id,
+                actions=list(inp.actions),
+                principal=inp.principal,
+                resource_kind=inp.resource.kind,
+                resource_attr=dict(inp.resource.attr),
+                resource_policy_version=inp.resource.policy_version,
+                resource_scope=inp.resource.scope,
+                aux_data=inp.aux_data,
+            )
+        )
+    return out
+
+
+PLAN_POOL = 24  # distinct (principal, action, kind) archetypes in the replay sweep
+
+
+def _plan_replay(n: int, pool: int) -> list:
+    """Serving-shaped plan sweep: ``pool`` distinct archetypes replayed to
+    ``n`` queries under fresh request ids. PlanResources traffic looks like
+    this in production — every list-endpoint hit re-plans the same
+    (principal, action, kind) triple — which is exactly the shape the
+    batched planner's dedup collapses; the cold sweep below keeps it honest
+    on all-distinct input."""
+    import dataclasses
+    import random
+
+    archetypes = _plan_queries(pool)
+    rng = random.Random(41)
+    out = []
+    for i in range(n):
+        a = rng.choice(archetypes)
+        out.append(dataclasses.replace(a, request_id=f"replay-{i}"))
+    return out
+
+
+def _plan_ab(sequential, batched, queries, params, reps) -> tuple[float, float, int]:
+    """(seq_qps, batched_qps, parity mismatches) over one sweep; the parity
+    pass doubles as warmup for both paths."""
+    want = [json.dumps(sequential.plan(q, params).to_json(), sort_keys=True) for q in queries]
+    have = [json.dumps(o.to_json(), sort_keys=True) for o in batched.plan_batch(queries, params)]
+    mismatches = sum(1 for w, h in zip(want, have) if w != h)
+
+    t_seq = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for q in queries:
+            sequential.plan(q, params)
+        t_seq = min(t_seq, time.perf_counter() - t0)
+    t_bat = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        batched.plan_batch(queries, params)
+        t_bat = min(t_bat, time.perf_counter() - t0)
+    return len(queries) / t_seq, len(queries) / t_bat, mismatches
+
+
+def plan_only_main(smoke: bool) -> int:
+    """--plan: batched-vs-sequential PlanResources A/B + filter-AST parity.
+
+    Two sweeps through the sequential ``Planner`` and the vectorized
+    ``BatchPlanner`` on the same rule table: a serving-shaped replay
+    (bounded archetype pool — the headline number) and a memo-cold sweep of
+    all-distinct queries (the dedup-free floor). Fails (exit 1) on any
+    byte-level serialized-filter divergence in either sweep. Single process,
+    one core under JAX_PLATFORMS=cpu. Prints one JSON line.
+    """
+    from cerbos_tpu.plan import BatchPlanner, Planner
+
+    n_queries = 256 if smoke else 2048
+    policies = list(parse_policies(bench_corpus.corpus_yaml(N_MODS)))
+    rt = build_rule_table(compile_policy_set(policies))
+    params = EvalParams()
+    replay = _plan_replay(n_queries, PLAN_POOL)
+    cold = _plan_queries(n_queries)
+    print(
+        f"plan sweep: {len(replay)} replay ({PLAN_POOL} archetypes) + "
+        f"{len(cold)} cold queries over {len(policies)} policy docs",
+        flush=True,
+    )
+
+    sequential = Planner(rt)
+    batched = BatchPlanner(rt)
+    reps = 2 if smoke else 5
+
+    seq_qps, bat_qps, bad_replay = _plan_ab(sequential, batched, replay, params, reps)
+    cold_seq, cold_bat, bad_cold = _plan_ab(sequential, batched, cold, params, reps)
+    mismatches = bad_replay + bad_cold
+    parity_ok = mismatches == 0
+    print(f"filter-AST parity: {'ok' if parity_ok else f'{mismatches} DIVERGENT'}", flush=True)
+
+    st = batched.stats.as_dict()
+    rules_total = st["device_rules"] + st["symbolic_rules"]
+    record = {
+        "metric": "plan_queries_per_sec",
+        "value": round(bat_qps, 1),
+        "sequential": round(seq_qps, 1),
+        "speedup": round(bat_qps / seq_qps, 2),
+        "cold_speedup": round(cold_bat / cold_seq, 2),
+        "cold_queries_per_sec": round(cold_bat, 1),
+        "queries": len(replay),
+        "pool": PLAN_POOL,
+        "parity": "ok" if parity_ok else f"{mismatches} divergent",
+        "mode": batched._mode(),
+        "device_query_share": round(st["device_queries"] / max(st["queries"], 1), 3),
+        "memo_query_share": round(st["memo_queries"] / max(st["queries"], 1), 3),
+        "residual_rule_share": round(st["symbolic_rules"] / max(rules_total, 1), 4),
+        "stats": st,
+    }
+    print(json.dumps(record))
+    return 0 if parity_ok else 1
+
+
 def _merged_percentile(buckets: list, counts: list, count: int, p: float) -> float:
     """Histogram.percentile over shard-merged bucket counts."""
     if count == 0:
@@ -576,6 +700,10 @@ def main() -> None:
         help="memo-cold rule-index micro-bench + bitmap/legacy parity check only",
     )
     parser.add_argument(
+        "--plan", action="store_true",
+        help="batched-vs-sequential PlanResources A/B + filter-AST parity gate only",
+    )
+    parser.add_argument(
         "--served", action="store_true",
         help="measure through the real BatchingEvaluator serving path "
         "(concurrent clients, cross-request batching, streaming pipeline)",
@@ -604,6 +732,8 @@ def main() -> None:
     args = parser.parse_args()
     if args.index_only:
         sys.exit(index_only_main(smoke=args.smoke))
+    if args.plan:
+        sys.exit(plan_only_main(smoke=args.smoke))
     if args.served:
         sys.exit(
             served_main(

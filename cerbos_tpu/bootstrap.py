@@ -530,6 +530,39 @@ def initialize(
 
     manager.on_swap = with_analysis
 
+    # batched PlanResources: attach a BatchPlanner to the (first) batcher
+    # lane so concurrent plan queries coalesce into vectorized partial-
+    # evaluation flights on the plan lane. The planner owns its own lowered
+    # table (no interner sharing with check batches) and refreshes on swap.
+    plan_batcher = None
+    plan_lane = None
+    if batcher is not None:
+        plan_lane = batcher.shards[0] if hasattr(batcher, "shards") else batcher
+        if not hasattr(plan_lane, "plan_planner"):
+            plan_lane = None
+    if plan_lane is not None:
+        from .plan import BatchPlanner
+
+        try:
+            batch_planner = BatchPlanner(
+                manager.rule_table,
+                schema_mgr=schema_mgr,
+                globals_=engine_globals,
+                use_jax=bool(getattr(tpu_evaluator, "use_jax", False)),
+            )
+            plan_lane.plan_planner = batch_planner
+            plan_batcher = plan_lane
+            _prev_plan = manager.on_swap
+
+            def with_batch_planner(rt) -> None:
+                if _prev_plan is not None:
+                    _prev_plan(rt)
+                batch_planner.refresh(rt)
+
+            manager.on_swap = with_batch_planner
+        except Exception:
+            _log.exception("batched planner unavailable; PlanResources stays sequential")
+
     service = CerbosService(
         engine,
         aux_data_mgr=aux_mgr,
@@ -539,6 +572,7 @@ def initialize(
         ),
         audit_log=audit_log,
         planner=planner,
+        plan_batcher=plan_batcher,
     )
     return Core(
         config=config,

@@ -100,6 +100,10 @@ class _Pending:
     # admission priority class ('' = unclassified → the default lane);
     # selects the weighted priority lane this request queues in
     pclass: str = ""
+    # "check" pendings carry CheckInputs for the device evaluator; "plan"
+    # pendings carry PlanInputs for the attached batched planner and ride
+    # the dedicated low-priority plan lane
+    kind: str = "check"
 
 
 class _Lane:
@@ -249,6 +253,7 @@ class _Inflight:
     submitted_wall_ns: int = 0
     occupancy: float = 1.0
     layout_key: Optional[str] = None
+    kind: str = "check"
 
 
 class _ShardStageView:
@@ -342,6 +347,11 @@ class BatchingEvaluator:
         # when set, completed device batches are offered for shadow-oracle
         # sampling from the drain thread
         self.sentinel: Optional[Any] = None
+        # batched planner (plan/batch.py BatchPlanner), attached
+        # post-construction; when set, plan() coalesces PlanResources
+        # queries into vectorized partial-evaluation flights on the same
+        # drain loop, riding the low-priority "plan" lane
+        self.plan_planner: Optional[Any] = None
         self.quarantine_max = max(1, int(quarantine_max))
         self.bisect_budget = max(3, int(bisect_budget))
         self._queue = _PriorityLanes()
@@ -362,6 +372,9 @@ class BatchingEvaluator:
             "deadline_drops": 0,
             "quarantined": 0,
             "lane_refusals": 0,
+            "plan_batches": 0,
+            "plan_requests": 0,
+            "plan_fallbacks": 0,
         }
         self._init_metrics()
         tname = "check-batcher" if shard_id is None else f"check-batcher-s{shard_id}"
@@ -456,12 +469,23 @@ class BatchingEvaluator:
 
     # -- request path -------------------------------------------------------
 
+    # queued plan queries beyond this refuse with OverloadRefused instead of
+    # growing an unbounded analytical backlog behind interactive checks
+    PLAN_QUEUE_BUDGET = 256
+
     def configure_lanes(self, lane_confs) -> None:
         """Install the weighted priority lanes (one per admission class,
         plus the default catch-all) from (name, priority, weight,
-        queue_budget) tuples — ``AdmissionController.lane_confs()``."""
+        queue_budget) tuples — ``AdmissionController.lane_confs()``. A
+        "plan" lane is appended below every configured band unless the
+        config names one explicitly: plan queries are analytical traffic
+        that must never preempt an interactive check."""
+        confs = list(lane_confs or ())
+        if confs and not any(str(c[0]) == "plan" for c in confs):
+            floor = max(int(c[1]) for c in confs)
+            confs.append(("plan", floor + 1, 1, self.PLAN_QUEUE_BUDGET))
         with self._wakeup:
-            self._queue.configure(lane_confs)
+            self._queue.configure(confs)
 
     def lane_depths(self) -> dict[str, int]:
         with self._lock:
@@ -598,6 +622,81 @@ class BatchingEvaluator:
             _settle(fut, error=_BatchFailed(None, "queue_budget"))
         return fut
 
+    # -- plan path ----------------------------------------------------------
+
+    def plan(
+        self,
+        inputs: Sequence[Any],
+        params: Optional[T.EvalParams] = None,
+        deadline: Optional[float] = None,
+        wf: Optional[Waterfall] = None,
+    ) -> list[Any]:
+        """Batched PlanResources: enqueue PlanInputs on the low-priority
+        "plan" lane and let the drain loop coalesce concurrent queries into
+        one vectorized partial-evaluation flight (plan/batch.py). Failures
+        fall back to the sequential planner per query — a plan query never
+        errors because a co-batched sibling did."""
+        planner = self.plan_planner
+        if planner is None:
+            raise RuntimeError("no batched planner attached to this batcher")
+        if deadline is not None and time.monotonic() >= deadline:
+            self._count_deadline_drop()
+            raise DeadlineExceeded("plan deadline expired before evaluation")
+        if self._stop or self._dead is not None or not self._thread.is_alive():
+            return self._serve_plan_sequential(inputs, params, "batcher_dead", wf=wf)
+        with start_span("batcher.plan_enqueue", inputs=len(inputs)) as span:
+            fut: Future = Future()
+            pending = _Pending(
+                list(inputs), params, fut, deadline=deadline, ctx=span.context, wf=wf,
+                pclass="plan", kind="plan",
+            )
+            self._admit_wf(wf, deadline)
+            if not self._enqueue(pending):
+                span.set_attribute("outcome", "queue_budget")
+                raise OverloadRefused("plan", "queue_budget", retry_after=0.1)
+            wait = self.request_timeout
+            if deadline is not None:
+                wait = min(wait, max(0.0, deadline - time.monotonic()))
+            try:
+                return fut.result(timeout=wait)
+            except DeadlineExceeded:
+                span.set_attribute("outcome", "deadline_exceeded")
+                raise
+            except _BatchFailed as e:
+                span.set_attribute("outcome", e.reason)
+                return self._serve_plan_sequential(pending.inputs, params, e.reason, wf=wf)
+            except (TimeoutError, FutureTimeoutError):
+                with self._wakeup:
+                    try:
+                        self._queue.remove(pending)
+                    except ValueError:
+                        pass
+                if deadline is not None and time.monotonic() >= deadline:
+                    self._count_deadline_drop()
+                    raise DeadlineExceeded("plan deadline expired while queued") from None
+                span.set_attribute("outcome", "timeout")
+                return self._serve_plan_sequential(pending.inputs, params, "timeout", wf=wf)
+
+    def _serve_plan_sequential(
+        self,
+        inputs: Sequence[Any],
+        params: Optional[T.EvalParams],
+        reason: str,
+        wf: Optional[Waterfall] = None,
+    ) -> list[Any]:
+        """Per-query recovery through the sequential walk of the attached
+        planner (BatchPlanner extends Planner; without a batch context every
+        rule routes symbolically, which is exactly the reference path)."""
+        self.stats["plan_fallbacks"] += 1
+        self.m_oracle_fallbacks.inc(f"plan_{reason}")
+        if wf is not None:
+            wf.note_fallback(f"plan_{reason}")
+        planner = self.plan_planner
+        out = [planner.plan(i, params) for i in inputs]
+        if wf is not None:
+            wf.mark(STAGE_ORACLE)
+        return out
+
     def _admit_wf(self, wf: Optional[Waterfall], deadline: Optional[float]) -> None:
         """Book the admission stage at enqueue and sample the remaining
         deadline budget at the enqueue point."""
@@ -722,13 +821,17 @@ class BatchingEvaluator:
                 self.m_inflight.set(len(inflight))
 
     def _submit(self, pending: list[_Pending], inflight: deque) -> None:
-        # group by params identity (globals etc. must match within a batch)
-        groups: dict[int, list[_Pending]] = {}
+        # group by (kind, params identity): globals etc. must match within a
+        # batch, and plan pendings must never mix into a device check batch
+        groups: dict[tuple[str, int], list[_Pending]] = {}
         for p in pending:
-            groups.setdefault(id(p.params), []).append(p)
+            groups.setdefault((p.kind, id(p.params)), []).append(p)
         now = time.perf_counter()
         shard = self.shard_id if self.shard_id is not None else 0
         for group in groups.values():
+            if group[0].kind == "plan":
+                self._submit_plan(group, inflight, now)
+                continue
             all_inputs: list[T.CheckInput] = []
             for p in group:
                 all_inputs.extend(p.inputs)
@@ -807,7 +910,75 @@ class BatchingEvaluator:
             if depth > self.stats["inflight_peak"]:
                 self.stats["inflight_peak"] = depth
 
+    def _submit_plan(self, group: list[_Pending], inflight: deque, now: float) -> None:
+        """One coalesced plan flight: run the batched planner synchronously
+        (plan_batch is host-driven — there is no streaming ticket to overlap)
+        and park the ready outputs in the inflight window for settle."""
+        all_inputs: list[Any] = []
+        for p in group:
+            all_inputs.extend(p.inputs)
+            self.m_queue_wait.observe(now - p.enqueued_at)
+            if p.wf is not None:
+                p.wf.mark(STAGE_QUEUE_WAIT)
+        batch_id = flight_recorder().next_batch_id()
+        links = [p.ctx for p in group if p.ctx is not None]
+        parent = links[0] if links else None
+        try:
+            with start_span(
+                "plan_batch.submit",
+                parent=parent,
+                links=links,
+                batch_id=batch_id,
+                requests=len(group),
+                inputs=len(all_inputs),
+            ) as span:
+                batch_ctx = span.context
+                ticket = _ReadyTicket(self.plan_planner.plan_batch(all_inputs, group[0].params))
+        except Exception as e:  # noqa: BLE001
+            self._batch_failed(group, all_inputs, e, batch_id=batch_id)
+            return
+        self.stats["plan_batches"] += 1
+        self.stats["plan_requests"] += len(group)
+        flight = _Inflight(
+            ticket,
+            group,
+            batch_id=batch_id,
+            n_inputs=len(all_inputs),
+            batch_ctx=batch_ctx,
+            submitted_at=time.perf_counter(),
+            submitted_wall_ns=time.time_ns(),
+            kind="plan",
+        )
+        inflight.append(flight)
+        self.m_inflight.set(len(inflight))
+
+    def _collect_plan(self, flight: _Inflight) -> None:
+        group = flight.group
+        outputs = flight.ticket.outputs
+        settle_start = time.perf_counter()
+        with start_span(
+            "plan_batch.settle", parent=flight.batch_ctx, batch_id=flight.batch_id
+        ):
+            offset = 0
+            for p in group:
+                _settle(p.future, result=outputs[offset : offset + len(p.inputs)])
+                offset += len(p.inputs)
+                if p.wf is not None:
+                    p.wf.mark(STAGE_SETTLE)
+        flight.timings["settle"] = time.perf_counter() - settle_start
+        self._record_flight(flight, outcome="ok")
+        sentinel = self.sentinel
+        if sentinel is not None:
+            all_inputs: list[Any] = []
+            for p in group:
+                all_inputs.extend(p.inputs)
+            # after settle so plan parity replays never add request latency
+            sentinel.observe_plan_batch(self, all_inputs, group[0].params, outputs)
+
     def _collect(self, flight: _Inflight) -> None:
+        if flight.kind == "plan":
+            self._collect_plan(flight)
+            return
         group = flight.group
         collect_start = time.perf_counter()
         # the window between submit returning and collect starting is device
@@ -900,9 +1071,13 @@ class BatchingEvaluator:
     ) -> None:
         """A device batch raised: settle each co-batched waiter with
         _BatchFailed so they each re-serve from the oracle (never a 5xx),
-        feed the breaker, and bisect the batch off-path for poison."""
+        feed the breaker, and bisect the batch off-path for poison. Plan
+        flights settle the same way (waiters re-plan sequentially) but
+        never feed the breaker or bisect — a planner bug is not a device
+        health signal, and PlanInputs have no check fingerprint."""
+        is_plan = bool(group) and group[0].kind == "plan"
         self.stats["batch_errors"] += 1
-        if self.health is not None:
+        if self.health is not None and not is_plan:
             self.health.record_failure()
         _log.warning(
             "device batch failed; co-batched requests fall back to the CPU oracle",
@@ -920,7 +1095,8 @@ class BatchingEvaluator:
         )
         for p in group:
             _settle(p.future, error=_BatchFailed(e))
-        self._schedule_bisect(all_inputs, group[0].params)
+        if not is_plan:
+            self._schedule_bisect(all_inputs, group[0].params)
 
     # -- poison bisection + quarantine --------------------------------------
 

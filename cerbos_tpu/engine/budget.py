@@ -255,8 +255,10 @@ class BudgetTracker:
         )
         self.m_decisions = reg.counter_vec(
             "cerbos_tpu_decisions_total",
-            "Decisions by outcome: deadline_met, oracle_fallback, expired, refused (goodput = met + fallback)",
-            label="outcome",
+            "Decisions by API and outcome: deadline_met, oracle_fallback, expired, "
+            "refused (goodput = met + fallback); api=plan books PlanResources "
+            "traffic so shed_plan brownouts show as refused instead of vanishing",
+            label=("api", "outcome"),
         )
         self.m_slow = reg.counter(
             "cerbos_tpu_slow_requests_total",
@@ -322,10 +324,16 @@ class BudgetTracker:
             self._budget_children[key] = child
         child.observe(max(0.0, remaining))
 
-    def finish(self, wf: Optional[Waterfall], outcome: str, final_stage: Optional[str] = None) -> None:
+    def finish(
+        self,
+        wf: Optional[Waterfall],
+        outcome: str,
+        final_stage: Optional[str] = None,
+        api: str = "check",
+    ) -> None:
         """Count the decision and flush the waterfall's stages to the
         histograms; slower-than-threshold requests land in the slow ring."""
-        self.m_decisions.inc(outcome)
+        self.m_decisions.inc((api, outcome))
         if wf is None:
             return
         now = time.monotonic()
@@ -348,9 +356,9 @@ class BudgetTracker:
             with self._lock:
                 self._ring.append(entry)
 
-    def count(self, outcome: str) -> None:
+    def count(self, outcome: str, api: str = "check") -> None:
         """Goodput accounting for the waterfall-disabled path."""
-        self.m_decisions.inc(outcome)
+        self.m_decisions.inc((api, outcome))
 
     # -- slow ring ----------------------------------------------------------
 

@@ -222,6 +222,22 @@ class _Sample:
 
 
 @dataclass
+class _PlanSample:
+    """One sampled PLAN batch awaiting sequential replay. The plan-mode
+    parity guarantee is stronger than the check-mode one: not just effects
+    but the full serialized filter AST must match byte-for-byte."""
+
+    shard: int
+    inputs: list[Any]  # PlanInput
+    outputs: list[Any]  # PlanOutput
+    params: Optional[T.EvalParams]
+    rule_table: Any
+    schema_mgr: Any
+    batch_id: int
+    done_at: float
+
+
+@dataclass
 class _LaneState:
     """Per-shard sampler + storm-window state. The accumulator starts at 1.0
     so the FIRST completed batch on every lane is always checked — a replica
@@ -258,8 +274,13 @@ class ParitySentinel:
         self.corpus = DivergenceCorpus(corpus_dir, corpus_max)
         self._clock = clock
         self._lanes: dict[int, _LaneState] = {}
+        # plan-mode parity keeps its own sampler lanes: plan batches are
+        # rarer than check batches, so sharing an accumulator would let a
+        # busy check lane starve plan sampling (and vice versa)
+        self._plan_lanes: dict[int, _LaneState] = {}
         self._lock = threading.Lock()
         self._backlog: deque[_Sample] = deque()
+        self._inflight = 0  # popped but not yet verified (drain must wait)
         self._wakeup = threading.Condition(self._lock)
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -275,6 +296,8 @@ class ParitySentinel:
             "storms": 0,
             "replay_errors": 0,
             "replay_seconds": 0.0,
+            "plan_checks": 0,
+            "plan_divergences": 0,
         }
         self._init_metrics()
 
@@ -317,6 +340,14 @@ class ParitySentinel:
         self.m_corpus = reg.gauge(
             "cerbos_tpu_parity_corpus_records",
             "divergence records currently captured in the on-disk corpus",
+        )
+        self.m_plan_checks = reg.counter(
+            "cerbos_tpu_plan_parity_checks_total",
+            "batched PlanResources flights replayed through the sequential planner by the parity sentinel",
+        )
+        self.m_plan_divergence = reg.counter(
+            "cerbos_tpu_plan_parity_divergence_total",
+            "sampled plan batches whose serialized filter AST differed byte-for-byte from the sequential planner",
         )
         self.m_rate.set(self.sample_rate if self.enabled else 0.0)
 
@@ -422,6 +453,60 @@ class ParitySentinel:
         except Exception:  # noqa: BLE001  (diagnostics must never hurt serving)
             _log.exception("parity sentinel observe_batch failed")
 
+    def should_sample_plan(self, shard: int) -> bool:
+        """Plan-lane twin of :meth:`should_sample` — same deterministic
+        fractional accumulator, separate per-shard state, same first-batch
+        guarantee (acc starts at 1.0)."""
+        if not self.enabled or self._shed:
+            return False
+        with self._lock:
+            st = self._plan_lanes.setdefault(shard, _LaneState())
+            st.seen += 1
+            st.acc += self.sample_rate
+            if st.acc < 1.0:
+                return False
+            st.acc -= 1.0
+            st.sampled += 1
+            return True
+
+    def observe_plan_batch(
+        self,
+        batcher: Any,
+        inputs: list[Any],
+        params: Optional[T.EvalParams],
+        outputs: list[Any],
+    ) -> None:
+        """Called after a batched-planner flight settled OK. Snapshots the
+        PlanInputs/PlanOutputs and the table the batch ran against, then
+        hands off to the replay thread, which re-plans every query through
+        an independent sequential :class:`~cerbos_tpu.plan.Planner` and
+        compares serialized filter ASTs byte-for-byte. Never raises."""
+        try:
+            shard = getattr(batcher, "shard_id", 0) or 0
+            if not self.should_sample_plan(shard):
+                return
+            planner = getattr(batcher, "plan_planner", None) or batcher
+            sample = _PlanSample(
+                shard=shard,
+                inputs=list(inputs),
+                outputs=list(outputs),
+                params=params,
+                rule_table=getattr(planner, "rt", None),
+                schema_mgr=getattr(planner, "schema_mgr", None),
+                batch_id=getattr(batcher, "_batch_seq", 0),
+                done_at=self._clock(),
+            )
+            with self._wakeup:
+                if len(self._backlog) >= self.max_backlog:
+                    self.stats["dropped"] += 1
+                    self.m_dropped.inc()
+                    return
+                self._backlog.append(sample)
+                self._wakeup.notify()
+            self._ensure_worker()
+        except Exception:  # noqa: BLE001  (diagnostics must never hurt serving)
+            _log.exception("parity sentinel observe_plan_batch failed")
+
     # -- background replay ---------------------------------------------------
 
     def _loop(self) -> None:
@@ -432,10 +517,17 @@ class ParitySentinel:
                 if self._stop and not self._backlog:
                     return
                 sample = self._backlog.popleft()
+                self._inflight += 1
             try:
-                self._verify(sample)
+                if isinstance(sample, _PlanSample):
+                    self._verify_plan(sample)
+                else:
+                    self._verify(sample)
             except Exception:  # noqa: BLE001
                 _log.exception("parity sentinel verification failed")
+            finally:
+                with self._lock:
+                    self._inflight -= 1
 
     def _verify(self, s: _Sample) -> None:
         t0 = time.perf_counter()
@@ -464,6 +556,85 @@ class ParitySentinel:
         if not diff:
             return
         self._divergence(s, device, oracle, diff, replay_error, lag)
+
+    def _verify_plan(self, s: _PlanSample) -> None:
+        """Byte-exact filter-AST parity: serialize both planners' outputs
+        with sorted keys and compare the strings. No storm trip — a plan
+        divergence is a planner bug, not a sick chip, so it is counted and
+        captured but never routes check traffic to the oracle."""
+        from ..plan import Planner
+
+        t0 = time.perf_counter()
+        replay_error = ""
+        diff: list[int] = []
+        device = [json.dumps(o.to_json(), sort_keys=True) for o in s.outputs]
+        sequential: list[str] = []
+        try:
+            planner = Planner(s.rule_table, s.schema_mgr)
+            for i in s.inputs:
+                sequential.append(
+                    json.dumps(planner.plan(i, s.params).to_json(), sort_keys=True)
+                )
+        except Exception as e:  # noqa: BLE001  (a replay crash IS a divergence signal)
+            replay_error = f"{type(e).__name__}: {e}"
+        if replay_error:
+            diff = list(range(len(device)))
+        else:
+            n = min(len(device), len(sequential))
+            diff = [i for i in range(n) if device[i] != sequential[i]]
+            diff.extend(range(n, max(len(device), len(sequential))))
+        replay_s = time.perf_counter() - t0
+        lag = max(0.0, self._clock() - s.done_at)
+        self.stats["plan_checks"] += 1
+        self.stats["checks"] += 1
+        self.stats["replay_seconds"] += replay_s
+        if replay_error:
+            self.stats["replay_errors"] += 1
+        self.m_plan_checks.inc()
+        self.m_replay_seconds.inc(replay_s)
+        self.m_lag.observe(lag)
+        if not diff:
+            return
+        self.stats["plan_divergences"] += 1
+        self.stats["divergences"] += 1
+        self.m_plan_divergence.inc()
+        record = {
+            "ts": time.time(),
+            "kind": "plan",
+            "shard": s.shard,
+            "batch_id": s.batch_id,
+            "lag_seconds": round(lag, 6),
+            "divergent_indices": diff,
+            "replay_error": replay_error,
+            "device_filters": device,
+            "sequential_filters": sequential,
+        }
+        path = None
+        try:
+            path = self.corpus.append(record)
+        except Exception:  # noqa: BLE001
+            _log.exception("failed to persist plan divergence record")
+        self.m_corpus.set(float(self.corpus.size()))
+        flight_recorder().record_event(
+            "plan_parity_divergence",
+            shard=s.shard,
+            batch_id=s.batch_id,
+            inputs=len(s.inputs),
+            divergent=len(diff),
+            corpus_path=path,
+            replay_error=replay_error or None,
+        )
+        _log.error(
+            "PLAN PARITY DIVERGENCE: batched filter AST differs from the sequential planner",
+            extra={
+                "fields": {
+                    "shard": s.shard,
+                    "inputs": len(s.inputs),
+                    "divergent": len(diff),
+                    "corpus": path,
+                }
+            },
+        )
 
     def _divergence(
         self,
@@ -580,7 +751,9 @@ class ParitySentinel:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                if not self._backlog:
+                # a popped-but-unverified sample (self._inflight) must hold
+                # drain open: stats for it land only after verification
+                if not self._backlog and not self._inflight:
                     return True
             time.sleep(0.005)
         return False
@@ -604,6 +777,8 @@ class ParitySentinel:
             "dropped": stats["dropped"],
             "storms": stats["storms"],
             "replay_errors": stats["replay_errors"],
+            "plan_checks": stats["plan_checks"],
+            "plan_divergences": stats["plan_divergences"],
             "replay_seconds": round(stats["replay_seconds"], 6),
             "lag_p99_s": round(self.m_lag.percentile(0.99), 6),
             "corpus_records": self.corpus.size(),

@@ -1,2 +1,3 @@
 from .types import PlanInput, PlanOutput  # noqa: F401
 from .planner import Planner  # noqa: F401
+from .batch import BatchPlanner  # noqa: F401

@@ -274,8 +274,7 @@ class Planner:
                     dr = drs[name]
                     if "*" not in dr.parent_roles and not (dr.parent_roles & principal_parent_roles):
                         continue
-                    dr_pe = self._pe_for(pe_factory, known, dr.params, None)
-                    node = self._cond_node(dr_pe, dr.condition)
+                    node = self._derived_role_node(pe_factory, known, dr)
                     if node is TRUE:
                         node = A.Lit(True)
                     elif node is FALSE:
@@ -317,12 +316,7 @@ class Planner:
                             # maps.Copy(effectivePolicies, GetSourceAttributes())
                             for f, attrs in rt.get_chain_source_attributes(b.origin_fqn).items():
                                 effective_policies.setdefault(f, dict(attrs))
-                        pe = self._pe_for(pe_factory, known, b.params, drl)
-                        node = self._cond_node(pe, b.condition)
-                        if b.derived_role_condition is not None:
-                            dr_pe = self._pe_for(pe_factory, known, b.derived_role_params, drl)
-                            dr_node = self._cond_node(dr_pe, b.derived_role_condition)
-                            node = dr_node if b.condition is None else _and([node, dr_node])
+                        node = self._binding_node(pe_factory, known, drl, b)
                         if b.effect == "EFFECT_ALLOW":
                             scope_allow = add_node(scope_allow, node, or2)
                         elif b.effect == "EFFECT_DENY":
@@ -397,6 +391,26 @@ class Planner:
         if is_false(root):
             return FALSE, matched_scope, matched
         return to_node(root), matched_scope, matched
+
+    # -- condition evaluation seams ---------------------------------------
+    # BatchPlanner (plan/batch.py) overrides these two to try the device
+    # ternary verdict before falling back to symbolic partial evaluation;
+    # the sequential walk above is byte-identical either way.
+
+    def _binding_node(self, pe_factory, known, drl, b):
+        """One rule binding → TRUE/FALSE/residual node."""
+        pe = self._pe_for(pe_factory, known, b.params, drl)
+        node = self._cond_node(pe, b.condition)
+        if b.derived_role_condition is not None:
+            dr_pe = self._pe_for(pe_factory, known, b.derived_role_params, drl)
+            dr_node = self._cond_node(dr_pe, b.derived_role_condition)
+            node = dr_node if b.condition is None else _and([node, dr_node])
+        return node
+
+    def _derived_role_node(self, pe_factory, known, dr):
+        """One derived-role definition → TRUE/FALSE/residual node."""
+        dr_pe = self._pe_for(pe_factory, known, dr.params, None)
+        return self._cond_node(dr_pe, dr.condition)
 
     def _pe_for(self, pe_factory, known, params_obj, drl) -> PartialEvaluator:
         var_defs = {}

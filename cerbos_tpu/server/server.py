@@ -365,7 +365,7 @@ def _grpc_rpcs(svc: CerbosService):
         if brownout_ctl.controller().active("shed_plan"):
             # staged brownout: plan queries yield to interactive checks
             brownout_ctl.controller().note_shed("plan")
-            budget_tracker().count(OUTCOME_REFUSED)
+            budget_tracker().count(OUTCOME_REFUSED, api="plan")
             ctx.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 "overloaded: plan queries are shed (brownout)",
@@ -397,7 +397,13 @@ def _grpc_rpcs(svc: CerbosService):
                 "includeMeta": req.include_meta,
             }
             resp_json, call_id = _plan_from_json(svc, body, aux)
+            budget_tracker().count(OUTCOME_MET, api="plan")
             return _plan_json_to_proto(resp_json, response_pb2)
+        except OverloadRefused as e:
+            # the batcher's plan-lane queue budget filled: same refusal
+            # surface as the brownout shed above
+            budget_tracker().count(OUTCOME_REFUSED, api="plan")
+            ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except NotImplementedError as e:
             ctx.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
         except RequestLimitExceeded as e:
@@ -1229,7 +1235,7 @@ class Server:
             # staged brownout: analytical plan traffic yields to interactive
             # checks while the ladder is at shed_plan or deeper
             brownout_ctl.controller().note_shed("plan")
-            budget_tracker().count(OUTCOME_REFUSED)
+            budget_tracker().count(OUTCOME_REFUSED, api="plan")
             return web.json_response(
                 {"code": 8, "message": "overloaded: plan queries are shed (brownout)"},
                 status=429,
@@ -1250,7 +1256,15 @@ class Server:
             resp, _call_id = await asyncio.get_running_loop().run_in_executor(
                 None, _plan_from_json, self.svc, body, aux
             )
+            budget_tracker().count(OUTCOME_MET, api="plan")
             return web.json_response(resp)
+        except OverloadRefused as e:
+            budget_tracker().count(OUTCOME_REFUSED, api="plan")
+            return web.json_response(
+                {"code": 8, "message": str(e)},
+                status=429,
+                headers={"Retry-After": retry_after_header(e)},
+            )
         except NotImplementedError as e:
             return web.json_response({"code": 12, "message": str(e)}, status=501)
         except RequestLimitExceeded as e:

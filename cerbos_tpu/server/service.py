@@ -70,12 +70,17 @@ class CerbosService:
         limits: Optional[ServiceLimits] = None,
         audit_log: Any = None,
         planner: Any = None,
+        plan_batcher: Any = None,
     ):
         self.engine = engine
         self.aux_data_mgr = aux_data_mgr
         self.limits = limits or ServiceLimits()
         self.audit_log = audit_log
         self.planner = planner
+        # a BatchingEvaluator with a BatchPlanner attached (plan lane):
+        # when present, plan queries coalesce into vectorized partial-
+        # evaluation flights instead of walking the rule table one by one
+        self.plan_batcher = plan_batcher
         self.metrics = ServiceMetrics()
 
     def _extract_aux_data(self, jwt_token: str, key_set_id: str) -> Optional[T.AuxData]:
@@ -166,11 +171,26 @@ class CerbosService:
         return outputs, call_id
 
     def plan_resources(self, input: Any, params: Optional[T.EvalParams] = None) -> tuple[Any, str]:
-        if self.planner is None:
+        if self.planner is None and self.plan_batcher is None:
             raise NotImplementedError("PlanResources is not configured")
         call_id = uuid.uuid4().hex
-        t0 = time.perf_counter()
-        output = self.planner.plan(input, params=params)
+        pb = self.plan_batcher
+        if pb is not None and getattr(pb, "plan_planner", None) is not None:
+            # plan-lane path: OverloadRefused propagates (the handlers turn
+            # it into 429/RESOURCE_EXHAUSTED and book outcome=refused);
+            # anything else degrades to the sequential walk below
+            from ..engine.admission import OverloadRefused
+
+            try:
+                output = pb.plan([input], params=params)[0]
+            except OverloadRefused:
+                raise
+            except Exception:  # noqa: BLE001
+                if self.planner is None:
+                    raise
+                output = self.planner.plan(input, params=params)
+        else:
+            output = self.planner.plan(input, params=params)
         self.metrics.plan_count += 1
         if self.audit_log is not None:
             self.audit_log.write_plan(call_id, input, output)

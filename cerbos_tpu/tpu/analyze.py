@@ -44,6 +44,12 @@ CLASS_DEVICE = "device"
 CLASS_TAGGED = "tagged-fallback"
 CLASS_ORACLE = "oracle-only"
 
+# plan-mode (PlanResources) eligibility: can BatchPlanner trust the device
+# ternary verdict for this rule, or must it always take the sequential
+# symbolic fallback? Decided statically by condcompile.plan_verdict.
+PLAN_RESIDUALIZABLE = "residualizable"
+PLAN_SYMBOLIC = "symbolic-only"
+
 KIND_ELIGIBILITY = "eligibility"
 KIND_DIVERGENCE = "divergence-risk"
 KIND_GRAPH = "policy-graph"
@@ -187,6 +193,9 @@ class RuleReport:
     fallbacks: list[dict[str, Any]] = field(default_factory=list)
     # host-predicate columns (still device-classed): [{code, message, expr, offset}]
     predicates: list[dict[str, Any]] = field(default_factory=list)
+    # plan-mode verdict + reasons when symbolic-only: [{code, reason, message, expr, offset}]
+    plan: str = PLAN_RESIDUALIZABLE
+    plan_reasons: list[dict[str, Any]] = field(default_factory=list)
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -199,6 +208,8 @@ class RuleReport:
             "reasons": self.reasons,
             "fallbacks": self.fallbacks,
             "predicates": self.predicates,
+            "plan": self.plan,
+            "plan_reasons": self.plan_reasons,
         }
 
     def primary_reason(self) -> str:
@@ -223,6 +234,13 @@ class AnalysisReport:
             out[r.eligibility] = out.get(r.eligibility, 0) + 1
         return out
 
+    def plan_counts(self) -> dict[str, int]:
+        """Plan-class histogram (the /_cerbos/debug/analysis 'Plan' block)."""
+        out = {PLAN_RESIDUALIZABLE: 0, PLAN_SYMBOLIC: 0}
+        for r in self.rules:
+            out[r.plan] = out.get(r.plan, 0) + 1
+        return out
+
     def finding_counts(self) -> dict[str, int]:
         out: dict[str, int] = {}
         for f in self.findings:
@@ -240,12 +258,20 @@ class AnalysisReport:
                 continue  # already counted through the rule classes
             key = (f.kind, f.code)
             out[key] = out.get(key, 0) + 1
+        for r in self.rules:
+            if r.plan == PLAN_SYMBOLIC:
+                code = r.plan_reasons[0]["code"] if r.plan_reasons else "unknown"
+                key = ("plan-" + PLAN_SYMBOLIC, code)
+            else:
+                key = ("plan-" + PLAN_RESIDUALIZABLE, "ok")
+            out[key] = out.get(key, 0) + 1
         return out
 
     def summary(self) -> dict[str, Any]:
         return {
             "rules": len(self.rules),
             "classes": self.class_counts(),
+            "plan": self.plan_counts(),
             "findings": self.finding_counts(),
         }
 
@@ -258,11 +284,14 @@ class AnalysisReport:
 
     def summary_line(self) -> str:
         c = self.class_counts()
+        pc = self.plan_counts()
         fc = self.finding_counts()
         return (
             f"policy analysis: {len(self.rules)} rules "
             f"({c[CLASS_DEVICE]} device, {c[CLASS_TAGGED]} tagged-fallback, "
-            f"{c[CLASS_ORACLE]} oracle-only), "
+            f"{c[CLASS_ORACLE]} oracle-only; "
+            f"plan: {pc[PLAN_RESIDUALIZABLE]} residualizable, "
+            f"{pc[PLAN_SYMBOLIC]} symbolic-only), "
             f"{fc.get(KIND_DIVERGENCE, 0)} divergence-risk, "
             f"{fc.get(KIND_GRAPH, 0)} policy-graph findings"
         )
@@ -376,6 +405,29 @@ def _classify_rule(rep: RuleReport, row: RuleRow, kernels: list[CondKernel]) -> 
         rep.eligibility = CLASS_TAGGED
     else:
         rep.eligibility = CLASS_DEVICE
+
+    # plan-mode verdict: symbolic-only as soon as ANY kernel of the rule
+    # carries a plan_reason (BatchPlanner routes per kernel, but the rule-
+    # level report answers "can this rule ever ride the device path")
+    seen_plan: set[str] = set()
+    for k in kernels:
+        if k.plan_reason is None:
+            continue
+        code, msg, node = k.plan_reason
+        if code in seen_plan:
+            continue
+        seen_plan.add(code)
+        src, off = _locate(node, conds, params)
+        rep.plan_reasons.append(
+            {
+                "code": code,
+                "reason": REASONS.get(code, code),
+                "message": msg,
+                "expr": src,
+                "offset": off,
+            }
+        )
+    rep.plan = PLAN_SYMBOLIC if rep.plan_reasons else PLAN_RESIDUALIZABLE
 
 
 # ---------------------------------------------------------------------------
@@ -861,7 +913,9 @@ def render_text(report: AnalysisReport) -> str:
         lines.append("non-device rules:")
         for r in nondevice:
             loc = r.file or r.policy
-            lines.append(f"  [{r.eligibility}] {loc} rule#{r.rule_index} {r.evaluation_key}")
+            lines.append(
+                f"  [{r.eligibility}] [plan: {r.plan}] {loc} rule#{r.rule_index} {r.evaluation_key}"
+            )
             for reason in r.reasons:
                 lines.append(
                     f"      {reason['code']}: {reason['message']}"
@@ -870,6 +924,18 @@ def render_text(report: AnalysisReport) -> str:
             for fb in r.fallbacks:
                 rs = f" [{', '.join(fb['reasons'])}]" if fb["reasons"] else ""
                 lines.append(f"      fallback {fb['path']} tags={'/'.join(fb['tags'])}{rs}")
+    plan_symbolic = [r for r in report.rules if r.plan != PLAN_RESIDUALIZABLE]
+    if plan_symbolic:
+        lines.append("")
+        lines.append("plan symbolic-only rules:")
+        for r in plan_symbolic:
+            loc = r.file or r.policy
+            lines.append(f"  [plan: {r.plan}] {loc} rule#{r.rule_index} {r.evaluation_key}")
+            for reason in r.plan_reasons:
+                lines.append(
+                    f"      {reason['code']}: {reason['message']}"
+                    + (f"  ({reason['expr']!r} @{reason['offset']})" if reason["expr"] else "")
+                )
     shown = [f for f in report.findings if f.kind != KIND_ELIGIBILITY]
     if shown:
         lines.append("")

@@ -87,6 +87,12 @@ REASONS: dict[str, str] = {
     "non_numeric_ordering_constant": "ordering against a non-numeric constant",
     "nan_ordering_constant": "ordering against a NaN constant",
     "unsupported_membership": "membership test shape outside the device op set",
+    # plan-mode (PlanResources) eligibility verdicts: a kernel carrying one
+    # of these can still run in check mode, but BatchPlanner must route the
+    # rule to the sequential symbolic fallback instead of the device
+    # ternary path (see plan/batch.py and docs/PLAN.md)
+    "plan_time_dependent": "condition depends on now(); plan has no single evaluation instant",
+    "plan_unknown_resource_field": "references a resource field PlanResources never knows",
 }
 
 
@@ -144,6 +150,26 @@ class CondKernel:
     pred_reasons: list[tuple[str, str, Optional[A.Node]]] = field(default_factory=list)
     oracle_reason: Optional[tuple[str, str, Optional[A.Node]]] = None
     fallback_reasons: dict[tuple[str, ...], frozenset[str]] = field(default_factory=dict)
+    # plan-mode verdict, decided statically at compile time: None means the
+    # kernel is residualizable (device ternary evaluation is sound when the
+    # per-query resource deps are known); a (code, msg, node) triple means
+    # BatchPlanner must always take the symbolic fallback for this kernel
+    plan_reason: Optional[tuple[str, str, Optional[A.Node]]] = None
+
+    def resource_dep_paths(self) -> set[tuple[str, ...]]:
+        """Every resource-rooted path the kernel's verdict can depend on.
+
+        The union of device column paths, host predicate references, list
+        membership columns and timestamp columns — plan.batch uses this to
+        decide, per query, whether a device TRUE/FALSE is trustworthy."""
+        deps: set[tuple[str, ...]] = set()
+        for p in self.paths:
+            deps.add(p)
+        for spec in self.preds:
+            deps.update(spec.ref_paths)
+        deps.update(self.list_paths)
+        deps.update(self.ts_paths)
+        return {p for p in deps if p and p[0] == "resource"}
 
 
 @dataclass
@@ -1107,6 +1133,8 @@ class ConditionSetCompiler:
             _count_unsupported(u.code)
             kernel.oracle_reason = (u.code, str(u), u.node)
             kernel.emit = None
+            # no device path at all ⇒ no device ternary either
+            kernel.plan_reason = kernel.oracle_reason
             return kernel
 
         kernel.template_sig = tuple(comp.sig)
@@ -1117,6 +1145,7 @@ class ConditionSetCompiler:
         # only None-check it); evaluation happens through the group path,
         # emit(refs, gc) being the shared template
         kernel.emit = template
+        kernel.plan_reason = plan_verdict(kernel)
         return kernel
 
     def build_groups(self) -> None:
@@ -1169,6 +1198,64 @@ def _params_struct_key(params: Optional[PolicyParams]):
         tuple(sorted((k, _freeze_val(v)) for k, v in params.constants.items())),
         tuple((v.name, v.expr.original) for v in params.ordered_variables),
     )
+
+
+def plan_path_always_unknown(path: tuple[str, ...]) -> bool:
+    """True for resource fields PlanResources can never supply.
+
+    Mirrors the sequential planner's knowledge model (plan/partial.py):
+    ``resource.kind`` and ``resource.scope`` come from the query itself and a
+    specific ``resource.attr.X`` leaf may be listed in known_attrs, but
+    ``resource.id`` (always empty in plan mode), ``policyVersion``, the bare
+    attr map and whole-resource references are unknowable by construction.
+    """
+    if not path or path[0] != "resource":
+        return False
+    if len(path) == 1:
+        return True
+    if path[1] in ("kind", "scope"):
+        return False
+    if path[1] == "attr":
+        return len(path) < 3  # bare attr-map reference
+    return True
+
+
+def plan_verdict(kernel: CondKernel) -> Optional[tuple[str, str, Optional[A.Node]]]:
+    """Static plan-mode eligibility for one device-evaluable kernel.
+
+    Returns None when the kernel is residualizable — its device TRUE/FALSE
+    is trustworthy for any plan query whose known attrs cover the kernel's
+    resource deps — or a (code, msg, node) triple naming why BatchPlanner
+    must always take the symbolic fallback. Decided here, at compile time,
+    so the runtime router never guesses; the raise sites below keep the
+    codes in the REASONS registry honest (the source-scan test walks them).
+    """
+    try:
+        if kernel.uses_now:
+            raise Unsupported(
+                "condition compares against now(); a plan filter has no "
+                "single evaluation instant",
+                code="plan_time_dependent",
+                node=None,
+            )
+        for spec in kernel.preds:
+            if spec.time_dependent:
+                raise Unsupported(
+                    "host predicate column is time-dependent",
+                    code="plan_time_dependent",
+                    node=spec.node,
+                )
+        for p in sorted(kernel.resource_dep_paths()):
+            if plan_path_always_unknown(p):
+                raise Unsupported(
+                    "condition references resource field "
+                    f"{'.'.join(p)} that PlanResources never knows",
+                    code="plan_unknown_resource_field",
+                    node=None,
+                )
+    except Unsupported as u:
+        return (u.code, str(u), u.node)
+    return None
 
 
 def evaluate_pred_host(spec: PredSpec, input_obj, eval_ctx_factory) -> tuple[bool, bool]:

@@ -98,6 +98,30 @@ def _make_bodies(n_mods: int, n: int = 512, unique: bool = False) -> list[bytes]
     return bodies
 
 
+def _make_plan_bodies(n_mods: int, n: int = 256) -> list[bytes]:
+    """PlanResources bodies over the same corpus mix: one action per query
+    (the singular `action` form), resource attrs fully known. A bounded
+    replay pool is the realistic serving shape — every list-endpoint hit
+    re-plans the same (principal, action, kind) — and exactly what the
+    batched planner's dedup collapses."""
+    from cerbos_tpu.util import bench_corpus
+
+    bodies = []
+    for i in bench_corpus.requests(n, n_mods):
+        body = {
+            "requestId": f"plan-{i.request_id}",
+            "action": i.actions[0],
+            "principal": {"id": i.principal.id, "roles": i.principal.roles,
+                          "policyVersion": i.principal.policy_version,
+                          "scope": i.principal.scope, "attr": i.principal.attr},
+            "resource": {"kind": i.resource.kind,
+                         "policyVersion": i.resource.policy_version,
+                         "scope": i.resource.scope, "attr": i.resource.attr},
+        }
+        bodies.append(json.dumps(body).encode())
+    return bodies
+
+
 _GOLD_ROLE = "loadtest:gold"
 
 
@@ -234,11 +258,11 @@ def spawn_server(
     return proc, http_port, grpc_port
 
 
-def _http_request_bytes(bodies: list[bytes]) -> list[bytes]:
+def _http_request_bytes(bodies: list[bytes], path: str = "/api/check/resources") -> list[bytes]:
     reqs = []
     for b in bodies:
         head = (
-            "POST /api/check/resources HTTP/1.1\r\n"
+            f"POST {path} HTTP/1.1\r\n"
             "Host: 127.0.0.1\r\n"
             "Content-Type: application/json\r\n"
             f"Content-Length: {len(b)}\r\n"
@@ -441,6 +465,71 @@ def _goodput_block(text: str, elapsed: float) -> dict:
     }
 
 
+def _plan_block(text: str) -> dict:
+    """Fold the batched-PlanResources series: queries by resolution path
+    (device / symbolic / memo), batch count+mean by mode, mean residual
+    rules per query, the plan-mode parity sentinel counters, and
+    decisions_total{api="plan"} outcomes."""
+    paths: dict[str, float] = {}
+    batch_count: dict[str, float] = {}
+    batch_sum: dict[str, float] = {}
+    residual_sum = residual_count = 0.0
+    parity_checks = parity_div = 0.0
+    outcomes: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        if series.startswith("cerbos_tpu_plan_queries_total"):
+            at = series.find('path="')
+            if at >= 0:
+                p = series[at + 6 : series.index('"', at + 6)]
+                paths[p] = paths.get(p, 0.0) + v
+        elif series.startswith("cerbos_tpu_plan_batch_seconds_count"):
+            at = series.find('mode="')
+            if at >= 0:
+                m = series[at + 6 : series.index('"', at + 6)]
+                batch_count[m] = batch_count.get(m, 0.0) + v
+        elif series.startswith("cerbos_tpu_plan_batch_seconds_sum"):
+            at = series.find('mode="')
+            if at >= 0:
+                m = series[at + 6 : series.index('"', at + 6)]
+                batch_sum[m] = batch_sum.get(m, 0.0) + v
+        elif series.startswith("cerbos_tpu_plan_residual_rules_sum"):
+            residual_sum += v
+        elif series.startswith("cerbos_tpu_plan_residual_rules_count"):
+            residual_count += v
+        elif series.startswith("cerbos_tpu_plan_parity_checks_total"):
+            parity_checks += v
+        elif series.startswith("cerbos_tpu_plan_parity_divergence_total"):
+            parity_div += v
+        elif series.startswith("cerbos_tpu_decisions_total"):
+            if 'api="plan"' not in series:
+                continue
+            at = series.find('outcome="')
+            if at >= 0:
+                o = series[at + 9 : series.index('"', at + 9)]
+                outcomes[o] = outcomes.get(o, 0.0) + v
+    return {
+        "queries_by_path": {k: int(v) for k, v in sorted(paths.items())},
+        "batches": {
+            m: {
+                "count": int(batch_count[m]),
+                "mean_ms": round(batch_sum.get(m, 0.0) / batch_count[m] * 1000, 3),
+            }
+            for m in sorted(batch_count)
+            if batch_count[m]
+        },
+        "mean_residual_rules": round(residual_sum / residual_count, 3) if residual_count else 0.0,
+        "parity": {"checks": int(parity_checks), "divergences": int(parity_div)},
+        "outcomes": {k: int(v) for k, v in sorted(outcomes.items())},
+    }
+
+
 def _pressure_block(text: str) -> dict:
     """Saturation pressure at scrape time: max over workers per component
     (the score is already a max over components within each process)."""
@@ -574,10 +663,11 @@ def _transport_block(text: str, http_port: int, elapsed: float) -> dict:
     return block
 
 
-def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0, budget: bool = True, rate: float = 0.0, priority_mix: str = "", admit_rate: float = 0.0) -> dict:
+def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu: bool, workers: int, cold: bool = False, frontends: int = 0, shards: int = 0, budget: bool = True, rate: float = 0.0, priority_mix: str = "", admit_rate: float = 0.0, plan_mix: str = "") -> dict:
     tmp = tempfile.mkdtemp(prefix="cerbos-loadtest-")
     generate_policies(tmp, n_mods)
     gold_parts, default_parts = _parse_priority_mix(priority_mix)
+    plan_parts, check_parts = _parse_priority_mix(plan_mix)
     overload_conf: dict | None = None
     if admit_rate or gold_parts:
         # overload drill config: a protected gold class (priority 0, heavier
@@ -613,7 +703,16 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     # measurement, loadtest-classic.md:4-6). In --cold mode the warmup uses
     # the STANDARD replay set so jit/structural caches warm but the cold
     # pool's value memos stay cold.
+    plan_reqs: list[bytes] = []
+    if plan_parts:
+        plan_reqs = _http_request_bytes(
+            _make_plan_bodies(n_mods), path="/api/plan/resources"
+        )
+
     warm_reqs = _http_request_bytes(_make_bodies(n_mods) if cold else bodies)
+    # warm the plan lane too: the first plan query lowers the rule table
+    # into the BatchPlanner's own kernels — keep that out of the window
+    warm_reqs.extend(plan_reqs[:8])
     ws = socket.create_connection(("127.0.0.1", http_port))
     ws.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     wbuf = bytearray()
@@ -626,6 +725,9 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     counts = [0] * connections
     errors = [0] * connections
     refused = [0] * connections
+    plan_sent = [0] * connections
+    plan_refused = [0] * connections
+    plan_lat_all: list[float] = []
     stop = threading.Event()
     lock = threading.Lock()
     lat_by_class: dict[str, list[float]] = {"gold": [], "default": []}
@@ -633,21 +735,35 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
 
     # request list tagged with its priority class: slot i is gold when
     # i mod (a+b) < a for --priority-mix a:b (deterministic, so the offered
-    # mix is exact over any window that covers the cycle)
+    # mix is exact over any window that covers the cycle). --plan-mix a:b
+    # substitutes a PlanResources request into a of every a+b slots on its
+    # own cycle; plan slots ride the plan lane, never the gold class.
     cycle = gold_parts + default_parts
-    tagged: list[tuple[bytes, str]] = []
+    pcycle = plan_parts + check_parts
+    tagged: list[tuple[bytes, str, str]] = []
     for j, body in enumerate(bodies):
-        if gold_parts and (j % cycle) < gold_parts:
-            tagged.append((_http_request_bytes([_tag_gold(body)])[0], "gold"))
+        if plan_parts and (j % pcycle) < plan_parts:
+            tagged.append((plan_reqs[j % len(plan_reqs)], "default", "plan"))
+        elif gold_parts and (j % cycle) < gold_parts:
+            tagged.append((_http_request_bytes([_tag_gold(body)])[0], "gold", "check"))
         else:
-            tagged.append((_http_request_bytes([body])[0], "default"))
+            tagged.append((_http_request_bytes([body])[0], "default", "check"))
 
     import itertools
 
     slots = itertools.count()  # shared open-loop arrival counter (GIL-atomic)
 
-    def _record(resp: bytes, wid: int, cls: str, lat_ms: float, local: dict) -> None:
+    def _record(resp: bytes, wid: int, cls: str, kind: str, lat_ms: float, local: dict) -> None:
         head = resp[:16]
+        if kind == "plan":
+            plan_sent[wid] += 1
+            if b" 200 " in head:
+                local["plan"].append(lat_ms)
+            elif b" 429 " in head:
+                plan_refused[wid] += 1  # shed_plan / plan-lane budget, not an error
+            else:
+                errors[wid] += 1
+            return
         if b" 200 " in head:
             local[cls].append(lat_ms)
         elif b" 429 " in head:
@@ -656,7 +772,7 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
             errors[wid] += 1
 
     def http_worker(wid: int) -> None:
-        local: dict[str, list[float]] = {"gold": [], "default": []}
+        local: dict[str, list[float]] = {"gold": [], "default": [], "plan": []}
         n = 0
         try:
             sock = socket.create_connection(("127.0.0.1", http_port))
@@ -675,13 +791,13 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
                     sched_lag_ms[wid] = max(
                         sched_lag_ms[wid], (time.perf_counter() - t_fire) * 1000
                     )
-                    req, cls = tagged[i % len(tagged)]
+                    req, cls, kind = tagged[i % len(tagged)]
                 else:
-                    req, cls = tagged[(wid + n) % len(tagged)]
+                    req, cls, kind = tagged[(wid + n) % len(tagged)]
                 t0 = time.perf_counter()
                 sock.sendall(req)
                 resp = _read_http_response(sock, buf)
-                _record(resp, wid, cls, (time.perf_counter() - t0) * 1000, local)
+                _record(resp, wid, cls, kind, (time.perf_counter() - t0) * 1000, local)
                 n += 1
             sock.close()
         except Exception as e:  # noqa: BLE001  (a dead worker must not vanish silently)
@@ -689,8 +805,9 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
             print(f"http worker {wid} died after {n} requests: {e}", file=sys.stderr)
         counts[wid] = n
         with lock:
-            for cls, vals in local.items():
-                lat_by_class[cls].extend(vals)
+            for cls in ("gold", "default"):
+                lat_by_class[cls].extend(local[cls])
+            plan_lat_all.extend(local["plan"])
             latencies.extend(local["gold"])
             latencies.extend(local["default"])
 
@@ -744,6 +861,7 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     goodput = _goodput_block(metrics_text, elapsed)
     pressure = _pressure_block(metrics_text)
     admission = _admission_block(metrics_text)
+    plan_server = _plan_block(metrics_text)
     ipc_transport = _transport_block(metrics_text, http_port, elapsed)
     proc.terminate()
     try:
@@ -772,7 +890,14 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
 
     accepted = len(latencies)
     refused_total = sum(refused)
-    offered = total
+    plan_offered = sum(plan_sent)
+    # check-lane accounting only: plan slots have their own block below
+    offered = total - plan_offered
+    plan_lat = sorted(plan_lat_all)
+
+    def plan_pct(p: float) -> float:
+        return plan_lat[min(len(plan_lat) - 1, int(p * len(plan_lat)))] if plan_lat else 0.0
+
     return {
         "transport": "grpc" if use_grpc else "http",
         "requests": total,
@@ -837,6 +962,21 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
         "latency_by_class": {
             cls: cls_pcts(vals) for cls, vals in lat_by_class.items() if vals
         },
+        # --plan-mix a:b: PlanResources slots interleaved into the offered
+        # load. Client side: offered/accepted/refused plan requests and
+        # accepted-plan latency; server side: the batched planner's series
+        # (queries by device/symbolic/memo path, batch count+mean by mode,
+        # mean residual rules, plan-mode parity sentinel counters, and
+        # decisions_total{api="plan"} outcomes)
+        "plan": {
+            "mix": plan_mix,
+            "offered": plan_offered,
+            "accepted": len(plan_lat_all),
+            "refused": sum(plan_refused),
+            "p50_ms": round(plan_pct(0.50), 2),
+            "p99_ms": round(plan_pct(0.99), 2),
+            "server": plan_server,
+        },
         # ticket-queue data plane (engine/ipc.py): negotiated transport
         # (shm frame rings vs uds marshal), frames/s, codec ns/frame,
         # ring-full sheds — transport=local outside the front-door topology
@@ -884,6 +1024,14 @@ def main() -> None:
         "(e.g. 1:4 = 20%% gold)",
     )
     ap.add_argument(
+        "--plan-mix",
+        default="",
+        metavar="A:B",
+        help="substitute a PlanResources request into A of every A+B slots "
+        "(e.g. 1:9 = 10%% plan traffic through the batcher's plan lane). "
+        "HTTP only.",
+    )
+    ap.add_argument(
         "--admit-rate",
         type=float,
         default=0.0,
@@ -910,13 +1058,14 @@ def main() -> None:
         # this the pool crash-loops and the readiness poll times out
         print("--frontends implies the TPU engine path; enabling --tpu", file=sys.stderr)
         args.tpu = True
-    if args.grpc and (args.rate or args.priority_mix):
-        ap.error("--rate / --priority-mix drive the raw-socket HTTP path; drop --grpc")
+    if args.grpc and (args.rate or args.priority_mix or args.plan_mix):
+        ap.error("--rate / --priority-mix / --plan-mix drive the raw-socket HTTP path; drop --grpc")
     result = run(
         args.duration, args.connections, args.mods, args.grpc, args.tpu, args.workers,
         cold=args.cold, frontends=args.frontends, shards=args.shards,
         budget=not args.no_budget,
         rate=args.rate, priority_mix=args.priority_mix, admit_rate=args.admit_rate,
+        plan_mix=args.plan_mix,
     )
     print(json.dumps(result))
     if args.json:

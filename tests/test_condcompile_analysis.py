@@ -89,6 +89,10 @@ CASES: dict[str, tuple[str, PolicyParams, bool]] = {
         EMPTY,
         True,
     ),
+    # plan-mode verdicts: the kernel stays device-evaluable for check
+    # traffic, but plan_reason routes it to the symbolic planner fallback
+    "plan_time_dependent": ("timestamp(R.attr.t) < now()", EMPTY, False),
+    "plan_unknown_resource_field": ('R.id == "x"', EMPTY, False),
 }
 
 
@@ -106,6 +110,12 @@ def test_reason_code_assigned(case):
     code = case.split("@", 1)[0]
     src, params, oracle_only = CASES[case]
     k, pred_codes, oracle_code = _kernel_codes(src, params)
+    if code.startswith("plan_"):
+        # plan verdicts don't disturb the check path: the kernel keeps its
+        # device emit and the rejection lands in plan_reason only
+        assert k.emit is not None, f"{src!r} should stay device-evaluable"
+        assert k.plan_reason is not None and k.plan_reason[0] == code
+        return
     if oracle_only:
         assert k.emit is None, f"{src!r} should be oracle-only"
         assert oracle_code == code

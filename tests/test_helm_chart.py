@@ -211,6 +211,12 @@ class TestChartStatic:
             "cerbos_tpu_brownout_stage",
             "cerbos_tpu_brownout_shed_total",
             "cerbos_tpu_brownout_transitions_total",
+            # plan row (batched PlanResources)
+            "cerbos_tpu_plan_batch_seconds_bucket",
+            "cerbos_tpu_plan_queries_total",
+            "cerbos_tpu_plan_residual_rules_bucket",
+            "cerbos_tpu_plan_parity_checks_total",
+            "cerbos_tpu_plan_parity_divergence_total",
         ):
             assert needle in joined, needle
 

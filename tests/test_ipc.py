@@ -437,7 +437,7 @@ class TestMetricsRelabel:
             'cerbos_tpu_request_stage_seconds_bucket{stage="ipc_encode",shard="0",le="0.001"} 3\n'
             'cerbos_tpu_request_stage_seconds_sum{stage="ipc_encode",shard="0"} 0.002\n'
             "# TYPE cerbos_tpu_decisions_total counter\n"
-            'cerbos_tpu_decisions_total{outcome="deadline_met"} 7\n'
+            'cerbos_tpu_decisions_total{api="check",outcome="deadline_met"} 7\n'
             "# TYPE cerbos_tpu_pressure_score gauge\n"
             "cerbos_tpu_pressure_score 0.25\n"
         )
@@ -453,7 +453,7 @@ class TestMetricsRelabel:
             'cerbos_tpu_request_stage_seconds_bucket{worker="fe0",stage="ipc_encode",shard="0",le="0.001"} 3'
             in fe_rel
         )
-        assert 'cerbos_tpu_decisions_total{worker="fe0",outcome="deadline_met"} 7' in fe_rel
+        assert 'cerbos_tpu_decisions_total{worker="fe0",api="check",outcome="deadline_met"} 7' in fe_rel
         assert 'cerbos_tpu_pressure_score{worker="batcher"} 0.75' in b_rel
         merged = merge_metrics_texts(fe_rel, b_rel)
         assert merged.count("# TYPE cerbos_tpu_request_stage_seconds histogram") == 1
@@ -532,6 +532,38 @@ class TestMetricsRelabel:
         )
         assert 'cerbos_tpu_cond_compile_unsupported_total{worker="batcher",reason="unsupported_membership"} 3' in merged
         assert 'cerbos_tpu_cond_compile_unsupported_total{worker="fe0",reason="undefined_global"} 1' in merged
+
+    def test_relabel_and_merge_cover_plan_families(self):
+        """The batched-planner families ride the same textual machinery:
+        mode/path labels survive relabeling, plan traffic booked under
+        decisions_total{api="plan"} keeps its api dimension, and the
+        plan-parity counters merge alongside the check-parity ones."""
+        batcher = (
+            "# TYPE cerbos_tpu_plan_batch_seconds histogram\n"
+            'cerbos_tpu_plan_batch_seconds_bucket{mode="numpy",le="0.01"} 12\n'
+            'cerbos_tpu_plan_batch_seconds_sum{mode="numpy"} 0.05\n'
+            "# TYPE cerbos_tpu_plan_queries_total counter\n"
+            'cerbos_tpu_plan_queries_total{path="device"} 900\n'
+            'cerbos_tpu_plan_queries_total{path="symbolic"} 100\n'
+            "# TYPE cerbos_tpu_plan_parity_checks_total counter\n"
+            "cerbos_tpu_plan_parity_checks_total 40\n"
+            "# TYPE cerbos_tpu_plan_parity_divergence_total counter\n"
+            "cerbos_tpu_plan_parity_divergence_total 0\n"
+        )
+        fe = (
+            "# TYPE cerbos_tpu_decisions_total counter\n"
+            'cerbos_tpu_decisions_total{api="plan",outcome="deadline_met"} 31\n'
+            'cerbos_tpu_decisions_total{api="plan",outcome="refused"} 4\n'
+        )
+        b_rel = relabel_metrics_text(batcher, "worker", "batcher")
+        fe_rel = relabel_metrics_text(fe, "worker", "fe0")
+        assert 'cerbos_tpu_plan_batch_seconds_bucket{worker="batcher",mode="numpy",le="0.01"} 12' in b_rel
+        assert 'cerbos_tpu_plan_queries_total{worker="batcher",path="device"} 900' in b_rel
+        assert 'cerbos_tpu_plan_parity_divergence_total{worker="batcher"} 0' in b_rel
+        merged = merge_metrics_texts(b_rel, fe_rel)
+        assert merged.count("# TYPE cerbos_tpu_plan_queries_total counter") == 1
+        assert 'cerbos_tpu_decisions_total{worker="fe0",api="plan",outcome="refused"} 4' in merged
+        assert 'cerbos_tpu_plan_parity_checks_total{worker="batcher"} 40' in merged
 
 
 class TestTransportMetricsLint:

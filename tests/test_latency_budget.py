@@ -271,7 +271,7 @@ class TestGoodputUnderWedge:
         wedged = FaultInjector(OracleEvaluator(rt), "wedge_after:2,wedge_sleep_s:1")
         b = BatchingEvaluator(wedged, max_wait_ms=1.0, min_batch_to_wait=1)
         vec = tracker.m_decisions
-        before = {k: vec.get(k) for k in (OUTCOME_MET, OUTCOME_EXPIRED)}
+        before = {k: vec.get(("check", k)) for k in (OUTCOME_MET, OUTCOME_EXPIRED)}
         try:
             wf = tracker.start()
             assert finish_like_server(tracker, wf, lambda: b.check([inp(1)], wf=wf))
@@ -284,8 +284,8 @@ class TestGoodputUnderWedge:
                 assert out is None  # deadline expired while the device wedged
         finally:
             b.close()
-        met = vec.get(OUTCOME_MET) - before[OUTCOME_MET]
-        expired = vec.get(OUTCOME_EXPIRED) - before[OUTCOME_EXPIRED]
+        met = vec.get(("check", OUTCOME_MET)) - before[OUTCOME_MET]
+        expired = vec.get(("check", OUTCOME_EXPIRED)) - before[OUTCOME_EXPIRED]
         assert met == 1
         assert expired == 2
 
@@ -320,11 +320,11 @@ class TestSlowRing:
 
     def test_disabled_tracker_still_counts_decisions(self, tracker):
         tracker.configure(enabled=False)
-        before = tracker.m_decisions.get(OUTCOME_MET)
+        before = tracker.m_decisions.get(("check", OUTCOME_MET))
         assert tracker.start() is None
         tracker.finish(None, OUTCOME_MET)
         tracker.count(OUTCOME_MET)
-        assert tracker.m_decisions.get(OUTCOME_MET) == before + 2
+        assert tracker.m_decisions.get(("check", OUTCOME_MET)) == before + 2
         assert not tracker.slow_dump()["requests"]
 
 

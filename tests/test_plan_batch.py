@@ -1,0 +1,411 @@
+"""Batched PlanResources: differential parity, routing, the plan lane,
+and the plan-mode parity sentinel.
+
+The contract under test (docs/PLAN.md): for any (principal, action,
+known-attrs) query, ``BatchPlanner.plan_batch`` must produce a serialized
+filter AST byte-identical to the sequential ``Planner`` — the device
+ternary path only ever replaces a symbolic sub-walk whose outcome the
+static analyzer proved it can reproduce (``condcompile.plan_verdict``).
+"""
+
+import json
+import random
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+from cerbos_tpu.engine import EvalParams, Principal
+from cerbos_tpu.engine.admission import OverloadRefused
+from cerbos_tpu.engine.batcher import BatchingEvaluator, _Pending
+from cerbos_tpu.engine.sentinel import ParitySentinel
+from cerbos_tpu.engine.types import AuxData
+from cerbos_tpu.plan import BatchPlanner, Planner
+from cerbos_tpu.plan.types import PlanInput
+
+from test_golden_plan import (
+    COMMON,
+    LENIENT,
+    STRICT,
+    make_params,
+    plan_table,
+)
+from test_latency_budget import OracleEvaluator, inp as check_inp, table as check_table
+
+pytestmark = pytest.mark.plan_batch
+
+
+def canon(out) -> str:
+    """The parity currency: byte-exact serialized filter AST."""
+    return json.dumps(out.to_json(), sort_keys=True)
+
+
+def suite_queries(suite):
+    """PlanInputs for every non-error test of one golden suite (mirrors
+    test_golden_plan.run_suite construction, including plan_case_05/06)."""
+    p = suite["principal"]
+    principal = Principal(
+        id=p["id"],
+        roles=list(p.get("roles", [])),
+        attr=p.get("attr", {}) or {},
+        policy_version=p.get("policyVersion", ""),
+        scope=p.get("scope", ""),
+    )
+    aux = AuxData(jwt={"customInt": 42})
+    queries = []
+    for tt in suite.get("tests", []):
+        if tt.get("wantErr"):
+            continue
+        actions = tt.get("actions") or [tt["action"]]
+        res = tt["resource"]
+        queries.append(
+            PlanInput(
+                request_id="requestId",
+                actions=list(actions),
+                principal=principal,
+                resource_kind=res["kind"],
+                resource_attr=res.get("attr", {}) or {},
+                resource_policy_version=res.get("policyVersion", ""),
+                resource_scope=res.get("scope", ""),
+                aux_data=aux,
+                include_meta=True,
+            )
+        )
+    return queries
+
+
+class TestGoldenCorpusParity:
+    """Differential harness over the full golden plan corpus: every suite
+    (common / strict / lenient, incl. query_planner_filter case_05/06
+    contexts) through BOTH planners, asserting byte-identical output."""
+
+    @pytest.mark.parametrize("lenient", [False, True], ids=["strict", "lenient"])
+    def test_full_corpus_byte_exact(self, lenient):
+        rt = plan_table()
+        params = make_params(lenient)
+        sequential = Planner(rt)
+        batched = BatchPlanner(rt, globals_={"environment": "test"})
+        suites = COMMON + (LENIENT if lenient else STRICT)
+        total = 0
+        for name, suite in suites:
+            queries = suite_queries(suite)
+            if not queries:
+                continue
+            want = [canon(sequential.plan(q, params)) for q in queries]
+            have = [canon(o) for o in batched.plan_batch(queries, params)]
+            for i, (w, h) in enumerate(zip(want, have)):
+                assert w == h, f"{name}#{i}: batched filter diverged\n want {w}\n have {h}"
+            total += len(queries)
+        assert total > 50  # the corpus is non-trivial
+        # the device path must actually carry traffic — a silently
+        # all-symbolic planner would pass parity while proving nothing
+        assert batched.stats.device_rules > 0, batched.stats.as_dict()
+        assert batched.stats.device_queries > 0, batched.stats.as_dict()
+
+    def test_mismatched_globals_go_symbolic_but_stay_correct(self):
+        rt = plan_table()
+        params = make_params(False)
+        sequential = Planner(rt)
+        # compiled against DIFFERENT globals than params carry: the whole
+        # batch must route symbolic (never trust stale constant folds)
+        batched = BatchPlanner(rt, globals_={"environment": "prod"})
+        name, suite = COMMON[0]
+        queries = suite_queries(suite)
+        have = [canon(o) for o in batched.plan_batch(queries, params)]
+        want = [canon(sequential.plan(q, params)) for q in queries]
+        assert have == want
+        assert batched.stats.device_rules == 0, batched.stats.as_dict()
+
+
+class TestRandomizedParity:
+    """Property-style sweep: randomized (principal, action, known-attrs)
+    queries — including attr subsets the policies never name and unknown
+    roles/kinds — byte-identical through both planners."""
+
+    ATTR_POOL = [
+        "owner",
+        "public",
+        "dept",
+        "team",
+        "status",
+        "hidden",
+        "GlobalID",
+        "geographies",
+        "classification",
+    ]
+    VALUE_POOL = [True, False, 0, 1, 42, "x", "eng", "GB", "", ["GB", "FR"], None]
+
+    def _random_query(self, rng, kinds, roles, actions):
+        n_attr = rng.randrange(0, 4)
+        attrs = {
+            rng.choice(self.ATTR_POOL): rng.choice(self.VALUE_POOL)
+            for _ in range(n_attr)
+        }
+        principal = Principal(
+            id=f"u{rng.randrange(5)}",
+            roles=rng.sample(roles, k=rng.randrange(1, min(3, len(roles)) + 1)),
+            attr={"dept": rng.choice(["eng", "sales"]), "GlobalID": rng.randrange(3)}
+            if rng.random() < 0.7
+            else {},
+        )
+        return PlanInput(
+            request_id="rand",
+            actions=[rng.choice(actions)],
+            principal=principal,
+            resource_kind=rng.choice(kinds),
+            resource_attr=attrs,
+            include_meta=rng.random() < 0.5,
+        )
+
+    def test_randomized_queries_byte_exact(self):
+        rt = plan_table()
+        params = make_params(False)
+        sequential = Planner(rt)
+        batched = BatchPlanner(rt, globals_={"environment": "test"})
+        kinds = sorted({n for n, s in COMMON for t in s.get("tests", []) for n in [t["resource"]["kind"]]})
+        actions = ["view", "edit", "delete", "approve", "report"]
+        roles = ["user", "employee", "manager", "admin", "boss"]
+        rng = random.Random(20260807)
+        queries = [self._random_query(rng, kinds, roles, actions) for _ in range(150)]
+        outs = batched.plan_batch(queries, params)
+        for i, (q, o) in enumerate(zip(queries, outs)):
+            want = canon(sequential.plan(q, params))
+            assert canon(o) == want, f"query {i} diverged:\n want {want}\n have {canon(o)}"
+
+
+def album_plan_input(i: int, **attr) -> PlanInput:
+    return PlanInput(
+        request_id=f"pq{i}",
+        actions=["view"],
+        principal=Principal(id=f"u{i}", roles=["user"]),
+        resource_kind="album",
+        resource_attr=attr,
+    )
+
+
+def make_plan_batcher(**kw):
+    rt = check_table()
+    kw.setdefault("max_wait_ms", 1.0)
+    b = BatchingEvaluator(OracleEvaluator(rt), **kw)
+    b.plan_planner = BatchPlanner(rt)
+    return rt, b
+
+
+class TestPlanLane:
+    def test_plan_through_batcher_matches_sequential(self):
+        rt, b = make_plan_batcher()
+        try:
+            sequential = Planner(rt)
+            q = album_plan_input(1, public=True)
+            out = b.plan([q])
+            assert len(out) == 1
+            assert canon(out[0]) == canon(sequential.plan(q, EvalParams()))
+            assert b.stats["plan_batches"] == 1
+        finally:
+            b.close()
+
+    def test_concurrent_plans_coalesce_and_stay_byte_exact(self):
+        rt, b = make_plan_batcher(max_wait_ms=5.0, min_batch_to_wait=4)
+        try:
+            sequential = Planner(rt)
+            queries = [
+                album_plan_input(i, **({"public": True} if i % 3 == 0 else {}))
+                for i in range(12)
+            ]
+            results: dict[int, str] = {}
+            errors: list[BaseException] = []
+
+            def worker(i: int) -> None:
+                try:
+                    results[i] = canon(b.plan([queries[i]])[0])
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert not errors, errors
+            for i, q in enumerate(queries):
+                assert results[i] == canon(sequential.plan(q, EvalParams()))
+        finally:
+            b.close()
+
+    def test_configure_lanes_appends_plan_lane_below_all_bands(self):
+        rt, b = make_plan_batcher()
+        try:
+            b.configure_lanes([("gold", 0, 4, 0), ("default", 1, 1, 0)])
+            lanes = b._queue._lanes
+            assert "plan" in lanes
+            assert lanes["plan"].priority > max(lanes["gold"].priority, lanes["default"].priority)
+            assert lanes["plan"].budget == b.PLAN_QUEUE_BUDGET
+            # an explicitly configured plan lane is honored, not duplicated
+            b.configure_lanes([("gold", 0, 4, 0), ("plan", 9, 2, 7)])
+            assert b._queue._lanes["plan"].budget == 7
+        finally:
+            b.close()
+
+    def test_plan_queue_budget_refuses_with_overload(self):
+        rt, b = make_plan_batcher()
+        try:
+            b.configure_lanes([("gold", 0, 1, 0), ("plan", 1, 1, 1)])
+            # park a pending in the plan lane without waking the drain loop:
+            # the next plan() must refuse at the lane budget, not queue behind
+            with b._lock:
+                b._queue.append(
+                    _Pending([album_plan_input(0)], None, Future(), pclass="plan", kind="plan")
+                )
+            with pytest.raises(OverloadRefused) as ei:
+                b.plan([album_plan_input(1)])
+            assert ei.value.pclass == "plan"
+            assert ei.value.reason == "queue_budget"
+        finally:
+            b.close()
+
+    def test_plan_failure_falls_back_sequentially_per_query(self):
+        rt, b = make_plan_batcher()
+        try:
+            boom = {"n": 0}
+            orig = b.plan_planner.plan_batch
+
+            def exploding(inputs, params=None):
+                boom["n"] += 1
+                raise RuntimeError("vectorized path down")
+
+            b.plan_planner.plan_batch = exploding
+            sequential = Planner(rt)
+            q = album_plan_input(2, public=True)
+            out = b.plan([q])
+            assert canon(out[0]) == canon(sequential.plan(q, EvalParams()))
+            assert boom["n"] == 1
+            assert b.stats["plan_fallbacks"] == 1
+            b.plan_planner.plan_batch = orig
+        finally:
+            b.close()
+
+
+@pytest.mark.chaos
+class TestPlanBrownoutChaos:
+    def test_plan_refusals_lose_zero_check_requests(self):
+        """The chaos leg: with the plan lane wedged at budget, a burst of
+        interleaved plan+check traffic must refuse ONLY plan queries —
+        every check-lane request still gets a decision."""
+        rt, b = make_plan_batcher(max_wait_ms=1.0)
+        try:
+            b.configure_lanes([("default", 0, 1, 0), ("plan", 1, 1, 1)])
+            with b._lock:
+                b._queue.append(
+                    _Pending([album_plan_input(0)], None, Future(), pclass="plan", kind="plan")
+                )
+            # drain loop is still asleep (the park bypassed the wakeup), so
+            # the lane budget is deterministically exhausted right now
+            with pytest.raises(OverloadRefused) as ei:
+                b.plan([album_plan_input(99)])
+            assert ei.value.pclass == "plan"
+            assert ei.value.reason == "queue_budget"
+
+            check_ok = []
+            plan_ok = []
+            plan_refused = []
+            errors = []
+
+            def do_check(i: int) -> None:
+                try:
+                    out = b.check([check_inp(i)])
+                    assert len(out) == 1
+                    check_ok.append(i)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(("check", i, e))
+
+            def do_plan(i: int) -> None:
+                # once checks wake the drain loop the parked flight clears,
+                # so burst plans may be served OR refused — both are fine;
+                # what is NEVER fine is a lost check decision
+                try:
+                    b.plan([album_plan_input(i)])
+                    plan_ok.append(i)
+                except OverloadRefused:
+                    plan_refused.append(i)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(("plan", i, e))
+
+            threads = []
+            for i in range(30):
+                threads.append(threading.Thread(target=do_check, args=(i,)))
+                if i % 3 == 0:
+                    threads.append(threading.Thread(target=do_plan, args=(i,)))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert not errors, errors
+            assert len(check_ok) == 30  # zero check-lane losses
+            assert len(plan_ok) + len(plan_refused) == 10  # every plan settled
+        finally:
+            b.close()
+
+
+@pytest.mark.parity_sentinel
+class TestPlanParitySentinel:
+    def test_plan_batches_replay_clean(self):
+        rt, b = make_plan_batcher()
+        sent = ParitySentinel(sample_rate=1.0).attach(b)
+        try:
+            b.plan([album_plan_input(1, public=True)])
+            b.plan([album_plan_input(2)])
+            assert sent.drain(timeout=10)
+            snap = sent.snapshot()
+            assert snap["plan_checks"] >= 2
+            assert snap["plan_divergences"] == 0
+        finally:
+            sent.close()
+            b.close()
+
+    def test_corrupted_plan_output_is_a_divergence(self, tmp_path):
+        rt = check_table()
+        planner = BatchPlanner(rt)
+        sent = ParitySentinel(sample_rate=1.0, corpus_dir=str(tmp_path))
+        try:
+            q = album_plan_input(3)
+            good = planner.plan_batch([q])
+            bad = planner.plan_batch([album_plan_input(3, public=True)])
+
+            class FakeBatcher:
+                shard_id = 0
+                plan_planner = planner
+                _batch_seq = 7
+
+            # feed the sentinel a batch whose recorded output does NOT
+            # match what the sequential planner produces for q
+            sent.observe_plan_batch(FakeBatcher(), [q], None, bad)
+            assert sent.drain(timeout=10)
+            snap = sent.snapshot()
+            assert snap["plan_checks"] == 1
+            assert snap["plan_divergences"] == 1
+            from cerbos_tpu.engine.sentinel import DivergenceCorpus
+
+            records = list(DivergenceCorpus.load(str(tmp_path)))
+            assert records, "divergence must be captured in the corpus"
+            # and a clean batch replays clean
+            sent.observe_plan_batch(FakeBatcher(), [q], None, good)
+            assert sent.drain(timeout=10)
+            assert sent.snapshot()["plan_divergences"] == 1
+        finally:
+            sent.close()
+
+    def test_shed_pauses_plan_sampling(self):
+        rt, b = make_plan_batcher()
+        sent = ParitySentinel(sample_rate=1.0).attach(b)
+        try:
+            sent.set_shed(True)
+            b.plan([album_plan_input(4)])
+            assert sent.drain(timeout=5)
+            assert sent.snapshot()["plan_checks"] == 0
+            sent.set_shed(False)
+            b.plan([album_plan_input(5)])
+            assert sent.drain(timeout=5)
+            assert sent.snapshot()["plan_checks"] == 1
+        finally:
+            sent.close()
+            b.close()

@@ -220,6 +220,13 @@ class TestMetricsLint:
                 # the condition compiler itself
                 "cerbos_tpu_policy_analysis_total",
                 "cerbos_tpu_cond_compile_unsupported_total",
+                # batched PlanResources family (plan/batch.py + the plan-mode
+                # parity leg in engine/sentinel.py)
+                "cerbos_tpu_plan_batch_seconds",
+                "cerbos_tpu_plan_queries_total",
+                "cerbos_tpu_plan_residual_rules",
+                "cerbos_tpu_plan_parity_checks_total",
+                "cerbos_tpu_plan_parity_divergence_total",
             ):
                 assert name in inst, name
             known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.GaugeVec, obs.Histogram, obs.HistogramVec)
@@ -254,9 +261,10 @@ class TestMetricsLint:
             ):
                 m = inst.get(name)
                 assert isinstance(m.label, tuple) and m.label[-1] == "shard", (name, m.label)
-            # goodput accounting splits on outcome only (process-global)
+            # goodput accounting splits on (api, outcome) so PlanResources
+            # traffic is booked alongside checks (process-global)
             m = inst.get("cerbos_tpu_decisions_total")
-            assert isinstance(m, obs.CounterVec) and m.label == "outcome", m.label
+            assert isinstance(m, obs.CounterVec) and m.label == ("api", "outcome"), m.label
             # rendered exposition carries the label on every child series
             text = obs.metrics().render()
             for line in text.splitlines():
