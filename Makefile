@@ -9,11 +9,12 @@ PYTEST_FLAGS ?= -q -p no:cacheprovider
 TRANSPORT_TESTS := tests/test_shm_transport.py tests/test_ipc.py tests/test_latency_budget.py
 OVERLOAD_TESTS := tests/test_overload.py
 PLAN_TESTS := tests/test_plan_batch.py
+ROLLOUT_TESTS := tests/test_rollout.py
 # the native-touching suites: codec round-trips, frame rings, truncation fuzz
 ASAN_TESTS := tests/test_native.py tests/test_shm_transport.py
 
 .PHONY: all native native-asan clean test test-transport test-overload \
-	test-plan test-native-asan lint
+	test-plan test-rollout test-native-asan lint
 
 all: native
 
@@ -48,6 +49,14 @@ test-overload: native
 test-plan: native
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(PLAN_TESTS) $(PYTEST_FLAGS) -m plan_batch
 	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(PLAN_TESTS) $(PYTEST_FLAGS) -m plan_batch
+
+# safe-rollout chaos drills on both codec legs: the epoch stamp crosses
+# the ticket queue inside STATUS/reply frames, so the mixed-epoch and
+# bounded-skew invariants must hold with the native shm codec present and
+# with the uds marshal fallback.
+test-rollout: native
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(ROLLOUT_TESTS) $(PYTEST_FLAGS) -m rollout
+	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(ROLLOUT_TESTS) $(PYTEST_FLAGS) -m rollout
 
 # ASan/UBSan leg: rebuild the native module instrumented, run the suites
 # that exercise the C++ codec/ring paths (incl. the truncation fuzzers),

@@ -106,12 +106,15 @@ def _entry_from_decision(
     outputs: list[T.CheckOutput],
     trace_id: str = "",
     shard: Optional[int] = None,
+    epoch: Optional[int] = None,
 ) -> dict:
     """Ref: auditv1.DecisionLogEntry (checkResources + auditTrail shape as
     compared by engine_test.go's wantDecisionLogs). ``traceId`` and ``shard``
     correlate the decision entry with the request's trace and the device
     lane that evaluated it — the join key between audit, /_cerbos/debug
-    traces, and the flight recorder."""
+    traces, and the flight recorder. ``policyEpoch`` records which committed
+    policy epoch evaluated the request (engine/rollout.py) — the stamp the
+    mixed-table chaos drills audit."""
     effective: dict[str, dict] = {}
     for o in outputs:
         for key, attrs in o.effective_policies.items():
@@ -123,6 +126,7 @@ def _entry_from_decision(
             "kind": "decision",
             "traceId": trace_id,
             "shard": shard,
+            "policyEpoch": epoch,
             "checkResources": {
                 "inputs": [_input_json(i) for i in inputs],
                 "outputs": [_output_json(o) for o in outputs],
@@ -216,6 +220,7 @@ class AuditLog:
         outputs: list[T.CheckOutput],
         trace_id: str = "",
         shard: Optional[int] = None,
+        epoch: Optional[int] = None,
     ) -> None:
         if not self.decision_logs_enabled or self.backend is None:
             return
@@ -223,7 +228,11 @@ class AuditLog:
             return
         if not self.decision_filter.keep(inputs, outputs):
             return
-        self._submit(_entry_from_decision(call_id, inputs, outputs, trace_id=trace_id, shard=shard))
+        self._submit(
+            _entry_from_decision(
+                call_id, inputs, outputs, trace_id=trace_id, shard=shard, epoch=epoch
+            )
+        )
 
     def write_plan(self, call_id: str, plan_input: Any, plan_output: Any) -> None:
         """Plan decision entry mirroring DecisionLogEntry.PlanResources

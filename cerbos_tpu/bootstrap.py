@@ -37,8 +37,11 @@ class Core:
     tpu_evaluator: Any = None
     batcher: Any = None
     sentinel: Any = None
+    rollout: Any = None
 
     def close(self) -> None:
+        if self.rollout is not None:
+            self.rollout.close()
         if self.sentinel is not None:
             self.sentinel.close()
         if self.batcher is not None:
@@ -190,6 +193,37 @@ def initialize(
     _admission.controller().configure(overload_conf)
     _brownout.controller().configure(overload_conf.get("brownout") or {})
 
+    # fault injection (chaos testing): CERBOS_TPU_FAULTS env wins over the
+    # engine.tpu.faults config key; empty means no wrapper at all. Parsed
+    # once here — the rollout controller reads the swap_fail knob, the
+    # batcher lanes get the device knobs.
+    import os as _os
+
+    fault_spec = _os.environ.get("CERBOS_TPU_FAULTS", "") or str(tpu_conf.get("faults", "") or "")
+    from .engine.faults import parse_fault_spec as _parse_faults
+
+    fault_knobs = _parse_faults(fault_spec) if fault_spec else {}
+
+    # safe policy rollout: every storage event now routes through the
+    # staged shadow-build → analyzer-gate → epoch-versioned cutover →
+    # canary ladder instead of the bare build-and-swap; the swap hooks
+    # that used to chain through manager.on_swap register below as named
+    # cutover subscribers. Front ends run the controller in passive mode:
+    # no epoch authority (that is the batcher's), just the subscriber
+    # registry over the local oracle-fallback table.
+    from .engine import rollout as _rollout
+
+    rollout_ctl = _rollout.RolloutController(
+        manager,
+        conf=tpu_conf.get("rollout", {}) or {},
+        mode="passive" if role == "frontend" else "full",
+        globals_=engine_conf.get("globals", {}) or {},
+        schema_mgr=schema_mgr,
+        faults=fault_knobs,
+    )
+    manager.rollout = rollout_ctl
+    _rollout.install(rollout_ctl)
+
     tpu_enabled = tpu_conf.get("enabled", True) if use_tpu is None else use_tpu
     tpu_evaluator = None
     dispatch_evaluator = None
@@ -218,15 +252,8 @@ def initialize(
         # server's dispatch decision and for close(); the client fits both
         batcher = client
 
-        _client_prev = manager.on_swap
-
-        def _client_swap(rt) -> None:
-            # policy reload: keep the local oracle fallback on the new table
-            client.refresh_table(rt)
-            if _client_prev is not None:
-                _client_prev(rt)
-
-        manager.on_swap = _client_swap
+        # policy reload: keep the local oracle fallback on the new table
+        rollout_ctl.subscribe("client", lambda ep, _c=client: _c.refresh_table(ep.rule_table))
     elif tpu_enabled:
         if prebuilt is not None and prebuilt.tpu_evaluator is not None:
             # adopt the pre-lowered evaluator (COW-shared across forked
@@ -235,15 +262,17 @@ def initialize(
             tpu_evaluator.schema_mgr = schema_mgr
         else:
             tpu_evaluator = _make_evaluator(manager.rule_table, engine_conf, schema_mgr)
-        manager.evaluator_refresh_hook(tpu_evaluator)
-        dispatch_evaluator = tpu_evaluator
-        # fault injection (chaos testing): CERBOS_TPU_FAULTS env wins over the
-        # engine.tpu.faults config key; empty means no wrapper at all
-        import os as _os
 
-        fault_spec = _os.environ.get("CERBOS_TPU_FAULTS", "") or str(
-            tpu_conf.get("faults", "") or ""
-        )
+        def _sub_evaluator(ep, _ev=tpu_evaluator) -> None:
+            # re-lower the SHARED lowered table first; every later subscriber
+            # (shard clones, engine, planners) sees the refreshed device
+            # state. Runs inside the drain barrier: no flight is in the air.
+            _ev.rule_table = ep.rule_table
+            _ev.lowered.table = ep.rule_table
+            _ev.refresh()
+
+        rollout_ctl.subscribe("evaluator", _sub_evaluator)
+        dispatch_evaluator = tpu_evaluator
         mesh_conf = tpu_conf.get("mesh", {}) or {}
         shards_knob = mesh_conf.get("shards", 0)
         n_shards = 0
@@ -277,17 +306,11 @@ def initialize(
             )
             dispatch_evaluator = batcher
 
-            _shards_prev = manager.on_swap
-
-            def _shards_swap(rt, _pool=batcher) -> None:
-                # the base evaluator's refresh hook re-lowers the SHARED
-                # table first; then the clones only need their table pointer
-                # + derived caches refreshed
-                if _shards_prev is not None:
-                    _shards_prev(rt)
-                _pool.refresh_shards(rt)
-
-            manager.on_swap = _shards_swap
+            # the evaluator subscriber re-lowered the SHARED table; the
+            # clones only need their table pointer + derived caches refreshed
+            rollout_ctl.subscribe(
+                "shards", lambda ep, _pool=batcher: _pool.refresh_shards(ep.rule_table)
+            )
         else:
             if fault_spec:
                 from .engine.faults import FaultInjector
@@ -350,6 +373,20 @@ def initialize(
         if s.enabled:
             sentinel = s.attach(batcher)
     rstate.bind_parity(sentinel.storm_shards if sentinel is not None else None)
+
+    # rollout wiring that needs the serving topology: the sentinel drives
+    # the canary (boosted sampling + divergence triggers), the batcher
+    # lanes are what the cutover barrier parks, and the boot table becomes
+    # epoch 1. Front ends carry neither — their epoch arrives in STATUS
+    # frames from the batcher process.
+    rollout_ctl.sentinel = sentinel
+    if role != "frontend":
+        if batcher is not None and hasattr(batcher, "swap_lanes"):
+            rollout_ctl.bind_lanes(batcher.swap_lanes())
+        elif batcher is not None:
+            rollout_ctl.bind_lanes([batcher])
+        rollout_ctl.seed(manager.rule_table)
+        rstate.bind_epoch(rollout_ctl.epoch_info)
 
     # pressure monitor: bind whatever saturation sources this role actually
     # has (zero-arg callables, read defensively at sample time) and start
@@ -465,50 +502,28 @@ def initialize(
         tpu_batch_threshold=1 if batcher is not None else int(tpu_conf.get("batchThreshold", 5)),
     )
 
-    # keep the engine pointed at the latest table after swaps
-    prev_hook = manager.on_swap
-
-    def swap_engine(rt) -> None:
-        engine.rule_table = rt
+    # keep the engine pointed at the latest table after cutovers
+    def _sub_engine(ep) -> None:
+        engine.rule_table = ep.rule_table
         # keep traffic on the batcher (it wraps the refreshed evaluator);
         # rewiring to the raw evaluator here would silently drop
         # cross-request batching after the first policy reload
         engine.tpu_evaluator = dispatch_evaluator
-        if prev_hook is not None:
-            prev_hook(rt)
 
-    if prev_hook is None:
-        manager.on_swap = swap_engine
-    else:
-        # evaluator hook already set; chain engine update after it
-        def chained(rt) -> None:
-            prev_hook(rt)
-            engine.rule_table = rt
-
-        manager.on_swap = chained
+    rollout_ctl.subscribe("engine", _sub_engine)
 
     aux_mgr = AuxDataManager.from_config(config.section("auxData"))
 
     limits_conf = config.get("server.requestLimits", {}) or {}
     planner = Planner(manager.rule_table, schema_mgr=schema_mgr)
-
-    def planner_swap(rt) -> None:
-        planner.rt = rt
-
-    outer = manager.on_swap
-
-    def with_planner(rt) -> None:
-        if outer is not None:
-            outer(rt)
-        planner_swap(rt)
-
-    manager.on_swap = with_planner
+    rollout_ctl.subscribe("planner", lambda ep, _p=planner: setattr(_p, "rt", ep.rule_table))
 
     # static policy analysis: published at boot and republished on every
-    # swap so cerbos_tpu_policy_analysis_total and /_cerbos/debug/analysis
-    # always describe the table currently serving. The device-owning roles
-    # reuse the evaluator's lowering (already refreshed by its swap hook,
-    # chained above); other roles lower an audit copy.
+    # cutover so cerbos_tpu_policy_analysis_total and /_cerbos/debug/analysis
+    # always describe the table currently serving. A gated rollout already
+    # analyzed the shadow lowering — that report is republished verbatim;
+    # ungated commits (rollout disabled, passive front ends) analyze fresh,
+    # reusing the evaluator's lowering where one exists.
     from .tpu import analyze as _analyze
 
     engine_globals = dict(engine_conf.get("globals", {}) or {})
@@ -521,14 +536,14 @@ def initialize(
             _log.exception("policy analysis failed; keeping previous report")
 
     publish_analysis(manager.rule_table)
-    _prev_analysis = manager.on_swap
 
-    def with_analysis(rt) -> None:
-        if _prev_analysis is not None:
-            _prev_analysis(rt)
-        publish_analysis(rt)
+    def _sub_analysis(ep) -> None:
+        if getattr(ep, "analysis_report", None) is not None:
+            _analyze.publish(ep.analysis_report)
+        else:
+            publish_analysis(ep.rule_table)
 
-    manager.on_swap = with_analysis
+    rollout_ctl.subscribe("analysis", _sub_analysis)
 
     # batched PlanResources: attach a BatchPlanner to the (first) batcher
     # lane so concurrent plan queries coalesce into vectorized partial-
@@ -552,14 +567,10 @@ def initialize(
             )
             plan_lane.plan_planner = batch_planner
             plan_batcher = plan_lane
-            _prev_plan = manager.on_swap
-
-            def with_batch_planner(rt) -> None:
-                if _prev_plan is not None:
-                    _prev_plan(rt)
-                batch_planner.refresh(rt)
-
-            manager.on_swap = with_batch_planner
+            rollout_ctl.subscribe(
+                "batch-planner",
+                lambda ep, _bp=batch_planner: _bp.refresh(ep.rule_table),
+            )
         except Exception:
             _log.exception("batched planner unavailable; PlanResources stays sequential")
 
@@ -585,6 +596,7 @@ def initialize(
         tpu_evaluator=tpu_evaluator,
         batcher=batcher,
         sentinel=sentinel,
+        rollout=rollout_ctl,
     )
 
 

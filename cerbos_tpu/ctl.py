@@ -24,7 +24,14 @@ class Client:
         token = base64.b64encode(f"{username}:{password}".encode()).decode()
         self.headers = {"Authorization": f"Basic {token}", "Content-Type": "application/json"}
 
-    def call(self, method: str, path: str, body: dict | None = None, params: dict | None = None):
+    def call(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        params: dict | None = None,
+        timeout: float = 30,
+    ):
         url = self.base + path
         if params:
             pairs = []
@@ -37,7 +44,7 @@ class Client:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, headers=self.headers, method=method)
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
@@ -75,7 +82,14 @@ class GrpcClient:
         except grpc.RpcError as e:
             raise SystemExit(f"error: {e.code().name} {e.details()}") from None
 
-    def call(self, method: str, path: str, body: dict | None = None, params: dict | None = None):
+    def call(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        params: dict | None = None,
+        timeout: float = 30,
+    ):
         from google.protobuf import json_format
 
         from .api.cerbos.policy.v1 import policy_pb2
@@ -124,8 +138,18 @@ class GrpcClient:
             r = self._rpc("DeleteSchema", self.req.DeleteSchemaRequest(id=params.get("id", [])), self.resp.DeleteSchemaResponse)
             return {"deletedSchemas": r.deleted_schemas}
         if path == "/admin/store/reload":
+            if (params or {}).get("wait"):
+                raise SystemExit(
+                    "error: the gRPC admin API has no staged-reload report; "
+                    "use the HTTP transport for store reload --wait"
+                )
             self._rpc("ReloadStore", self.req.ReloadStoreRequest(), self.resp.ReloadStoreResponse)
             return {}
+        if path == "/admin/store/rollback":
+            raise SystemExit(
+                "error: the gRPC admin API has no store rollback (match the "
+                "reference); use the HTTP transport"
+            )
         if path.startswith("/admin/auditlog/list/"):
             kind_name = path.rsplit("/", 1)[-1]
             kind = (
@@ -361,6 +385,52 @@ def _analyze_cmd(args) -> int:
     return 0
 
 
+def _print_rollout_report(report: dict) -> None:
+    """Render a rollout run report (``/admin/store/reload?wait=1`` payload)
+    as a stage-by-stage verdict: ladder, gate findings with stable reason
+    codes, replay diffs, canary result, terminal outcome."""
+    head = f"rollout #{report.get('generation', '?')} [{report.get('trigger', '')}]"
+    epochs = f"epoch {report.get('from_epoch')} -> {report.get('to_epoch')}"
+    bundle = report.get("bundle_hash") or "?"
+    print(f"{head}  {epochs}  bundle {bundle}")
+    for st in report.get("stages", []):
+        line = f"  {st.get('stage', '?'):<10} {st.get('status', '?'):<12} {st.get('seconds', 0.0):>8.3f}s"
+        extra = {
+            k: v
+            for k, v in st.items()
+            if k not in ("stage", "status", "seconds") and v not in (None, "", [], {})
+        }
+        if extra:
+            line += "  " + " ".join(f"{k}={v}" for k, v in extra.items())
+        print(line)
+    gate = report.get("gate") or {}
+    analysis = gate.get("analysis")
+    if analysis:
+        print(f"  gate analysis: {json.dumps(analysis)}")
+    for f in gate.get("findings") or []:
+        print(
+            f"    finding [{f.get('severity', '?')}] {f.get('code', '?')} "
+            f"{f.get('policy', '')}/{f.get('rule', '')}: {f.get('message', '')}"
+        )
+    replay = gate.get("replay")
+    if replay:
+        print(
+            f"  gate replay: {replay.get('replayed', 0)} inputs, "
+            f"{replay.get('diffs', 0)} effect diffs, {replay.get('errors', 0)} errors"
+        )
+        for s in replay.get("samples") or []:
+            print(
+                f"    diff {s.get('principal')} on {s.get('resource')}: "
+                f"{s.get('old')} -> {s.get('new')}"
+            )
+    canary = report.get("canary") or {}
+    if canary:
+        print(f"  canary: {json.dumps(canary)}")
+    outcome = report.get("outcome", "?")
+    err = report.get("error")
+    print(f"outcome: {outcome}" + (f" ({err})" if err else ""))
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="cerbos-tpuctl", description="Admin client for cerbos-tpu PDPs")
     parser.add_argument("--server", default="127.0.0.1:3592")
@@ -391,7 +461,15 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument("ids", nargs="+")
 
     p_store = sub.add_parser("store", help="store operations")
-    p_store.add_argument("op", choices=["reload"])
+    p_store.add_argument("op", choices=["reload", "rollback"])
+    p_store.add_argument(
+        "--wait", action="store_true",
+        help="block until the staged rollout finishes and print its stage-by-stage verdict",
+    )
+    p_store.add_argument(
+        "--timeout", type=float, default=120.0,
+        help="seconds to wait for the rollout report (with --wait)",
+    )
 
     p_audit = sub.add_parser("audit", help="browse audit log entries")
     p_audit.add_argument("--kind", choices=["access", "decision"], default="decision")
@@ -490,8 +568,22 @@ def main(argv: list[str] | None = None) -> int:
         key = "enabledPolicies" if args.command == "enable" else "disabledPolicies"
         print(f"{args.command}d {resp.get(key, 0)}")
     elif args.command == "store":
-        client.call("GET", "/admin/store/reload")
-        print("store reload triggered")
+        if args.op == "rollback":
+            report = client.call("GET", "/admin/store/rollback")
+            _print_rollout_report(report)
+        elif args.wait:
+            report = client.call(
+                "GET",
+                "/admin/store/reload",
+                params={"wait": "1", "timeoutSec": str(args.timeout)},
+                timeout=args.timeout + 10,
+            )
+            _print_rollout_report(report)
+            if report.get("outcome") not in ("serving",):
+                return 1
+        else:
+            client.call("GET", "/admin/store/reload")
+            print("store reload triggered")
     elif args.command == "decisions":
         return _decisions_browser(client, tail=args.tail, follow=args.follow, interval=args.interval)
     elif args.command == "audit":

@@ -104,6 +104,10 @@ class _Pending:
     # pendings carry PlanInputs for the attached batched planner and ride
     # the dedicated low-priority plan lane
     kind: str = "check"
+    # the policy epoch this request's batch was submitted under — assigned
+    # on the drain thread at submit time (happens-before the future
+    # resolves), read back on the request thread to stamp the decision
+    epoch: Optional[int] = None
 
 
 class _Lane:
@@ -360,6 +364,14 @@ class BatchingEvaluator:
         self._stop = False
         self._dead: Optional[BaseException] = None
         self._draining: list[_Pending] = []
+        # policy epoch this lane is serving (rollout.py stamps it inside the
+        # cutover barrier); None until a RolloutController seeds/commits one
+        self.epoch: Optional[int] = None
+        # pending cutover barrier (rollout.SwapBarrier): when set, the drain
+        # loop submits nothing new, collects every in-flight batch, then
+        # parks at the flight boundary until the controller releases it —
+        # the mechanism that guarantees no request spans two rule tables
+        self._swap_barrier: Optional[Any] = None
         self._qlock = threading.Lock()
         self._quarantine: dict[int, bool] = {}  # insertion-ordered, bounded
         self._bisect_busy = False
@@ -459,8 +471,12 @@ class BatchingEvaluator:
         if wf is not None:
             wf.note_fallback(reason)
         ev = self.evaluator
+        # read the table once: a cutover between inputs must not split this
+        # request across two tables; the epoch stamp travels with the table
+        rt = ev.rule_table
+        T.set_current_epoch(getattr(rt, "policy_epoch", None))
         out = [
-            check_input(ev.rule_table, i, params or T.EvalParams(), ev.schema_mgr)
+            check_input(rt, i, params or T.EvalParams(), ev.schema_mgr)
             for i in inputs
         ]
         if wf is not None:
@@ -546,7 +562,11 @@ class BatchingEvaluator:
             if deadline is not None:
                 wait = min(wait, max(0.0, deadline - time.monotonic()))
             try:
-                return fut.result(timeout=wait)
+                outs = fut.result(timeout=wait)
+                # assigned on the drain thread at submit time (after the
+                # cutover-barrier check): the epoch this batch actually ran on
+                T.set_current_epoch(pending.epoch)
+                return outs
             except DeadlineExceeded:
                 span.set_attribute("outcome", "deadline_exceeded")
                 raise
@@ -737,6 +757,22 @@ class BatchingEvaluator:
         with self._lock:
             return bool(self._queue)
 
+    # -- cutover barrier ----------------------------------------------------
+
+    def request_swap(self, barrier: Any) -> bool:
+        """Ask the drain loop to park at its next flight boundary: it stops
+        submitting, collects every in-flight batch, then calls
+        ``barrier.park(self)`` until the rollout controller has swapped the
+        shared tables (rollout.SwapBarrier). Returns False when the drain
+        loop is dead or stopping — no flight can race the swap then, and
+        the controller must not wait for a thread that will never park."""
+        with self._wakeup:
+            if self._stop or self._dead is not None or not self._thread.is_alive():
+                return False
+            self._swap_barrier = barrier
+            self._wakeup.notify_all()
+        return True
+
     # -- drain loop ---------------------------------------------------------
 
     def _loop(self) -> None:
@@ -767,24 +803,34 @@ class BatchingEvaluator:
             with self._wakeup:
                 if self._stop:
                     break
-                if not self._queue:
+                barrier = self._swap_barrier
+                if barrier is None and not self._queue:
                     if not inflight:
                         self._wakeup.wait()
                         continue
-                elif not inflight and self.max_wait > 0:
+                elif barrier is None and not inflight and self.max_wait > 0:
                     # small wait to let concurrent requests coalesce (only
                     # while the pipeline is empty: with batches in flight the
                     # collect below provides the coalescing window for free)
                     deadline = time.monotonic() + self.max_wait
-                    while len(self._queue) < self.min_batch_to_wait and not self._stop:
+                    while (
+                        len(self._queue) < self.min_batch_to_wait
+                        and not self._stop
+                        and self._swap_barrier is None
+                    ):
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
                         self._wakeup.wait(remaining)
+                    barrier = self._swap_barrier
                 pending: list[_Pending] = []
                 total = 0
                 now = time.monotonic()
-                while self._queue and total < self.max_batch:
+                # with a cutover barrier pending, submit nothing new: the
+                # queue keeps admitting (requests just wait out the barrier),
+                # while the collect loop below drains the device pipeline to
+                # the flight boundary the swap requires
+                while barrier is None and self._queue and total < self.max_batch:
                     p = self._queue.peek()
                     if pending and total + len(p.inputs) > self.max_batch:
                         break
@@ -815,16 +861,31 @@ class BatchingEvaluator:
             # arrive; re-check the queue between collects so a fresh burst
             # re-enters the submit path with batches still in flight).
             while inflight:
-                if len(inflight) < self.max_inflight and self._queue_nonempty():
+                if (
+                    barrier is None
+                    and len(inflight) < self.max_inflight
+                    and self._queue_nonempty()
+                ):
                     break
                 self._collect(inflight.popleft())
                 self.m_inflight.set(len(inflight))
+            if barrier is not None:
+                # flight boundary reached: nothing in flight, nothing mid-
+                # submit. Park here while the controller swaps the shared
+                # tables and stamps the new epoch, then resume draining.
+                barrier.park(self)
+                with self._wakeup:
+                    if self._swap_barrier is barrier:
+                        self._swap_barrier = None
 
     def _submit(self, pending: list[_Pending], inflight: deque) -> None:
         # group by (kind, params identity): globals etc. must match within a
         # batch, and plan pendings must never mix into a device check batch
         groups: dict[tuple[str, int], list[_Pending]] = {}
         for p in pending:
+            # the epoch pin: everything submitted between two cutover
+            # barriers ran against exactly this lane epoch's tables
+            p.epoch = self.epoch
             groups.setdefault((p.kind, id(p.params)), []).append(p)
         now = time.perf_counter()
         shard = self.shard_id if self.shard_id is not None else 0

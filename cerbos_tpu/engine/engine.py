@@ -74,7 +74,12 @@ class Engine:
                 from ..ruletable import check_input
 
                 span.set_attribute("path", "serial")
-                outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
+                # read the table once: a rollout cutover between inputs must
+                # not split one request across two tables, and the epoch
+                # stamp must describe the table actually used
+                rt = self.rule_table
+                T.set_current_epoch(getattr(rt, "policy_epoch", None))
+                outputs = [check_input(rt, i, params, self.schema_mgr) for i in inputs]
                 if wf is not None:
                     wf.mark("evaluate")
         if self.on_decision is not None:
@@ -124,7 +129,10 @@ class Engine:
                 from ..ruletable import check_input
 
                 span.set_attribute("path", "serial")
-                outputs = [check_input(self.rule_table, i, params, self.schema_mgr) for i in inputs]
+                # single table read per request — see check() above
+                rt = self.rule_table
+                T.set_current_epoch(getattr(rt, "policy_epoch", None))
+                outputs = [check_input(rt, i, params, self.schema_mgr) for i in inputs]
                 if wf is not None:
                     wf.mark("evaluate")
         if self.on_decision is not None:

@@ -34,6 +34,12 @@ silently disable a chaos run):
   wrapper: scope the whole spec to shard lane N of the sharded serving
   pool (the shard-kill chaos drill: one sick chip, N-1 healthy siblings).
   Without it, every lane gets the injector.
+- ``swap_fail:STAGE`` — consumed by ``engine/rollout.RolloutController``,
+  not this wrapper: force the named rollout stage to fail. ``build``,
+  ``lower``, and ``gate`` raise at that stage (the last valid epoch keeps
+  serving); ``canary`` trips the canary watcher, driving an automatic
+  rollback drill. Scopable with ``shard:N`` like every other knob (the
+  scope is recorded in the rollout report).
 - ``seed:N`` — PRNG seed for the probabilistic knobs (default 1337).
 
 The wrapper delegates every other attribute (``rule_table``,
@@ -55,7 +61,11 @@ class DeviceFault(RuntimeError):
 
 _FLOAT_KNOBS = {"submit_raise", "collect_raise", "check_raise", "wedge_sleep_s", "flip_effect"}
 _INT_KNOBS = {"submit_delay_ms", "collect_delay_ms", "wedge_after", "ipc_wedge_after", "seed", "shard"}
-_STR_KNOBS = {"poison_attr"}
+_STR_KNOBS = {"poison_attr", "swap_fail"}
+
+# legal swap_fail stages (validated at parse time so a typo'd stage name
+# fails the run instead of silently never firing)
+_SWAP_STAGES = {"build", "lower", "gate", "canary"}
 
 
 def parse_fault_spec(spec: str) -> Dict[str, Any]:
@@ -76,6 +86,11 @@ def parse_fault_spec(spec: str) -> Dict[str, Any]:
         elif name in _INT_KNOBS:
             out[name] = int(raw)
         elif name in _STR_KNOBS:
+            if name == "swap_fail" and raw not in _SWAP_STAGES:
+                raise ValueError(
+                    f"unknown swap_fail stage {raw!r} (want one of "
+                    f"{'|'.join(sorted(_SWAP_STAGES))})"
+                )
             out[name] = raw
         else:
             raise ValueError(f"unknown fault knob {name!r} in spec {spec!r}")

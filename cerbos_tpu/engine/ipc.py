@@ -976,6 +976,20 @@ class RemoteBatcherClient:
             "requests served from the CPU oracle instead of the device path, by reason",
             label="reason",
         )
+        # rollout visibility (engine/rollout.py): the batcher's committed
+        # epoch as observed from this front end, and how long each cutover
+        # took to become visible here — the "bounded, measured skew window"
+        # the epoch design promises. Same family names the device-owning
+        # process exports, so a merged scrape tells the fleet-wide story.
+        self.m_policy_epoch = reg.gauge(
+            "cerbos_tpu_policy_epoch",
+            "policy epoch currently serving (monotone except across a rollback)",
+        )
+        self.m_epoch_skew = reg.gauge(
+            "cerbos_tpu_policy_epoch_skew_seconds",
+            "delay between the batcher committing a policy epoch and this front end observing it",
+        )
+        self._epoch_seen: Optional[int] = None
 
     # -- connection management ----------------------------------------------
 
@@ -1157,10 +1171,29 @@ class RemoteBatcherClient:
                         self._last_status = snap
                         if snap.get("status") in ("ready", "degraded"):
                             self._ever_ready = True
+                        self._note_epoch(snap)
                 except (IpcError, OSError, FutureTimeoutError, TimeoutError, ValueError):
                     pass
             # fast cadence until the first frame lands, configured cadence after
             time.sleep(self.status_poll_s if self._last_status is not None else 0.05)
+
+    def _note_epoch(self, snap: dict) -> None:
+        """Track the batcher's committed epoch as it becomes visible here.
+        The skew gauge is measured on the observing edge: wall-clock now
+        minus the commit timestamp the STATUS frame carried — bounded by
+        the status poll cadence plus the cutover itself."""
+        epoch = snap.get("policy_epoch")
+        if epoch is None:
+            return
+        try:
+            self.m_policy_epoch.set(epoch)
+            if epoch != self._epoch_seen:
+                self._epoch_seen = epoch
+                committed_at = snap.get("policy_epoch_committed_at")
+                if committed_at:
+                    self.m_epoch_skew.set(max(0.0, time.time() - float(committed_at)))
+        except Exception:  # noqa: BLE001 — status bookkeeping never kills the poll loop
+            pass
 
     # -- raw request/response -----------------------------------------------
 
@@ -1208,7 +1241,12 @@ class RemoteBatcherClient:
         if wf is not None:
             wf.note_fallback(reason)
         p = params or self.params
-        out = [check_input(self.rule_table, i, p, self.schema_mgr) for i in inputs]
+        # single table read per request; the local COW table is never epoch-
+        # committed (the batcher owns epoch authority), so local fallbacks
+        # stamp None — honestly unversioned — rather than a guessed epoch
+        rt = self.rule_table
+        T.set_current_epoch(getattr(rt, "policy_epoch", None))
+        out = [check_input(rt, i, p, self.schema_mgr) for i in inputs]
         if wf is not None:
             # books everything since the last mark — including any dead
             # round trip that preceded the fallback — as the oracle stage
@@ -1312,6 +1350,12 @@ class RemoteBatcherClient:
     def _decode_result(
         self, payload: bytes, wf: Optional[Waterfall], transport: str = "uds"
     ) -> list[T.CheckOutput]:
+        # the batcher evaluated this ticket under its current epoch; the
+        # nearest view this side of the socket is the last STATUS frame —
+        # exact to within the measured skew window the epoch gauges expose
+        last = self._last_status
+        if last is not None:
+            T.set_current_epoch(last.get("policy_epoch"))
         t0 = time.perf_counter_ns()
         if transport == "shm":
             outs, spec = native.get().reply_unpack(

@@ -101,6 +101,10 @@ class PressureMonitor:
         self._lock = threading.Lock()
         self._queue_samples: deque = deque()   # (t, load fraction)
         self._counter_samples: deque = deque()  # (t, fallbacks, decisions, storms)
+        # last aggregate score, readable without triggering a sample (the
+        # rollout canary polls this — calling sample() from outside the
+        # ticker would double-fire the brownout observers)
+        self.last_score = 0.0
         self._high = False
         self._ticker: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -248,6 +252,7 @@ class PressureMonitor:
         self.m_degraded.set(degraded)
         self.m_compile.set(compile_frac)
         self.m_score.set(score)
+        self.last_score = score
 
         if score >= HIGH_WATER and not self._high:
             self._high = True

@@ -60,6 +60,7 @@ class ReadinessState:
         self._remote: Optional[Callable[[], dict]] = None
         self._parity: Optional[Callable[[], list]] = None
         self._brownout: Optional[Callable[[], str]] = None
+        self._epoch: Optional[Callable[[], dict]] = None
         self.m_state.set(_STATUS_CODE["ready"])
 
     # -- transitions (driven by bootstrap / the warmup driver) -------------
@@ -109,6 +110,24 @@ class ReadinessState:
         stage name (still serving — shedding optional work IS how the
         service stays live). ``provider`` returns the stage name or ''."""
         self._brownout = provider
+
+    def bind_epoch(self, provider: Optional[Callable[[], dict]]) -> None:
+        """Wire the rollout controller's epoch block in: ``{"policy_epoch":
+        N, "policy_epoch_committed_at": wall_ts, ...}`` merged into every
+        snapshot. Because the shared batcher's STATUS frames are built from
+        this snapshot, front ends learn about cutovers on their next status
+        poll with no IPC frame change — ``committed_at`` is the wall-clock
+        reference the skew gauge measures against."""
+        self._epoch = provider
+
+    def _epoch_info(self) -> dict:
+        provider = getattr(self, "_epoch", None)
+        if provider is None:
+            return {}
+        try:
+            return dict(provider() or {})
+        except Exception:
+            return {}
 
     def bind_remote(self, provider: Optional[Callable[[], dict]]) -> None:
         """Front-end mode: this process has no device of its own — readiness
@@ -212,6 +231,7 @@ class ReadinessState:
             # wrong answers; brownout only signals shed work)
             out.setdefault("reason", "brownout")
             out["brownout_stage"] = brownout_stage
+        out.update(self._epoch_info())
         return out
 
 

@@ -57,6 +57,7 @@ DEFAULT_WINDOW_SEC = 60.0
 DEFAULT_STORM_THRESHOLD = 3
 DEFAULT_CORPUS_MAX = 64
 DEFAULT_BACKLOG = 64
+DEFAULT_RECENT_INPUTS = 512
 
 
 # -- effect-row comparison ---------------------------------------------------
@@ -287,6 +288,14 @@ class ParitySentinel:
         # brownout shed flag (engine/brownout.py shed_parity): sampling
         # pauses while set, the worker and backlog stay intact
         self._shed = False
+        # rollout-canary boost (engine/rollout.py): for a bounded window
+        # after a cutover the sentinel samples at an elevated rate so a bad
+        # epoch is caught inside canarySec, not at the steady-state rate
+        self._boost_rate = 0.0
+        self._boost_until = 0.0
+        # bounded ring of recently sampled live inputs — the rollout gate's
+        # differential-replay corpus alongside the on-disk divergence corpus
+        self.recent: deque[T.CheckInput] = deque(maxlen=DEFAULT_RECENT_INPUTS)
         self.stats = {
             "seen": 0,
             "sampled": 0,
@@ -392,6 +401,39 @@ class ParitySentinel:
             0.0 if self._shed or not self.enabled else self.sample_rate
         )
 
+    def set_boost(self, rate: float, duration_s: float) -> None:
+        """Rollout-canary hook: sample at ``max(rate, sample_rate)`` for the
+        next ``duration_s`` seconds, then fall back to the configured rate
+        automatically (no timer thread — expiry is checked on the sampling
+        path). The exported rate gauge tracks the boost so the elevated
+        window is visible on dashboards."""
+        rate = min(1.0, max(0.0, float(rate)))
+        with self._lock:
+            self._boost_rate = rate
+            self._boost_until = self._clock() + max(0.0, float(duration_s))
+        if self.enabled and not self._shed:
+            self.m_rate.set(max(rate, self.sample_rate))
+
+    def _effective_rate(self) -> float:
+        """Current sampling rate honoring an active canary boost (caller
+        holds ``self._lock``)."""
+        if self._boost_until > 0.0:
+            if self._clock() < self._boost_until:
+                return max(self.sample_rate, self._boost_rate)
+            # boost expired: restore the steady-state gauge once
+            self._boost_until = 0.0
+            self._boost_rate = 0.0
+            self.m_rate.set(
+                0.0 if self._shed or not self.enabled else self.sample_rate
+            )
+        return self.sample_rate
+
+    def recent_inputs(self) -> list:
+        """A bounded snapshot of recently sampled live inputs (newest last)
+        — the rollout gate replays these old-vs-new before a cutover."""
+        with self._lock:
+            return list(self.recent)
+
     # -- hot path (batcher drain thread) ------------------------------------
 
     def should_sample(self, shard: int) -> bool:
@@ -405,7 +447,7 @@ class ParitySentinel:
             st = self._lanes.setdefault(shard, _LaneState())
             st.seen += 1
             self.stats["seen"] += 1
-            st.acc += self.sample_rate
+            st.acc += self._effective_rate()
             if st.acc < 1.0:
                 return False
             st.acc -= 1.0
@@ -425,6 +467,8 @@ class ParitySentinel:
             inputs: list[T.CheckInput] = []
             for p in group:
                 inputs.extend(p.inputs)
+            with self._lock:
+                self.recent.extend(inputs)
             ev = batcher.evaluator
             sample = _Sample(
                 shard=shard,
@@ -462,7 +506,7 @@ class ParitySentinel:
         with self._lock:
             st = self._plan_lanes.setdefault(shard, _LaneState())
             st.seen += 1
-            st.acc += self.sample_rate
+            st.acc += self._effective_rate()
             if st.acc < 1.0:
                 return False
             st.acc -= 1.0

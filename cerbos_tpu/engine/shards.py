@@ -178,6 +178,12 @@ class ShardedBatchingEvaluator:
             ev.rule_table = rule_table
             ev.invalidate()
 
+    def swap_lanes(self) -> list[Any]:
+        """The per-shard BatchingEvaluators a rollout cutover must park at a
+        flight boundary before mutating the shared lowered tables — the
+        clones all read those tables, so the barrier is pool-wide."""
+        return list(self.shards)
+
     # -- aggregate views ----------------------------------------------------
 
     @property

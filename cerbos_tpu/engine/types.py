@@ -30,6 +30,25 @@ def set_current_shard(shard: Optional[int]) -> None:
 def current_shard() -> Optional[int]:
     return _current_shard.get()
 
+
+# which policy epoch the current request was evaluated against — stamped by
+# the evaluator that actually resolved the request (batcher device path,
+# oracle fallbacks, the serial engine path, or the IPC client from its last
+# STATUS frame) and read by the service layer into audit decision entries,
+# making mixed-table evaluation directly observable (ISSUE 18).
+_current_epoch: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "cerbos_tpu_current_epoch", default=None
+)
+
+
+def set_current_epoch(epoch: Optional[int]) -> None:
+    _current_epoch.set(epoch)
+
+
+def current_epoch() -> Optional[int]:
+    return _current_epoch.get()
+
+
 EFFECT_ALLOW = "EFFECT_ALLOW"
 EFFECT_DENY = "EFFECT_DENY"
 EFFECT_NO_MATCH = "EFFECT_NO_MATCH"

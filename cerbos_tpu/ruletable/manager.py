@@ -29,6 +29,11 @@ class RuleTableManager:
     ):
         self.store = store
         self.on_swap = on_swap
+        # when a RolloutController is attached (bootstrap), storage events
+        # are delegated to its staged build→gate→cutover path and the
+        # on_swap chain is never consulted; a gate-rejected bundle leaves
+        # self.rule_table untouched
+        self.rollout: Optional[Any] = None
         self._lock = threading.RLock()
         # a prebuilt table (bootstrap.prebuild, COW-shared across forked
         # workers) skips the parse+compile+build pipeline; storage events
@@ -50,13 +55,32 @@ class RuleTableManager:
             policies = self.store.get_all()
             return build_rule_table(compile_policy_set(policies))
 
+    def build_table(self) -> RuleTable:
+        """Build a fresh table off the serving path (the rollout
+        controller's shadow-build stage). ``self.rule_table`` is untouched."""
+        with self._lock:
+            return self._build()
+
+    def commit_table(self, new_table: RuleTable) -> None:
+        """Atomically publish a gated table (the rollout controller's
+        cutover stage — called inside the lane drain barrier)."""
+        with self._lock:
+            self.rule_table = new_table
+
     def on_storage_event(self, events: list[Event]) -> None:
         """Rebuild into a fresh table and swap the pointer atomically, so
         in-flight checks keep reading a consistent table and failures keep
         the last valid state (ref: manager.go:74-84,108-111). Incremental
         delete/ingest on the live table stays available to the Admin API via
         RuleTable directly; the event path always swaps whole tables, which
-        doubles as the device-table double-buffering (SURVEY.md §7.8)."""
+        doubles as the device-table double-buffering (SURVEY.md §7.8).
+
+        With a rollout controller attached, the whole sequence — shadow
+        build, analyzer gate, differential replay, epoch-versioned barrier
+        cutover, canary — replaces the bare build-and-swap below."""
+        if self.rollout is not None:
+            self.rollout.on_storage_event(events)
+            return
         with self._lock:
             try:
                 new_table = self._build()

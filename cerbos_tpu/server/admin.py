@@ -75,6 +75,7 @@ class AdminService:
         app.router.add_get("/admin/schema", self._h_get_schema)
         app.router.add_delete("/admin/schema", self._h_delete_schema)
         app.router.add_get("/admin/store/reload", self._h_reload_store)
+        app.router.add_get("/admin/store/rollback", self._h_rollback_store)
         app.router.add_get("/admin/auditlog/list/{kind}", self._h_audit_list)
         app.router.add_post("/admin/policies/inspect", self._h_inspect)
 
@@ -420,10 +421,56 @@ class AdminService:
         return web.json_response({"results": run_inspection(self.core.store.get_all())})
 
     async def _h_reload_store(self, request: web.Request) -> web.Response:
+        """``?wait=1`` blocks until the rollout triggered by this reload
+        reaches a terminal stage and returns its full report — the payload
+        ``cerbos-tpuctl store reload --wait`` renders stage by stage. The
+        bare form keeps the historical fire-and-forget contract."""
         if (resp := self._guard(request)) is not None:
             return resp
-        self.core.store.reload()
-        return web.json_response({})
+        ctl = getattr(self.core.manager, "rollout", None)
+        if not request.query.get("wait") or ctl is None:
+            self.core.store.reload()
+            return web.json_response({})
+        import asyncio
+        import json
+
+        timeout = float(request.query.get("timeoutSec", "120"))
+        gen = ctl.generation
+        loop = asyncio.get_running_loop()
+        # the reload itself runs the whole staged rollout synchronously
+        # (build → gate → cutover); keep the event loop free while it does
+        await loop.run_in_executor(None, self.core.store.reload)
+        report = await loop.run_in_executor(None, lambda: ctl.wait_report(gen, timeout))
+        if report is None:
+            return web.json_response(
+                {"code": 4, "message": f"no rollout report within {timeout:g}s"}, status=504
+            )
+        return web.json_response(
+            report, dumps=lambda o: json.dumps(o, default=str)
+        )
+
+    async def _h_rollback_store(self, request: web.Request) -> web.Response:
+        """Operator rollback: reinstate the still-resident previous epoch
+        (``cerbos-tpuctl store rollback``)."""
+        if (resp := self._guard(request)) is not None:
+            return resp
+        ctl = getattr(self.core.manager, "rollout", None)
+        if ctl is None:
+            return web.json_response(
+                {"code": 9, "message": "no rollout controller attached"}, status=400
+            )
+        import asyncio
+        import json
+
+        loop = asyncio.get_running_loop()
+        report = await loop.run_in_executor(
+            None, lambda: ctl.rollback(reason=request.query.get("reason", "operator"))
+        )
+        if report is None:
+            return web.json_response(
+                {"code": 9, "message": "no previous epoch resident to roll back to"}, status=400
+            )
+        return web.json_response(report, dumps=lambda o: json.dumps(o, default=str))
 
     async def _h_audit_list(self, request: web.Request) -> web.Response:
         if (resp := self._guard(request)) is not None:

@@ -738,6 +738,7 @@ class Server:
         app.router.add_get("/_cerbos/debug/transport", self._h_transport)
         app.router.add_get("/_cerbos/debug/overload", self._h_overload)
         app.router.add_get("/_cerbos/debug/analysis", self._h_analysis)
+        app.router.add_get("/_cerbos/debug/rollout", self._h_rollout)
         app.router.add_get("/_cerbos/debug/profile", self._h_profile)
         app.router.add_get("/api/server_info", self._h_server_info)
         # OpenAPI document + self-contained API explorer (ref: server.go:441-447)
@@ -922,6 +923,32 @@ class Server:
             return web.json_response(report.summary())
         loop = asyncio.get_running_loop()
         body = await loop.run_in_executor(None, report.to_dict)
+        return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _h_rollout(self, request: web.Request) -> web.Response:
+        """Policy-rollout state for THIS process: the serving epoch, the
+        still-resident rollback history, lane epoch stamps, and the recent
+        run reports (stage ladder, gate verdict with analyzer findings and
+        replay diffs, canary outcome). A front end has no epoch authority —
+        it reports what the batcher's STATUS frames last carried, which is
+        exactly the bounded-skew view its decisions are stamped with."""
+        from ..engine import rollout as rollout_mod
+
+        ctl = rollout_mod.active()
+        if ctl is None:
+            return web.json_response(
+                {"error": "no rollout controller (core not bootstrapped)"}, status=404
+            )
+        body = ctl.snapshot()
+        ev = getattr(self.svc.engine, "tpu_evaluator", None)
+        if body.get("mode") == "passive" and ev is not None and hasattr(ev, "remote_status"):
+            with contextlib.suppress(Exception):
+                last = ev.remote_status() or {}
+                body["batcher"] = {
+                    k: last.get(k)
+                    for k in ("policy_epoch", "policy_epoch_committed_at", "rollout_stage")
+                    if k in last
+                }
         return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
 
     async def _h_transport(self, request: web.Request) -> web.Response:

@@ -108,9 +108,11 @@ class CerbosService:
             "request.CheckResources", parent=trace_ctx, resources=len(inputs)
         ) as span:
             span.set_attribute("call_id", call_id)
-            # clear any shard affinity left by a previous request on this
-            # thread; the batcher re-stamps it if the device path is taken
+            # clear any shard/epoch affinity left by a previous request on
+            # this thread; the evaluator that resolves this request
+            # re-stamps both
             T.set_current_shard(None)
+            T.set_current_epoch(None)
             if wf is not None and not wf.trace_id:
                 wf.trace_id = span.context.trace_id
             outputs = self.engine.check(
@@ -120,7 +122,12 @@ class CerbosService:
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
             self.audit_log.write_decision(
-                call_id, inputs, outputs, trace_id=trace_id, shard=T.current_shard()
+                call_id,
+                inputs,
+                outputs,
+                trace_id=trace_id,
+                shard=T.current_shard(),
+                epoch=T.current_epoch(),
             )
         return outputs, call_id
 
@@ -157,6 +164,7 @@ class CerbosService:
         ) as span:
             span.set_attribute("call_id", call_id)
             T.set_current_shard(None)
+            T.set_current_epoch(None)
             if wf is not None and not wf.trace_id:
                 wf.trace_id = span.context.trace_id
             outputs = await self.engine.check_await(
@@ -166,7 +174,12 @@ class CerbosService:
         self.metrics.record_check((time.perf_counter() - t0) * 1000, len(inputs))
         if self.audit_log is not None:
             self.audit_log.write_decision(
-                call_id, inputs, outputs, trace_id=trace_id, shard=T.current_shard()
+                call_id,
+                inputs,
+                outputs,
+                trace_id=trace_id,
+                shard=T.current_shard(),
+                epoch=T.current_epoch(),
             )
         return outputs, call_id
 

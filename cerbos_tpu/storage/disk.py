@@ -38,6 +38,7 @@ class DiskStore(Store):
         super().__init__()
         self.directory = os.path.abspath(directory)
         self._lock = threading.Lock()
+        self._scan_lock = threading.Lock()  # serializes directory diffs
         self._policies: dict[str, model.Policy] = {}  # fqn -> policy
         self._files: dict[str, tuple[str, float]] = {}  # path -> (fqn, mtime)
         self._watcher: Optional[threading.Thread] = None
@@ -92,6 +93,17 @@ class DiskStore(Store):
         with self._lock:
             self._policies = policies
             self._files = files
+
+    def reload(self) -> None:
+        """Operator-triggered reload (Admin API store/reload): rescan the
+        directory FIRST so subscribers rebuild from what is on disk now.
+        The base EVENT_RELOAD contract rebuilds from the cached snapshot,
+        which would miss on-disk edits until the next watch poll — or
+        forever with watching disabled. An unchanged directory still emits
+        the historical full-rebuild signal so ``reload --wait`` always has
+        a rollout run to report on."""
+        if not self.check_for_changes():
+            super().reload()
 
     def get_all(self) -> list[model.Policy]:
         with self._lock:
@@ -149,7 +161,15 @@ class DiskStore(Store):
                 logging.getLogger("cerbos_tpu.storage.disk").exception("watch cycle failed")
 
     def check_for_changes(self) -> list[Event]:
-        """Diff the directory against the last snapshot; emit targeted events."""
+        """Diff the directory against the last snapshot; emit targeted events.
+
+        Serialized: an operator reload racing the watch poll must not both
+        diff against the same stale snapshot and double-notify (each event
+        triggers a full staged rollout downstream)."""
+        with self._scan_lock:
+            return self._check_for_changes_locked()
+
+    def _check_for_changes_locked(self) -> list[Event]:
         with self._lock:
             old_files = dict(self._files)
             old_policies = dict(self._policies)

@@ -514,7 +514,11 @@ class TestServerReadiness:
         assert status == 200  # liveness never gates on warmup
         status, body, _ = http_get_status(server, "/_cerbos/ready")
         assert status == 503
-        assert body == {"status": "warming", "compiled_layouts": 0, "expected": 2}
+        # snapshot may carry extra fields (e.g. policy_epoch from the rollout
+        # controller) -- assert the warmup-shaped subset
+        assert body["status"] == "warming"
+        assert body["compiled_layouts"] == 0
+        assert body["expected"] == 2
 
     def test_ready_flips_when_warmup_completes(self, server, restored_readiness):
         restored_readiness.begin_warmup(expected=2)
@@ -525,7 +529,9 @@ class TestServerReadiness:
         restored_readiness.mark_ready()
         status, body, _ = http_get_status(server, "/_cerbos/ready")
         assert status == 200
-        assert body == {"status": "ready", "compiled_layouts": 2, "expected": 2}
+        assert body["status"] == "ready"
+        assert body["compiled_layouts"] == 2
+        assert body["expected"] == 2
         assert grpc_health_check(server) == b"\x08\x01"  # SERVING
 
     def test_degraded_is_still_serving(self, server, restored_readiness):

@@ -533,6 +533,45 @@ class TestMetricsRelabel:
         assert 'cerbos_tpu_cond_compile_unsupported_total{worker="batcher",reason="unsupported_membership"} 3' in merged
         assert 'cerbos_tpu_cond_compile_unsupported_total{worker="fe0",reason="undefined_global"} 1' in merged
 
+    def test_relabel_and_merge_cover_rollout_families(self):
+        """The rollout families span both processes: the batcher owns the
+        rollout machinery (stage counters, epoch gauge), while each front
+        end exports its own policy_epoch plus the skew gauge measuring lag
+        behind the batcher's STATUS frames. A merged scrape must keep the
+        per-worker epochs distinct — epoch disagreement across workers IS
+        the mixed-epoch alert signal."""
+        batcher = (
+            "# TYPE cerbos_tpu_rollout_total counter\n"
+            'cerbos_tpu_rollout_total{stage="gate",outcome="ok"} 4\n'
+            'cerbos_tpu_rollout_total{stage="canary",outcome="rolled_back"} 1\n'
+            "# TYPE cerbos_tpu_rollout_duration_seconds histogram\n"
+            'cerbos_tpu_rollout_duration_seconds_bucket{stage="cutover",le="0.1"} 4\n'
+            'cerbos_tpu_rollout_duration_seconds_sum{stage="cutover"} 0.12\n'
+            "# TYPE cerbos_tpu_policy_epoch gauge\n"
+            "cerbos_tpu_policy_epoch 7\n"
+        )
+        fe = (
+            "# TYPE cerbos_tpu_policy_epoch gauge\n"
+            "cerbos_tpu_policy_epoch 7\n"
+            "# TYPE cerbos_tpu_policy_epoch_skew_seconds gauge\n"
+            "cerbos_tpu_policy_epoch_skew_seconds 0.31\n"
+        )
+        b_rel = relabel_metrics_text(batcher, "worker", "batcher")
+        fe_rel = relabel_metrics_text(fe, "worker", "fe0")
+        assert 'cerbos_tpu_rollout_total{worker="batcher",stage="canary",outcome="rolled_back"} 1' in b_rel
+        assert (
+            'cerbos_tpu_rollout_duration_seconds_bucket{worker="batcher",stage="cutover",le="0.1"} 4'
+            in b_rel
+        )
+        merged = merge_metrics_texts(b_rel, fe_rel)
+        # policy_epoch is registered by BOTH sides: family comment dedupes,
+        # both workers' series survive so skew is observable per process
+        assert merged.count("# TYPE cerbos_tpu_policy_epoch gauge") == 1
+        assert 'cerbos_tpu_policy_epoch{worker="batcher"} 7' in merged
+        assert 'cerbos_tpu_policy_epoch{worker="fe0"} 7' in merged
+        assert 'cerbos_tpu_policy_epoch_skew_seconds{worker="fe0"} 0.31' in merged
+        assert 'cerbos_tpu_rollout_total{worker="batcher",stage="gate",outcome="ok"} 4' in merged
+
     def test_relabel_and_merge_cover_plan_families(self):
         """The batched-planner families ride the same textual machinery:
         mode/path labels survive relabeling, plan traffic booked under

@@ -227,6 +227,11 @@ class TestMetricsLint:
                 "cerbos_tpu_plan_residual_rules",
                 "cerbos_tpu_plan_parity_checks_total",
                 "cerbos_tpu_plan_parity_divergence_total",
+                # safe policy rollout family (engine/rollout.py); the skew
+                # gauge is frontend-only (ipc client) so it is not listed
+                "cerbos_tpu_rollout_total",
+                "cerbos_tpu_rollout_duration_seconds",
+                "cerbos_tpu_policy_epoch",
             ):
                 assert name in inst, name
             known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.GaugeVec, obs.Histogram, obs.HistogramVec)
@@ -265,6 +270,12 @@ class TestMetricsLint:
             # traffic is booked alongside checks (process-global)
             m = inst.get("cerbos_tpu_decisions_total")
             assert isinstance(m, obs.CounterVec) and m.label == ("api", "outcome"), m.label
+            # rollout stage accounting splits on (stage, outcome) so a gate
+            # rejection and a canary rollback are distinct series
+            m = inst.get("cerbos_tpu_rollout_total")
+            assert isinstance(m, obs.CounterVec) and m.label == ("stage", "outcome"), m.label
+            m = inst.get("cerbos_tpu_rollout_duration_seconds")
+            assert isinstance(m, obs.HistogramVec) and m.label == "stage", m.label
             # rendered exposition carries the label on every child series
             text = obs.metrics().render()
             for line in text.splitlines():
