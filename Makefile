@@ -10,11 +10,12 @@ TRANSPORT_TESTS := tests/test_shm_transport.py tests/test_ipc.py tests/test_late
 OVERLOAD_TESTS := tests/test_overload.py
 PLAN_TESTS := tests/test_plan_batch.py
 ROLLOUT_TESTS := tests/test_rollout.py
+PROVENANCE_TESTS := tests/test_provenance.py
 # the native-touching suites: codec round-trips, frame rings, truncation fuzz
 ASAN_TESTS := tests/test_native.py tests/test_shm_transport.py
 
 .PHONY: all native native-asan clean test test-transport test-overload \
-	test-plan test-rollout test-native-asan lint
+	test-plan test-rollout test-provenance test-native-asan lint
 
 all: native
 
@@ -57,6 +58,13 @@ test-plan: native
 test-rollout: native
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(ROLLOUT_TESTS) $(PYTEST_FLAGS) -m rollout
 	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(ROLLOUT_TESTS) $(PYTEST_FLAGS) -m rollout
+
+# decision-provenance suite on both codec legs: the winning-rule column
+# crosses the ticket queue inside reply frames (native codec v2 and the
+# marshal fallback), so rule attribution must survive both encodings.
+test-provenance: native
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(PROVENANCE_TESTS) $(PYTEST_FLAGS) -m provenance
+	JAX_PLATFORMS=cpu CERBOS_TPU_NO_NATIVE=1 $(PYTHON) -m pytest $(PROVENANCE_TESTS) $(PYTEST_FLAGS) -m provenance
 
 # ASan/UBSan leg: rebuild the native module instrumented, run the suites
 # that exercise the C++ codec/ring paths (incl. the truncation fuzzers),

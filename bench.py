@@ -413,12 +413,33 @@ def _goodput(wall: float) -> dict:
     with vec._lock:
         outcomes = dict(vec._children)
     throughput = sum(outcomes.values())
-    good = outcomes.get(OUTCOME_MET, 0.0) + outcomes.get(OUTCOME_ORACLE, 0.0)
+    # children are keyed (api, outcome) since the plan PR split goodput by
+    # api; fold the api dimension for the rollup and keep JSON-able keys
+    outcome_of = lambda k: k[-1] if isinstance(k, tuple) else k
+    good = sum(v for k, v in outcomes.items() if outcome_of(k) in (OUTCOME_MET, OUTCOME_ORACLE))
     return {
-        "outcomes": {k: int(v) for k, v in sorted(outcomes.items())},
+        "outcomes": {
+            ("/".join(k) if isinstance(k, tuple) else k): int(v) for k, v in sorted(outcomes.items())
+        },
         "throughput_per_sec": round(throughput / wall, 1) if wall else 0.0,
         "goodput_per_sec": round(good / wall, 1) if wall else 0.0,
         "goodput_frac": round(good / throughput, 4) if throughput else 0.0,
+    }
+
+
+def _provenance_block(rule_table=None, k: int = 10) -> dict:
+    """Decision-provenance rollup for the artifact: attribution rate (what
+    fraction of decisions named a winning rule), the device/oracle source
+    split, the analyzer-class mix, and the hot-rule top-K from this run."""
+    from cerbos_tpu.engine.hotrules import recorder as hotrule_recorder
+
+    snap = hotrule_recorder().snapshot(k=k, rule_table=rule_table)
+    return {
+        "decisions": snap["decisions"],
+        "attribution_rate": snap["attribution_rate"],
+        "by_source": snap["by_source"],
+        "by_class": snap["by_class"],
+        "top": snap["top"],
     }
 
 
@@ -644,6 +665,9 @@ def served_main(
         # online shadow-oracle parity over this run's own batches
         # (engine/sentinel.py): divergences must be 0 with faults off
         "parity": parity,
+        # decision provenance (ISSUE 20): attribution rate, source split,
+        # hot-rule top-K — fed by the same hit counters /_cerbos/debug/hotrules reads
+        "provenance": _provenance_block(rt),
         # ticket-queue data plane (engine/ipc.py): negotiated transport,
         # frames each way, native codec ns/frame, ring-full sheds;
         # transport=local when the clients call the batcher in-process
@@ -678,6 +702,12 @@ def served_main(
             parity["lag_p99_s"],
             parity["overhead_pct"],
         ),
+        flush=True,
+    )
+    prov = record["provenance"]
+    print(
+        "provenance: decisions=%d attribution_rate=%.4f by_source=%s"
+        % (prov["decisions"], prov["attribution_rate"], json.dumps(prov["by_source"])),
         flush=True,
     )
     print(json.dumps(record))

@@ -114,11 +114,35 @@ def _entry_from_decision(
     lane that evaluated it — the join key between audit, /_cerbos/debug
     traces, and the flight recorder. ``policyEpoch`` records which committed
     policy epoch evaluated the request (engine/rollout.py) — the stamp the
-    mixed-table chaos drills audit."""
+    mixed-table chaos drills audit. ``provenance`` is the same kind of PDP
+    extension: the winning rule-table row and the evaluator (device/oracle)
+    per action — kept OUTSIDE the Cerbos-schema ``checkResources`` block so
+    log consumers comparing against the upstream entry shape stay clean."""
     effective: dict[str, dict] = {}
     for o in outputs:
         for key, attrs in o.effective_policies.items():
             effective.setdefault(key, {"attributes": dict(attrs)})
+    provenance = [
+        _drop_empty(
+            {
+                "resourceId": o.resource_id,
+                "actions": {
+                    a: _drop_empty(
+                        {
+                            "matchedRule": e.matched_rule,
+                            "ruleRowId": e.rule_row_id if e.rule_row_id >= 0 else None,
+                            "source": e.source,
+                        }
+                    )
+                    for a, e in o.actions.items()
+                    if e.matched_rule or e.source
+                },
+            }
+        )
+        for o in outputs
+    ]
+    if all(not p.get("actions") for p in provenance):
+        provenance = []
     return _drop_empty(
         {
             "callId": call_id,
@@ -127,6 +151,7 @@ def _entry_from_decision(
             "traceId": trace_id,
             "shard": shard,
             "policyEpoch": epoch,
+            "provenance": provenance,
             "checkResources": {
                 "inputs": [_input_json(i) for i in inputs],
                 "outputs": [_output_json(o) for o in outputs],

@@ -322,6 +322,8 @@ def _replay_divergences(args, client) -> int:
             f"device_still_diverges={'yes' if device_diff else 'no'} "
             f"traces={','.join(rec.get('trace_ids') or []) or '-'}"
         )
+        if getattr(args, "explain", False):
+            _explain_record(rec)
     mode = "bit-exact (local oracle)" if exact else "effects-only (server API)"
     print(
         f"\nreplayed {total} divergence record(s) [{mode}]: "
@@ -331,6 +333,30 @@ def _replay_divergences(args, client) -> int:
     # drift between replay and the recorded oracle means the policies changed
     # since capture — the repro is stale, flag it to the operator
     return 0 if reproduced == total else 1
+
+
+def _explain_record(rec: dict) -> None:
+    """Winning-rule diff for one divergence record: which rule each side
+    claims won, per action of every divergent row. Records captured before
+    provenance landed carry no rule data — say so instead of guessing."""
+    dev_p = rec.get("device_provenance") or []
+    ora_p = rec.get("oracle_provenance") or []
+    if not dev_p and not ora_p:
+        print("      (record predates provenance capture — no winning-rule data)")
+        return
+    idxs = rec.get("divergent_indices") or list(range(max(len(dev_p), len(ora_p))))
+    for i in idxs:
+        d = dev_p[i] if i < len(dev_p) else {}
+        o = ora_p[i] if i < len(ora_p) else {}
+        rid = d.get("resourceId") or o.get("resourceId") or "?"
+        for a in sorted(set(d.get("actions") or {}) | set(o.get("actions") or {})):
+            da = (d.get("actions") or {}).get(a) or {}
+            oa = (o.get("actions") or {}).get(a) or {}
+            dr = da.get("matchedRule") or "-"
+            orr = oa.get("matchedRule") or "-"
+            mark = "==" if dr == orr else "!="
+            src = da.get("source") or "?"
+            print(f"      {rid}/{a}: device[{src}] {dr} {mark} oracle {orr}")
 
 
 def _load_policies_arg(path: str) -> list:
@@ -375,6 +401,8 @@ def _analyze_cmd(args) -> int:
         for err in getattr(e, "errors", None) or [str(e)]:
             print(f"ERROR: {err}", file=sys.stderr)
         return 3
+    if args.hot:
+        return _hot_merge_cmd(report, args)
     if args.json:
         print(json.dumps(report.to_dict(), indent=2))
     else:
@@ -382,6 +410,76 @@ def _analyze_cmd(args) -> int:
     if args.fail_on and report.failed(args.fail_on):
         print(f"\nanalysis failed --fail-on {args.fail_on}", file=sys.stderr)
         return 1
+    return 0
+
+
+def _hot_merge_cmd(report, args) -> int:
+    """Merge a ``/_cerbos/debug/hotrules`` snapshot with the static
+    analyzer's eligibility classes and rank oracle-extinction targets: the
+    hottest live rules that do NOT lower to the device are the
+    highest-leverage fixes (ROADMAP item 5's burn-down list)."""
+    from .tpu.analyze import CLASS_DEVICE
+
+    try:
+        with open(args.hot, encoding="utf-8") as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"ERROR: cannot read hot-rule snapshot {args.hot}: {e}", file=sys.stderr)
+        return 3
+    by_row = {r.row_id: r for r in report.rules if r.row_id >= 0}
+    by_fqn = {f"{r.policy}#{r.rule_name}": r for r in report.rules}
+    merged = []
+    unmatched = 0
+    for entry in snap.get("top") or []:
+        rep = by_row.get(entry.get("rule_row_id"))
+        if rep is None and entry.get("rule"):
+            rep = by_fqn.get(entry["rule"])
+        if rep is None:
+            # snapshot from a different bundle/epoch than the analyzed one
+            unmatched += 1
+        merged.append(
+            {
+                "rule": entry.get("rule") or (f"{rep.policy}#{rep.rule_name}" if rep else "?"),
+                "hits": int(entry.get("hits") or 0),
+                "share": float(entry.get("share") or 0.0),
+                "class": rep.eligibility if rep else (entry.get("class") or "unknown"),
+                "reason": rep.primary_reason() if rep else "",
+            }
+        )
+    merged.sort(key=lambda m: m["hits"], reverse=True)
+    targets = [m for m in merged if m["class"] != CLASS_DEVICE]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "snapshot": {k: snap.get(k) for k in ("decisions", "attribution_rate", "by_class", "by_source")},
+                    "hot_rules": merged,
+                    "extinction_targets": targets,
+                    "unmatched_rows": unmatched,
+                },
+                indent=2,
+            )
+        )
+        return 0
+    print(
+        f"hot-rule snapshot: {snap.get('decisions', 0)} decisions, "
+        f"attribution rate {snap.get('attribution_rate', 0.0)}, "
+        f"by_class {json.dumps(snap.get('by_class') or {})}"
+    )
+    print(f"\n{'hits':>10} {'share':>7} {'class':<16} rule")
+    for m in merged:
+        line = f"{m['hits']:>10} {m['share']:>7.2%} {m['class']:<16} {m['rule']}"
+        if m["reason"]:
+            line += f"  [{m['reason']}]"
+        print(line)
+    if unmatched:
+        print(f"\nwarning: {unmatched} hot row(s) not in the analyzed bundle (stale snapshot?)")
+    if targets:
+        print(f"\noracle-extinction targets (hot, not device-eligible): {len(targets)}")
+        for m in targets:
+            print(f"  {m['share']:>7.2%} of attributed traffic  {m['rule']}  [{m['reason'] or m['class']}]")
+    else:
+        print("\nno extinction targets: every hot rule already lowers to the device")
     return 0
 
 
@@ -492,6 +590,11 @@ def main(argv: list[str] | None = None) -> int:
         default="",
         help="policy YAML file or directory: replay on a local CPU oracle (bit-exact) instead of the server API",
     )
+    p_replay.add_argument(
+        "--explain",
+        action="store_true",
+        help="per-record winning-rule diff: which rule the device vs the oracle claims won each action",
+    )
 
     p_an = sub.add_parser(
         "analyze",
@@ -507,6 +610,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     p_an.add_argument(
         "--globals", default="", help="engine globals as JSON (mirrors engine.globals config)"
+    )
+    p_an.add_argument(
+        "--hot",
+        default="",
+        metavar="FILE",
+        help="a saved /_cerbos/debug/hotrules snapshot: merge live hit counts with the static "
+        "classes and rank oracle-extinction targets",
     )
 
     args = parser.parse_args(argv)

@@ -56,6 +56,7 @@ from .budget import (
     STAGE_SETTLE,
     Waterfall,
 )
+from . import hotrules
 from .budget import tracker as budget_tracker
 from .flight import recorder as flight_recorder
 from .health import DeviceHealth  # noqa: F401  (re-exported for wiring/tests)
@@ -389,6 +390,10 @@ class BatchingEvaluator:
             "plan_fallbacks": 0,
         }
         self._init_metrics()
+        # instantiate the process-global hot-rule recorder eagerly so its
+        # metric families exist from bootstrap (scrapes see zeroed series
+        # before the first decision, and the registry lint covers them)
+        hotrules.recorder()
         tname = "check-batcher" if shard_id is None else f"check-batcher-s{shard_id}"
         self._thread = threading.Thread(target=self._loop, daemon=True, name=tname)
         self._thread.start()
@@ -479,6 +484,10 @@ class BatchingEvaluator:
             check_input(rt, i, params or T.EvalParams(), ev.schema_mgr)
             for i in inputs
         ]
+        # oracle-served decisions carry source="oracle" from check_input;
+        # fold them into the hot-rule heatmap so attribution-rate and
+        # device-vs-oracle splits cover the degraded path too
+        hotrules.recorder().observe(out)
         if wf is not None:
             wf.mark(STAGE_ORACLE)
         return out
@@ -1101,6 +1110,9 @@ class BatchingEvaluator:
         flight.timings["settle"] = settle_s
         self.m_stage_seconds.observe("settle", settle_s)
         self._record_flight(flight, outcome="ok")
+        # hot-rule heatmap (ISSUE 20): after settle like the sentinel, so
+        # attribution accounting never adds to request latency
+        hotrules.recorder().observe(outputs)
         sentinel = self.sentinel
         if sentinel is not None:
             # after settle so the sentinel never adds to request latency;

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, Any, Callable, Optional, Sequence
 
 from . import types as T
+from .hotrules import recorder as hotrule_recorder
 
 if TYPE_CHECKING:  # avoid circular imports (ruletable.check imports engine.types)
     from ..compile.compiler import CompiledPolicy
@@ -80,6 +81,10 @@ class Engine:
                 rt = self.rule_table
                 T.set_current_epoch(getattr(rt, "policy_epoch", None))
                 outputs = [check_input(rt, i, params, self.schema_mgr) for i in inputs]
+                # serial decisions bypass the batcher: fold them into the
+                # hot-rule heatmap here so attribution telemetry stays
+                # complete on low-traffic hosts (ISSUE 20)
+                hotrule_recorder().observe(outputs)
                 if wf is not None:
                     wf.mark("evaluate")
         if self.on_decision is not None:
@@ -133,6 +138,7 @@ class Engine:
                 rt = self.rule_table
                 T.set_current_epoch(getattr(rt, "policy_epoch", None))
                 outputs = [check_input(rt, i, params, self.schema_mgr) for i in inputs]
+                hotrule_recorder().observe(outputs)  # see check() above
                 if wf is not None:
                     wf.mark("evaluate")
         if self.on_decision is not None:

@@ -178,6 +178,12 @@ class FaultInjector:
                     ),
                     policy=e.policy,
                     scope=e.scope,
+                    # keep the device's claimed provenance: a divergence
+                    # record then names the rule the device *said* won,
+                    # which is exactly what corpus triage needs
+                    matched_rule=e.matched_rule,
+                    rule_row_id=e.rule_row_id,
+                    source=e.source,
                 )
                 for a, e in o.actions.items()
             }

@@ -93,6 +93,8 @@ T_SLOW_R = 12
 T_PRESSURE = 13
 T_PRESSURE_R = 14
 T_HELLO_R = 15
+T_HOTRULES = 16
+T_HOTRULES_R = 17
 
 _MAX_FRAME = 64 * 1024 * 1024  # a corrupt length must not allocate the moon
 
@@ -278,7 +280,10 @@ def encode_outputs(outputs: Sequence[T.CheckOutput]) -> list:
             (
                 o.request_id,
                 o.resource_id,
-                [(a, ae.effect, ae.policy, ae.scope) for a, ae in o.actions.items()],
+                [
+                    (a, ae.effect, ae.policy, ae.scope, ae.matched_rule, ae.rule_row_id, ae.source)
+                    for a, ae in o.actions.items()
+                ],
                 list(o.effective_derived_roles),
                 [(v.path, v.message, v.source) for v in o.validation_errors],
                 [(e.src, e.action, e.val, e.error) for e in o.outputs],
@@ -296,8 +301,11 @@ def decode_outputs(rows: list) -> list[T.CheckOutput]:
                 request_id=request_id,
                 resource_id=resource_id,
                 actions={
-                    a: T.ActionEffect(effect=e, policy=pol, scope=sc)
-                    for a, e, pol, sc in actions
+                    a: T.ActionEffect(
+                        effect=e, policy=pol, scope=sc,
+                        matched_rule=rule, rule_row_id=row, source=src,
+                    )
+                    for a, e, pol, sc, rule, row, src in actions
                 },
                 effective_derived_roles=list(edr),
                 validation_errors=[
@@ -619,6 +627,9 @@ class BatcherIpcServer:
                 elif mtype == T_PRESSURE:
                     snap = self._pressure_snapshot()
                     writer.send(T_PRESSURE_R, req_id, lambda s=snap: marshal.dumps(s))
+                elif mtype == T_HOTRULES:
+                    snap = self._hotrules_snapshot(payload)
+                    writer.send(T_HOTRULES_R, req_id, lambda s=snap: marshal.dumps(s))
         except (IpcError, OSError, EOFError, ValueError, TypeError):
             pass
         finally:
@@ -857,6 +868,24 @@ class BatcherIpcServer:
             out = monitor().sample()
         except Exception:  # noqa: BLE001
             out = {"score": 0.0, "components": {}}
+        out["pid"] = os.getpid()
+        return out
+
+    def _hotrules_snapshot(self, payload: bytes) -> dict:
+        """Hot-rule heatmap for `/_cerbos/debug/hotrules` on a front end:
+        the hit array aggregates in this (batcher) process, where decisions
+        settle; rule labels resolve against the batcher's current table."""
+        from .hotrules import recorder as hotrule_recorder
+
+        k = 20
+        try:
+            args = marshal.loads(payload) if payload else {}
+            if isinstance(args, dict) and args.get("k"):
+                k = int(args["k"])
+        except Exception:  # noqa: BLE001
+            pass
+        rt = getattr(getattr(self.batcher, "evaluator", None), "rule_table", None)
+        out = hotrule_recorder().snapshot(k=k, rule_table=rt)
         out["pid"] = os.getpid()
         return out
 
@@ -1573,6 +1602,15 @@ class RemoteBatcherClient:
         mtype, data = self._request(T_PRESSURE, b"", timeout=timeout)
         if mtype != T_PRESSURE_R:
             raise IpcError("unexpected reply to pressure request")
+        return marshal.loads(data)
+
+    def fetch_hotrules(self, k: int = 20, timeout: float = 5.0) -> dict:
+        """Hot-rule heatmap from the batcher process — the hit array
+        aggregates there, where decisions settle (ISSUE 20)."""
+        payload = marshal.dumps({"k": int(k)})
+        mtype, data = self._request(T_HOTRULES, payload, timeout=timeout)
+        if mtype != T_HOTRULES_R:
+            raise IpcError("unexpected reply to hotrules request")
         return marshal.loads(data)
 
     def fetch_metrics_text(self, timeout: float = 5.0) -> str:

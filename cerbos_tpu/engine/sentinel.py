@@ -82,6 +82,30 @@ def effect_rows(outputs: Sequence[T.CheckOutput]) -> list[dict]:
     return rows
 
 
+def provenance_rows(outputs: Sequence[T.CheckOutput]) -> list[dict]:
+    """Per-row decision provenance (winning rule / row id / evaluator
+    source), shaped like :func:`effect_rows`. Deliberately NOT part of the
+    parity comparison — attribution is telemetry, not the decision — but
+    divergence records carry both sides' winning rules so triage can see
+    which rule each path thought won (``replay-divergences --explain``)."""
+    rows = []
+    for o in outputs:
+        rows.append(
+            {
+                "resourceId": o.resource_id,
+                "actions": {
+                    a: {
+                        "matchedRule": e.matched_rule,
+                        "ruleRowId": e.rule_row_id,
+                        "source": e.source,
+                    }
+                    for a, e in sorted(o.actions.items())
+                },
+            }
+        )
+    return rows
+
+
 def compare_rows(device: list[dict], oracle: list[dict]) -> list[int]:
     """Indices of divergent rows — bit-exact dict equality per row. A length
     mismatch marks every trailing index divergent."""
@@ -578,11 +602,14 @@ class ParitySentinel:
         device = effect_rows(s.outputs)
         params = s.params or T.EvalParams()
         oracle: list[dict]
+        oracle_prov: list[dict] = []
         replay_error = ""
         try:
-            oracle = effect_rows(
-                [check_input(s.rule_table, i, params, s.schema_mgr) for i in s.inputs]
-            )
+            oracle_outputs = [
+                check_input(s.rule_table, i, params, s.schema_mgr) for i in s.inputs
+            ]
+            oracle = effect_rows(oracle_outputs)
+            oracle_prov = provenance_rows(oracle_outputs)
         except Exception as e:  # noqa: BLE001  (an oracle crash IS a divergence signal)
             replay_error = f"{type(e).__name__}: {e}"
             oracle = []
@@ -599,7 +626,7 @@ class ParitySentinel:
         diff = compare_rows(device, oracle) if not replay_error else list(range(len(device)))
         if not diff:
             return
-        self._divergence(s, device, oracle, diff, replay_error, lag)
+        self._divergence(s, device, oracle, diff, replay_error, lag, oracle_prov)
 
     def _verify_plan(self, s: _PlanSample) -> None:
         """Byte-exact filter-AST parity: serialize both planners' outputs
@@ -688,6 +715,7 @@ class ParitySentinel:
         diff: list[int],
         replay_error: str,
         lag: float,
+        oracle_prov: Optional[list[dict]] = None,
     ) -> None:
         self.stats["divergences"] += 1
         self.m_divergence.inc(str(s.shard))
@@ -702,6 +730,10 @@ class ParitySentinel:
             "inputs": [input_to_json(i) for i in s.inputs],
             "device_effects": device,
             "oracle_effects": oracle,
+            # both sides' winning rules: not compared for parity, but triage
+            # wants to know which rule each path claims won
+            "device_provenance": provenance_rows(s.outputs),
+            "oracle_provenance": oracle_prov or [],
         }
         path = None
         try:

@@ -106,6 +106,14 @@ class ActionEffect:
     effect: str
     policy: str
     scope: str = ""
+    # decision provenance (ISSUE 20): the winning rule as `<policy>#<rule>`
+    # plus its lowered rule-table row id, and which evaluator produced the
+    # decision ("device" | "oracle"). Empty/-1 when no rule matched (default
+    # DENY / NO_MATCH) — parity comparisons deliberately exclude these
+    # fields (sentinel.effect_rows compares effect/policy/scope only).
+    matched_rule: str = ""
+    rule_row_id: int = -1
+    source: str = ""
 
 
 @dataclass(slots=True)

@@ -36,6 +36,11 @@ class EffectInfo:
     effect: str
     policy: str
     scope: str = ""
+    # decision provenance (ISSUE 20): the winning rule (`<policy>#<rule>`)
+    # and its rule-table row id. Empty for default DENY / NO_MATCH and for
+    # scope-permissions NO_MATCH placeholders — no rule fired.
+    rule: str = ""
+    rule_row_id: int = -1
 
 
 @dataclass
@@ -217,12 +222,16 @@ def check_input(
 
     output = T.CheckOutput(request_id=input.request_id, resource_id=input.resource.id)
     for action in input.actions:
-        ae = T.ActionEffect(effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH)
+        # everything produced here ran on the CPU walk, so the provenance
+        # label is "oracle" — the device assembly path stamps its own
+        ae = T.ActionEffect(effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH, source="oracle")
         einfo = result.effects.get(action)
         if einfo is not None:
             ae.effect = einfo.effect
             ae.policy = einfo.policy
             ae.scope = einfo.scope
+            ae.matched_rule = einfo.rule
+            ae.rule_row_id = einfo.rule_row_id
         output.actions[action] = ae
     output.effective_derived_roles = sorted(result.effective_derived_roles)
     output.validation_errors = result.validation_errors
@@ -317,6 +326,8 @@ def _check(rt: RuleTable, input: T.CheckInput, params: T.EvalParams, schema_mgr:
                     break
 
                 has_allow = False
+                allow_rule = ""  # first satisfied ALLOW binding (provenance)
+                allow_row = -1
                 role_effect = EffectInfo(effect=T.EFFECT_NO_MATCH, policy=T.NO_POLICY_MATCH)
                 if (pt == KIND_RESOURCE and scoped_resource_exists) or (
                     pt == KIND_PRINCIPAL and scoped_principal_exists
@@ -391,10 +402,14 @@ def _check(rt: RuleTable, input: T.CheckInput, params: T.EvalParams, schema_mgr:
                                     ec.evaluate_output(b.name, rule_src, action, b.emit_output.rule_activated, constants, variables)
                                 )
                             if b.effect == T.EFFECT_ALLOW:
+                                if not has_allow:
+                                    allow_rule, allow_row = rule_src, b.id
                                 has_allow = True
                             if b.effect == T.EFFECT_DENY:
                                 role_effect.effect = T.EFFECT_DENY
                                 role_effect.scope = scope
+                                role_effect.rule = rule_src
+                                role_effect.rule_row_id = b.id
                                 if b.from_role_policy:
                                     role_effect.policy = namer.policy_key_from_fqn(b.origin_fqn)
                                 broke_out = True
@@ -415,9 +430,12 @@ def _check(rt: RuleTable, input: T.CheckInput, params: T.EvalParams, schema_mgr:
                         sp = rt.get_scope_scope_permissions(scope)
                         if sp == SCOPE_PERMISSIONS_REQUIRE_PARENTAL_CONSENT:
                             has_allow = False
+                            allow_rule, allow_row = "", -1
                         elif sp == SCOPE_PERMISSIONS_OVERRIDE_PARENT:
                             role_effect.effect = T.EFFECT_ALLOW
                             role_effect.scope = scope
+                            role_effect.rule = allow_rule
+                            role_effect.rule_row_id = allow_row
                             break
 
                 # first role result wins while NO_MATCH (check.go:409-423)

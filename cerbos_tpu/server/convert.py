@@ -168,7 +168,12 @@ def json_to_check_inputs(body: dict, aux_data: T.AuxData | None) -> tuple[list[T
 
 
 def outputs_to_json(
-    body: dict, outputs: list[T.CheckOutput], request_id: str, include_meta: bool, call_id: str = ""
+    body: dict,
+    outputs: list[T.CheckOutput],
+    request_id: str,
+    include_meta: bool,
+    call_id: str = "",
+    provenance: bool = False,
 ) -> dict:
     results = []
     for entry, out in zip(body.get("resources", []), outputs):
@@ -192,8 +197,29 @@ def outputs_to_json(
                 for oe in out.outputs
             ]
         if include_meta:
+            # matchedRule/source are decision provenance: the winning
+            # rule-table row (device lattice or CPU-oracle walk) and which
+            # evaluator produced the decision. Empty matchedRule means no
+            # rule fired (default-deny / no policy match). They extend the
+            # upstream EffectMeta schema, so they only appear when the
+            # caller opts in (X-Cerbos-TPU-Provenance header) — strict
+            # proto-schema clients parsing the default response stay clean.
             result["meta"] = {
-                "actions": {a: {"matchedPolicy": ae.policy, "matchedScope": ae.scope} for a, ae in out.actions.items()},
+                "actions": {
+                    a: {
+                        "matchedPolicy": ae.policy,
+                        "matchedScope": ae.scope,
+                        **(
+                            {
+                                **({"matchedRule": ae.matched_rule} if ae.matched_rule else {}),
+                                **({"source": ae.source} if ae.source else {}),
+                            }
+                            if provenance
+                            else {}
+                        ),
+                    }
+                    for a, ae in out.actions.items()
+                },
                 "effectiveDerivedRoles": out.effective_derived_roles,
             }
         results.append(result)

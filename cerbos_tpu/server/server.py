@@ -738,6 +738,8 @@ class Server:
         app.router.add_get("/_cerbos/debug/transport", self._h_transport)
         app.router.add_get("/_cerbos/debug/overload", self._h_overload)
         app.router.add_get("/_cerbos/debug/analysis", self._h_analysis)
+        app.router.add_get("/_cerbos/debug/hotrules", self._h_hotrules)
+        app.router.add_post("/_cerbos/debug/explain", self._h_explain)
         app.router.add_get("/_cerbos/debug/rollout", self._h_rollout)
         app.router.add_get("/_cerbos/debug/profile", self._h_profile)
         app.router.add_get("/api/server_info", self._h_server_info)
@@ -924,6 +926,163 @@ class Server:
         loop = asyncio.get_running_loop()
         body = await loop.run_in_executor(None, report.to_dict)
         return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _h_hotrules(self, request: web.Request) -> web.Response:
+        """Hot-rule heatmap: top-K rule-table rows by live decision hits,
+        with analyzer class, traffic share, and the device/oracle source
+        split — the ranking input for oracle-extinction work. ``?k=N`` caps
+        the list (default 20). In the front-door topology the counters live
+        in the shared batcher process and are fetched over the ticket queue;
+        a dead batcher falls back to this process's (front-end-local)
+        recorder with a note."""
+        try:
+            k = int(request.query.get("k", "20"))
+        except ValueError:
+            return web.json_response({"error": "k must be an integer"}, status=400)
+        from ..engine import hotrules
+
+        loop = asyncio.get_running_loop()
+
+        def local_snapshot() -> dict:
+            return hotrules.recorder().snapshot(k=k, rule_table=self.svc.engine.rule_table)
+
+        ev = getattr(self.svc.engine, "tpu_evaluator", None)
+        if ev is not None and hasattr(ev, "fetch_hotrules"):
+            try:
+                body = await loop.run_in_executor(None, lambda: ev.fetch_hotrules(k=k))
+                body["source"] = "batcher"
+                return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+            except Exception as e:  # noqa: BLE001
+                body = await loop.run_in_executor(None, local_snapshot)
+                body["source"] = "frontend"
+                body["batcher_error"] = f"{type(e).__name__}: {e}"
+                return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+        body = await loop.run_in_executor(None, local_snapshot)
+        body["source"] = "local"
+        return web.json_response(body, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _h_explain(self, request: web.Request) -> web.Response:
+        """Sampled explain mode: POST a CheckResources-shaped body and get,
+        per (resource, action), the device decision with its winning rule
+        next to a CPU-oracle traced replay — the trace's ACTIVATED rule is
+        the ground truth the device attribution must match. Intended for
+        replaying captured requests (divergence corpus records, audit
+        samples), NOT for per-request serving: the oracle leg walks the rule
+        table on CPU."""
+        try:
+            body = fastjson.loads(await request.read())
+        except json.JSONDecodeError:
+            return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"code": 3, "message": "invalid JSON payload"}, status=400)
+        verr = wire_validate.check_resources_body(body)
+        if verr:
+            return web.json_response({"code": 3, "message": verr}, status=400)
+        try:
+            aux = None
+            aux_j = (body.get("auxData") or {}).get("jwt") or {}
+            if aux_j.get("token"):
+                aux = self.svc._extract_aux_data(aux_j["token"], aux_j.get("keySetId", ""))
+            inputs, request_id, _ = convert.json_to_check_inputs(body, aux)
+        except RequestLimitExceeded as e:
+            return web.json_response({"code": 3, "message": str(e)}, status=400)
+
+        engine = self.svc.engine
+        rt = engine.rule_table
+        ev = getattr(engine, "tpu_evaluator", None)
+        loop = asyncio.get_running_loop()
+
+        def device_leg() -> tuple[list, str]:
+            # bypass the small-batch threshold: explain exists to audit the
+            # DEVICE attribution, so dispatch straight at the evaluator
+            if ev is not None:
+                try:
+                    return ev.check(list(inputs), engine.eval_params), "device"
+                except Exception as e:  # noqa: BLE001
+                    note = f"oracle (device leg failed: {type(e).__name__}: {e})"
+            else:
+                note = "oracle (no device evaluator)"
+            from ..ruletable import check_input
+
+            return [check_input(rt, i, engine.eval_params, engine.schema_mgr) for i in inputs], note
+
+        def oracle_leg() -> list:
+            from ..tracer import traced_check
+
+            return [traced_check(rt, i, engine.eval_params, engine.schema_mgr) for i in inputs]
+
+        (dev_outputs, dev_path), traced = await asyncio.gather(
+            loop.run_in_executor(None, device_leg),
+            loop.run_in_executor(None, oracle_leg),
+        )
+
+        def rule_of(comps: list) -> str:
+            policy = next((c["id"] for c in comps if c.get("kind") == "policy"), "")
+            rule = next((c["id"] for c in comps if c.get("kind") == "rule"), "")
+            return f"{policy}#{rule}"
+
+        results = []
+        agreements = disagreements = 0
+        for idx, inp in enumerate(inputs):
+            d_out = dev_outputs[idx]
+            o_out, rec = traced[idx]
+            actions: dict[str, Any] = {}
+            for action in inp.actions:
+                dae = d_out.actions.get(action)
+                oae = o_out.actions.get(action)
+                activated = [
+                    rule_of(e.components)
+                    for e in rec.events
+                    if e.activated
+                    and any(c.get("kind") == "action" and c.get("id") == action for c in e.components)
+                ]
+                agree = (
+                    dae is not None
+                    and oae is not None
+                    and dae.effect == oae.effect
+                    and dae.matched_rule == oae.matched_rule
+                )
+                agreements += 1 if agree else 0
+                disagreements += 0 if agree else 1
+                actions[action] = {
+                    "device": None
+                    if dae is None
+                    else {
+                        "effect": dae.effect,
+                        "policy": dae.policy,
+                        "matched_rule": dae.matched_rule,
+                        "rule_row_id": dae.rule_row_id,
+                        "source": dae.source,
+                    },
+                    "oracle": None
+                    if oae is None
+                    else {
+                        "effect": oae.effect,
+                        "policy": oae.policy,
+                        "matched_rule": oae.matched_rule,
+                        "rule_row_id": oae.rule_row_id,
+                    },
+                    "trace_activated": activated,
+                    "agree": agree,
+                }
+            results.append(
+                {
+                    "resource": {"kind": inp.resource.kind, "id": inp.resource.id},
+                    "actions": actions,
+                    "trace": rec.to_json(),
+                }
+            )
+        payload = {
+            "requestId": request_id,
+            "device_path": dev_path,
+            "results": results,
+            "summary": {
+                "actions": agreements + disagreements,
+                "agreements": agreements,
+                "disagreements": disagreements,
+            },
+        }
+        return web.json_response(payload, dumps=lambda o: json.dumps(o, default=str))
 
     async def _h_rollout(self, request: web.Request) -> web.Response:
         """Policy-rollout state for THIS process: the serving epoch, the
@@ -1117,7 +1276,14 @@ class Server:
                 )
             resp = web.Response(
                 body=fastjson.dumps(
-                    convert.outputs_to_json(body, outputs, request_id, include_meta, call_id)
+                    convert.outputs_to_json(
+                        body,
+                        outputs,
+                        request_id,
+                        include_meta,
+                        call_id,
+                        provenance="X-Cerbos-TPU-Provenance" in request.headers,
+                    )
                 ),
                 content_type="application/json",
             )

@@ -189,14 +189,21 @@ def _compute(
             allow_d = (allow_mask & at_d).any(axis=2)
             sp_d = sp_by_ba[:, pt, d][:, None]  # [BA, 1]
             allow_ok = allow_d & (sp_d == SP_OVERRIDE)
-            # first satisfied deny j at this depth
+            # first satisfied deny/allow j at this depth — the winning-rule
+            # column (ISSUE 20) is this one extra min-reduction over the
+            # already-computed activation masks, not a second pass
             j_idx = xp.arange(J)[None, None, :]
             deny_j = xp.where(deny_mask & at_d, j_idx, _BIG).min(axis=2)  # [BA, K]
+            allow_j = xp.where(allow_mask & at_d, j_idx, _BIG).min(axis=2)
             newly_deny = ~decided & deny_d
             newly_allow = ~decided & ~deny_d & allow_ok
             code = xp.where(newly_deny, CODE_DENY, xp.where(newly_allow, CODE_ALLOW, code))
             depth_out = xp.where(newly_deny | newly_allow, d, depth_out)
-            wj = xp.where(newly_deny, deny_j.astype(xp.int8), wj)
+            wj = xp.where(
+                newly_deny,
+                deny_j.astype(xp.int8),
+                xp.where(newly_allow, allow_j.astype(xp.int8), wj),
+            )
             decided = decided | newly_deny | newly_allow
         role_codes.append(code)
         role_depths.append(depth_out)
@@ -1294,7 +1301,9 @@ class TpuEvaluator:
                 self.stats["trivial_inputs"] += 1
                 out = T.CheckOutput(request_id=inp.request_id, resource_id=inp.resource.id)
                 for action in inp.actions:
-                    out.actions[action] = T.ActionEffect(effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH)
+                    out.actions[action] = T.ActionEffect(
+                        effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH, source="device"
+                    )
                 outputs.append(out)
                 continue
             self.stats["device_inputs"] += 1
@@ -1310,7 +1319,7 @@ class TpuEvaluator:
                     out = T.CheckOutput(request_id=inp.request_id, resource_id=inp.resource.id)
                     for action in inp.actions:
                         out.actions[action] = T.ActionEffect(
-                            effect=T.EFFECT_DENY, policy=plan.resource_policy_key
+                            effect=T.EFFECT_DENY, policy=plan.resource_policy_key, source="device"
                         )
                     out.validation_errors = vr_errors
                     outputs.append(out)
@@ -1442,7 +1451,9 @@ class TpuEvaluator:
         for action in inp.actions:
             ci = action_to_ba.get(action)
             if ci is None:
-                out.actions[action] = T.ActionEffect(effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH)
+                out.actions[action] = T.ActionEffect(
+                    effect=T.EFFECT_DENY, policy=T.NO_POLICY_MATCH, source="device"
+                )
                 continue
             code, pt, depth, k = (int(x) for x in final[ci])
 
@@ -1450,20 +1461,33 @@ class TpuEvaluator:
             main_key = plan.principal_policy_key if pt == PT_PRINCIPAL else plan.resource_policy_key
             exists = plan.scoped_principal_exists if pt == PT_PRINCIPAL else plan.scoped_resource_exists
 
-            if code == CODE_ALLOW:
-                ae = T.ActionEffect(effect=T.EFFECT_ALLOW, policy=main_key, scope=chain[depth] if depth < len(chain) else "")
-            elif code == CODE_DENY:
-                policy = main_key if exists else T.NO_POLICY_MATCH
+            if code in (CODE_ALLOW, CODE_DENY):
+                # winning-rule attribution (ISSUE 20): win_j carries the
+                # first-match j for BOTH effects now, so the decision names
+                # the rule row that produced it
+                policy = main_key if (code == CODE_ALLOW or exists) else T.NO_POLICY_MATCH
+                matched_rule, row_id = "", -1
                 wj = int(win_j[ci, k, pt])
                 if 0 <= wj:
                     entry = self._entry_at(batch, ci, k, wj)
-                    if entry is not None and entry.from_role_policy:
-                        policy = namer.policy_key_from_fqn(entry.origin_fqn)
-                ae = T.ActionEffect(effect=T.EFFECT_DENY, policy=policy, scope=chain[depth] if depth < len(chain) else "")
+                    if entry is not None:
+                        if code == CODE_DENY and entry.from_role_policy:
+                            policy = namer.policy_key_from_fqn(entry.origin_fqn)
+                        if entry.row is not None:
+                            matched_rule = self._rule_src(entry)
+                            row_id = entry.row.id
+                ae = T.ActionEffect(
+                    effect=T.EFFECT_ALLOW if code == CODE_ALLOW else T.EFFECT_DENY,
+                    policy=policy,
+                    scope=chain[depth] if depth < len(chain) else "",
+                    matched_rule=matched_rule,
+                    rule_row_id=row_id,
+                    source="device",
+                )
             else:
                 # NO_MATCH → default deny (resource-pass attribution)
                 policy = plan.resource_policy_key if plan.scoped_resource_exists else T.NO_POLICY_MATCH
-                ae = T.ActionEffect(effect=T.EFFECT_DENY, policy=policy)
+                ae = T.ActionEffect(effect=T.EFFECT_DENY, policy=policy, source="device")
             out.actions[action] = ae
 
             # reconstruct processed resource-chain depths + emitted outputs

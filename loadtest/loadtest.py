@@ -630,6 +630,69 @@ def _fetch_transport(http_port: int) -> dict:
         return {"transport": "unknown"}
 
 
+def _fetch_hotrules(http_port: int, k: int = 10) -> dict:
+    """GET /_cerbos/debug/hotrules: the hot-rule heatmap (served out of the
+    batcher process in the front-door topology); empty when unreachable."""
+    try:
+        s = socket.create_connection(("127.0.0.1", http_port), timeout=5)
+        s.sendall(
+            b"GET /_cerbos/debug/hotrules?k=%d HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+            % k
+        )
+        data = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data.extend(chunk)
+        s.close()
+        return json.loads(bytes(data).split(b"\r\n\r\n", 1)[-1].decode(errors="replace"))
+    except (OSError, ValueError):
+        return {}
+
+
+def _provenance_block(text: str, http_port: int) -> dict:
+    """Decision provenance for the artifact: attribution rate and the
+    device/oracle source split (cerbos_tpu_decision_source_total /
+    cerbos_tpu_rule_hits_total summed over every worker in the merged
+    scrape) plus the hot-rule top-K from the debug endpoint. All zeros with
+    CERBOS_TPU_NO_PROVENANCE=1 — that is the A/B baseline leg."""
+    by_source: dict[str, float] = {}
+    by_class: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        try:
+            series, raw = line.rsplit(" ", 1)
+            v = float(raw)
+        except ValueError:
+            continue
+        if series.startswith("cerbos_tpu_decision_source_total"):
+            i = series.find('source="')
+            if i >= 0:
+                src = series[i + 8 : series.index('"', i + 8)]
+                by_source[src] = by_source.get(src, 0.0) + v
+        elif series.startswith("cerbos_tpu_rule_hits_total"):
+            i = series.find('class="')
+            if i >= 0:
+                cls = series[i + 7 : series.index('"', i + 7)]
+                by_class[cls] = by_class.get(cls, 0.0) + v
+    snap = _fetch_hotrules(http_port)
+    decisions = sum(by_source.values())
+    unattributed = by_class.get("unattributed", 0.0)
+    attributed = sum(v for key, v in by_class.items() if key != "unattributed")
+    observed = attributed + unattributed
+    return {
+        "enabled": not bool(os.environ.get("CERBOS_TPU_NO_PROVENANCE")),
+        "decisions": int(decisions),
+        "attribution_rate": round(attributed / observed, 4) if observed else 0.0,
+        "by_source": {key: int(v) for key, v in sorted(by_source.items())},
+        "by_class": {key: int(v) for key, v in sorted(by_class.items())},
+        "top": (snap.get("top") or [])[:10],
+        "endpoint_source": snap.get("source", "unavailable"),
+    }
+
+
 def _transport_block(text: str, http_port: int, elapsed: float) -> dict:
     """Fold the ticket-queue data plane into the artifact: which transport
     the answering front end negotiated plus fleet-wide frame rates and
@@ -862,6 +925,7 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
     pressure = _pressure_block(metrics_text)
     admission = _admission_block(metrics_text)
     plan_server = _plan_block(metrics_text)
+    provenance = _provenance_block(metrics_text, http_port)
     ipc_transport = _transport_block(metrics_text, http_port, elapsed)
     proc.terminate()
     try:
@@ -977,6 +1041,11 @@ def run(duration: float, connections: int, n_mods: int, use_grpc: bool, use_tpu:
             "p99_ms": round(plan_pct(0.99), 2),
             "server": plan_server,
         },
+        # decision provenance (ISSUE 20): attribution rate, device/oracle
+        # source split, hot-rule top-K. Run the same shape with
+        # CERBOS_TPU_NO_PROVENANCE=1 for the A/B baseline; the rps delta is
+        # the provenance cost (<=2% acceptance bar, --provenance-baseline-rps)
+        "provenance": provenance,
         # ticket-queue data plane (engine/ipc.py): negotiated transport
         # (shm frame rings vs uds marshal), frames/s, codec ns/frame,
         # ring-full sheds — transport=local outside the front-door topology
@@ -1051,6 +1120,14 @@ def main() -> None:
         default="",
         help="also write the result artifact to PATH (CI-checkable, like bench.py --served --json)",
     )
+    ap.add_argument(
+        "--provenance-baseline-rps",
+        type=float,
+        default=0.0,
+        metavar="RPS",
+        help="rps of a CERBOS_TPU_NO_PROVENANCE=1 baseline run of the same shape: "
+        "computes provenance overhead %% and exits non-zero above the 2%% bar",
+    )
     args = ap.parse_args()
     if args.frontends and not args.tpu:
         # the front-door topology IS the shared device batcher: its batcher
@@ -1067,11 +1144,23 @@ def main() -> None:
         rate=args.rate, priority_mix=args.priority_mix, admit_rate=args.admit_rate,
         plan_mix=args.plan_mix,
     )
+    if args.provenance_baseline_rps > 0:
+        # A/B gate: this run (provenance on) vs the recorded baseline leg
+        # (CERBOS_TPU_NO_PROVENANCE=1, same shape). Positive = cost.
+        overhead = 100.0 * (1.0 - result["rps"] / args.provenance_baseline_rps)
+        result["provenance"]["overhead_pct"] = round(overhead, 2)
+        result["provenance"]["baseline_rps"] = args.provenance_baseline_rps
     print(json.dumps(result))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
+    if args.provenance_baseline_rps > 0 and result["provenance"]["overhead_pct"] > 2.0:
+        print(
+            f"provenance overhead {result['provenance']['overhead_pct']}% exceeds the 2% bar",
+            file=sys.stderr,
+        )
+        sys.exit(2)
 
 
 if __name__ == "__main__":

@@ -806,7 +806,7 @@ PyObject* PyResolveEffects(PyObject*, PyObject* args) {
         const Py_ssize_t cell = (static_cast<Py_ssize_t>(ba) * K + k) * J;
         for (int d = 0; d < D && !decided; d++) {
           bool deny_d = false, allow_d = false;
-          int deny_j = kBig;
+          int deny_j = kBig, allow_j = kBig;
           for (int j = 0; j < J; j++) {
             const Py_ssize_t idx = cell + j;
             if (!cand_valid[idx]) continue;
@@ -821,6 +821,7 @@ PyObject* PyResolveEffects(PyObject*, PyObject* args) {
               if (j < deny_j) deny_j = j;
             } else if (eff == allow_code) {
               allow_d = true;
+              if (j < allow_j) allow_j = j;
             }
           }
           const bool allow_ok = allow_d && sp_row[pt * D + d] == sp_override;
@@ -830,8 +831,11 @@ PyObject* PyResolveEffects(PyObject*, PyObject* args) {
             wj = deny_j;
             decided = true;
           } else if (allow_ok) {
+            // winning-rule column (ISSUE 20): ALLOW decisions record their
+            // first satisfied j too, mirroring the numpy/jax lattice
             code = kAllow;
             depth_out = d;
+            wj = allow_j;
             decided = true;
           }
         }
@@ -1686,6 +1690,8 @@ struct InternTable {
   PyObject* path;
   PyObject* message;
   PyObject* source;
+  PyObject* matched_rule;
+  PyObject* rule_row_id;
 };
 InternTable I;
 
@@ -1720,6 +1726,8 @@ bool InitTransportStatics() {
   CN_INTERN(path)
   CN_INTERN(message)
   CN_INTERN(source)
+  CN_INTERN(matched_rule)
+  CN_INTERN(rule_row_id)
 #undef CN_INTERN
   return true;
 }
@@ -1994,7 +2002,9 @@ bool DecodeInto(Rd& rd, PyObject* obj, PyObject* name) {
 //      resource(kind, id, attr, policy_version, scope), actions, jwt|None];
 // value(carry).
 
-constexpr uint8_t kFrameVersion = 1;
+// v2: reply per-action rows grew decision-provenance fields
+// (matched_rule, rule_row_id, source) — ISSUE 20
+constexpr uint8_t kFrameVersion = 2;
 
 PyObject* PyTicketPack(PyObject*, PyObject* args) {
   PyObject *inputs, *deadline, *traceparent, *carry;
@@ -2151,7 +2161,8 @@ PyObject* PyTicketUnpack(PyObject*, PyObject* args) {
 //
 // reply_pack(outputs, spec) -> bytes
 // Layout: u8 version; u32 n; n x [request_id, resource_id,
-//   u32 n_actions x (action, effect, policy, scope),
+//   u32 n_actions x (action, effect, policy, scope,
+//                    matched_rule, rule_row_id, source),
 //   effective_derived_roles,
 //   u32 n_verrs x (path, message, source),
 //   u32 n_outs x (src, action, val, error),
@@ -2182,7 +2193,10 @@ PyObject* PyReplyPack(PyObject*, PyObject* args) {
         Py_ssize_t pos = 0;
         while (ok && PyDict_Next(acts, &pos, &key, &ae)) {
           ok = EncodeValue(b, key, 0) && EncodeAttrOf(b, ae, I.effect) &&
-               EncodeAttrOf(b, ae, I.policy) && EncodeAttrOf(b, ae, I.scope);
+               EncodeAttrOf(b, ae, I.policy) && EncodeAttrOf(b, ae, I.scope) &&
+               EncodeAttrOf(b, ae, I.matched_rule) &&
+               EncodeAttrOf(b, ae, I.rule_row_id) &&
+               EncodeAttrOf(b, ae, I.source);
         }
       }
       Py_XDECREF(acts);
@@ -2269,7 +2283,10 @@ PyObject* PyReplyUnpack(PyObject*, PyObject* args) {
         PyObject* action = DecodeValue(rd, 0);
         PyObject* ae = action ? NewInstance(cls_ae) : nullptr;
         ok = ae && DecodeInto(rd, ae, I.effect) &&
-             DecodeInto(rd, ae, I.policy) && DecodeInto(rd, ae, I.scope);
+             DecodeInto(rd, ae, I.policy) && DecodeInto(rd, ae, I.scope) &&
+             DecodeInto(rd, ae, I.matched_rule) &&
+             DecodeInto(rd, ae, I.rule_row_id) &&
+             DecodeInto(rd, ae, I.source);
         ok = ok && PyDict_SetItem(acts, action, ae) == 0;
         Py_XDECREF(action);
         Py_XDECREF(ae);

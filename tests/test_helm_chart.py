@@ -217,6 +217,9 @@ class TestChartStatic:
             "cerbos_tpu_plan_residual_rules_bucket",
             "cerbos_tpu_plan_parity_checks_total",
             "cerbos_tpu_plan_parity_divergence_total",
+            # provenance row (decision attribution + hot rules)
+            "cerbos_tpu_rule_hits_total",
+            "cerbos_tpu_decision_source_total",
         ):
             assert needle in joined, needle
 

@@ -232,6 +232,11 @@ class TestMetricsLint:
                 "cerbos_tpu_rollout_total",
                 "cerbos_tpu_rollout_duration_seconds",
                 "cerbos_tpu_policy_epoch",
+                # decision-provenance family (engine/hotrules.py): the
+                # batcher instantiates the recorder at construction so the
+                # series exist before the first decision
+                "cerbos_tpu_rule_hits_total",
+                "cerbos_tpu_decision_source_total",
             ):
                 assert name in inst, name
             known = (obs.Counter, obs.CounterVec, obs.Gauge, obs.GaugeVec, obs.Histogram, obs.HistogramVec)
